@@ -1,0 +1,29 @@
+"""distributedtraining_tpu — a TPU-native incentivized federated-training framework.
+
+Capability-parity rebuild of bit-current/DistributedTraining ("Hivetrain"):
+independent *miners* train weight-deltas of a shared base LM, *validators*
+score each delta by measured loss improvement on held-out data and emit the
+scores to a (Bittensor-style) chain, and an *averager* merges deltas with
+learned mixing weights into the next base model. Coordination rides artifact
+repositories (HF-Hub-style) plus a chain key-value/score plane — not a
+collective-communication fabric — so every participant can come and go.
+
+Unlike the PyTorch reference, all compute here is JAX/XLA:
+
+- train / eval steps are jitted pure functions (engine/train.py, engine/validate.py)
+- the parameterized merge is a jitted computation over a stacked miner axis
+  with ``jax.grad`` supplying merge-weight meta-gradients (engine/average.py)
+- intra-node scaling is a ``jax.sharding.Mesh`` (dp/fsdp/tp/sp axes) over ICI
+  (parallel/), with ring attention for long sequences (ops/ring_attention.py)
+- deltas round-trip as msgpack / safetensors, never pickle (serialization.py)
+
+Reference layer map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+# Spec version emitted with chain weight-sets (reference:
+# template/__init__.py:24-27 encodes version -> int for set_weights).
+def spec_version() -> int:
+    major, minor, patch = (int(x) for x in __version__.split("."))
+    return (1000 * major) + (10 * minor) + patch

@@ -1,0 +1,191 @@
+"""Pytree weight-delta algebra.
+
+The smallest, most-depended-on layer of the framework: a *delta* is the
+per-parameter difference ``trained - base`` between two structurally identical
+parameter pytrees. Miners ship deltas, validators apply them for scoring, the
+averager merges stacks of them.
+
+Reference behavior being reproduced (TPU-idiomatically):
+- delta computation: hivetrain/training_manager.py:417-422
+- delta application: hivetrain/validation_logic.py:251-259
+- NaN screening of untrusted submissions: hivetrain/averaging_logic.py:121-127
+- shape screening of untrusted submissions: hivetrain/averaging_logic.py:404-410
+
+Everything here is a pure function on pytrees; the heavy ones are jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any  # a pytree of arrays
+
+
+def tree_sub(a: Params, b: Params) -> Params:
+    """Elementwise ``a - b`` over structurally identical pytrees."""
+    return jax.tree_util.tree_map(lambda x, y: x - y, a, b)
+
+
+def tree_add(a: Params, b: Params) -> Params:
+    """Elementwise ``a + b`` over structurally identical pytrees."""
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def compute_delta(trained: Params, base: Params) -> Params:
+    """delta = trained - base (the artifact a miner uploads)."""
+    return tree_sub(trained, base)
+
+
+def apply_delta(base: Params, delta: Params) -> Params:
+    """Reconstruct trained params from base + delta."""
+    return tree_add(base, delta)
+
+
+def tree_scale(a: Params, s) -> Params:
+    return jax.tree_util.tree_map(lambda x: x * s, a)
+
+
+def zeros_like(a: Params) -> Params:
+    return jax.tree_util.tree_map(jnp.zeros_like, a)
+
+
+# ---------------------------------------------------------------------------
+# Screening of untrusted submissions
+# ---------------------------------------------------------------------------
+
+def has_nonfinite(tree: Params) -> bool:
+    """True if any leaf contains NaN/Inf. Host-side screen for untrusted deltas."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return False
+    flags = [jnp.any(~jnp.isfinite(leaf)) for leaf in leaves]
+    return bool(jax.device_get(jnp.any(jnp.stack(flags))))
+
+
+def shapes_match(tree: Params, reference: Params, *, check_dtype: bool = False) -> bool:
+    """True iff ``tree`` has the same structure and per-leaf shapes as ``reference``.
+
+    Used to reject malformed miner submissions before any compute touches them.
+    """
+    ts = jax.tree_util.tree_structure(tree)
+    rs = jax.tree_util.tree_structure(reference)
+    if ts != rs:
+        return False
+    for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(reference)):
+        if tuple(np.shape(a)) != tuple(np.shape(b)):
+            return False
+        if check_dtype:
+            # numpy-side comparison: jnp.asarray would silently downcast a
+            # hostile f64 wire tensor to f32 under x64-disabled JAX and the
+            # check would pass vacuously.
+            da = a.dtype if hasattr(a, "dtype") else np.asarray(a).dtype
+            db = b.dtype if hasattr(b, "dtype") else np.asarray(b).dtype
+            if np.dtype(da) != np.dtype(db):
+                return False
+    return True
+
+
+def screen_delta(delta: Params, base: Params, *, max_abs: float | None = None,
+                 check_dtype: bool = True) -> tuple[bool, str]:
+    """Full admission screen for an untrusted delta.
+
+    Returns (ok, reason). Checks structure/shape/dtype parity with the base,
+    finiteness, and an optional magnitude cap (a crude poisoning guard the
+    reference lacks). dtype parity matters: a f64/i64 submission would
+    silently promote the merge and double its memory.
+    """
+    if not shapes_match(delta, base, check_dtype=check_dtype):
+        return False, "shape_mismatch"
+    if has_nonfinite(delta):
+        return False, "nonfinite"
+    if max_abs is not None:
+        m = global_max_abs(delta)
+        if m > max_abs:
+            return False, f"magnitude_exceeded({m:.3e}>{max_abs:.3e})"
+    return True, "ok"
+
+
+def global_max_abs(tree: Params) -> float:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return 0.0
+    return float(jax.device_get(jnp.max(jnp.stack([jnp.max(jnp.abs(l)) for l in leaves]))))
+
+
+def global_norm(tree: Params) -> float:
+    """L2 norm over all leaves (delta-magnitude diagnostic)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return 0.0
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return float(jax.device_get(jnp.sqrt(sq)))
+
+
+# ---------------------------------------------------------------------------
+# Stacking: the averager's miner axis
+# ---------------------------------------------------------------------------
+
+def stack_deltas(deltas: Sequence[Params]) -> Params:
+    """Stack M structurally identical deltas into one pytree with a leading
+    miner axis on every leaf: leaf shape (s0, ...) -> (M, s0, ...).
+
+    This is the TPU-native answer to the reference's per-batch disk reload of
+    every cached delta (hivetrain/averaging_logic.py:450-470): one stacked
+    pytree makes the merge a single einsum-like jitted computation and lets the
+    miner axis be sharded across devices.
+    """
+    if not deltas:
+        raise ValueError("stack_deltas: empty sequence")
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *deltas)
+
+
+def unstack_deltas(stacked: Params) -> list[Params]:
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]
+
+
+def weighted_merge(base: Params, stacked_deltas: Params, weights: jax.Array) -> Params:
+    """merged = base + sum_i softmax-free weights[i] * delta_i.
+
+    ``weights`` has shape (M,). Jittable; differentiable w.r.t. ``weights``,
+    which is how the parameterized averager gets its meta-gradient for free
+    (replacing the manual inner-product formula at
+    hivetrain/averaging_logic.py:513-528).
+    """
+    def merge_leaf(b, d):
+        w = weights.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
+        return b + jnp.sum(w * d, axis=0)
+
+    return jax.tree_util.tree_map(merge_leaf, base, stacked_deltas)
+
+
+def per_tensor_weighted_merge(base: Params, stacked_deltas: Params, weights: Params) -> Params:
+    """Merge with per-miner *and* per-tensor mixing weights.
+
+    ``weights`` is a pytree matching ``base``'s structure whose leaves have
+    shape (M,) — one mixing vector per parameter tensor. This is the
+    production merge of the reference (ParameterizedAverager,
+    hivetrain/averaging_logic.py:422-448, where ``self.weights`` is
+    (num_models, num_params)).
+    """
+    def merge_leaf(b, d, w):
+        wv = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
+        return b + jnp.sum(wv * d, axis=0)
+
+    return jax.tree_util.tree_map(merge_leaf, base, stacked_deltas, weights)
+
+
+def init_merge_weights(base: Params, num_miners: int, *, per_tensor: bool = True,
+                       value: float | None = None) -> Params | jax.Array:
+    """Uniform initial mixing weights (1/M each, like the reference's
+    torch.ones/num_models at hivetrain/averaging_logic.py:363)."""
+    v = (1.0 / num_miners) if value is None else value
+    if not per_tensor:
+        return jnp.full((num_miners,), v, dtype=jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda _: jnp.full((num_miners,), v, dtype=jnp.float32), base
+    )

@@ -1,0 +1,14 @@
+"""Model zoo: TPU-first Flax implementations.
+
+- gpt2: the reference's training target (openai-community/gpt2,
+  neurons/miner.py:60), in 124M and 355M presets plus tiny test configs.
+- llama: Llama-2-7B / Llama-3-8B presets for the LoRA-delta and multi-host
+  configs in BASELINE.json.
+- lora: low-rank adapter trees whose *parameters are the delta*.
+"""
+
+from .gpt2 import GPT2, GPT2Config
+from .llama import Llama, LlamaConfig
+from . import lora
+
+__all__ = ["GPT2", "GPT2Config", "Llama", "LlamaConfig", "lora"]

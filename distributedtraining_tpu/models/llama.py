@@ -1,0 +1,179 @@
+"""TPU-first Flax Llama family (Llama-2-7B / Llama-3-8B presets).
+
+Targets BASELINE.json configs 4-5 (Llama-2-7B LoRA-delta miner on v4-32;
+Llama-3-8B full-param delta on multi-host v5e-64). The reference never ships
+these models — it trains GPT-2 only — but its delta/merge machinery is
+model-agnostic, and these presets are what the scale configs exercise.
+
+Architecture: RMSNorm pre-norm, rotary position embeddings, SwiGLU MLP,
+grouped-query attention. Same TPU idioms as gpt2.py: logical sharding axes on
+every param, bf16 compute / fp32 storage, optional remat, packed-sequence
+masks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import causal_attention
+from .gpt2 import pad_vocab
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 4096
+    n_embd: int = 4096
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 32
+    intermediate_size: int = 11008
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    remat: bool = True
+    attention_impl: str = "dense"
+    vocab_multiple: int = 128
+
+    @property
+    def padded_vocab(self) -> int:
+        return pad_vocab(self.vocab_size, self.vocab_multiple)
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def storage_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+
+PRESETS: dict[str, LlamaConfig] = {
+    "llama2-7b": LlamaConfig(),
+    "llama3-8b": LlamaConfig(vocab_size=128256, max_seq_len=8192,
+                             n_kv_head=8, intermediate_size=14336,
+                             rope_theta=500000.0),
+    "tiny-llama": LlamaConfig(vocab_size=512, max_seq_len=128, n_embd=64,
+                              n_layer=2, n_head=4, n_kv_head=2,
+                              intermediate_size=128, remat=False),
+}
+
+
+def rotary_embedding(x: jax.Array, position_ids: jax.Array,
+                     theta: float) -> jax.Array:
+    """Apply RoPE to [B, T, H, D] given positions [B, T]."""
+    D = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    angles = position_ids[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    param_dtype: str
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("embed",)),
+            (x.shape[-1],), jnp.dtype(self.param_dtype))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True)
+                                   + self.eps)
+        return (norm * scale).astype(x.dtype)
+
+
+def _dense(features, name, axes, cfg: LlamaConfig):
+    return nn.Dense(features, use_bias=False, dtype=cfg.compute_dtype(),
+                    param_dtype=cfg.storage_dtype(),
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.normal(0.02), axes),
+                    name=name)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, position_ids):
+        cfg = self.cfg
+        B, T, E = x.shape
+        Hq, Hkv, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+
+        h = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="attn_norm")(x)
+        q = _dense(Hq * D, "wq", ("embed", "qkv"), cfg)(h).reshape(B, T, Hq, D)
+        k = _dense(Hkv * D, "wk", ("embed", "qkv"), cfg)(h).reshape(B, T, Hkv, D)
+        v = _dense(Hkv * D, "wv", ("embed", "qkv"), cfg)(h).reshape(B, T, Hkv, D)
+        q = rotary_embedding(q, position_ids, cfg.rope_theta)
+        k = rotary_embedding(k, position_ids, cfg.rope_theta)
+        if Hkv != Hq:  # GQA: broadcast kv heads to query heads
+            rep = Hq // Hkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = causal_attention(q, k, v, attention_mask=attention_mask,
+                                segment_ids=segment_ids, impl=cfg.attention_impl)
+        attn = _dense(E, "wo", ("qkv", "embed"), cfg)(attn.reshape(B, T, Hq * D))
+        x = x + attn
+
+        h = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="mlp_norm")(x)
+        gate = _dense(cfg.intermediate_size, "w_gate", ("embed", "mlp"), cfg)(h)
+        up = _dense(cfg.intermediate_size, "w_up", ("embed", "mlp"), cfg)(h)
+        down = _dense(E, "w_down", ("mlp", "embed"), cfg)(nn.silu(gate) * up)
+        return x + down
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, attention_mask=None, segment_ids=None,
+                 position_ids=None, deterministic: bool = True):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        wte = self.param(
+            "wte",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         ("vocab", "embed")),
+            (cfg.padded_vocab, cfg.n_embd), cfg.storage_dtype())
+        if position_ids is None:
+            position_ids = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = wte[input_ids].astype(cfg.compute_dtype())
+
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock)
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"layer_{i}")(x, attention_mask, segment_ids,
+                                              position_ids)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        lm_head = self.param(
+            "lm_head",
+            nn.with_logical_partitioning(nn.initializers.normal(0.02),
+                                         ("vocab", "embed")),
+            (cfg.padded_vocab, cfg.n_embd), cfg.storage_dtype())
+        logits = jnp.einsum("bte,ve->btv", x, lm_head.astype(cfg.compute_dtype()),
+                            preferred_element_type=jnp.float32)
+        return logits
+
+    def init_params(self, rng, *, seq_len: int = 8):
+        """Raw (unboxed) param pytree; logical axis metadata is recovered
+        separately via parallel.sharding.logical_param_specs."""
+        dummy = jnp.zeros((1, seq_len), jnp.int32)
+        return nn.meta.unbox(self.init(rng, dummy)["params"])
+
+
+def make_model(preset_or_cfg) -> tuple[Llama, LlamaConfig]:
+    cfg = PRESETS[preset_or_cfg] if isinstance(preset_or_cfg, str) else preset_or_cfg
+    return Llama(cfg), cfg

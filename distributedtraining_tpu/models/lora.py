@@ -1,0 +1,115 @@
+"""LoRA adapters as delta subtrees.
+
+For BASELINE.json config 4 (Llama-2-7B LoRA-delta miner): instead of shipping
+a full-parameter delta, the miner trains low-rank factors (A, B) per target
+kernel and ships *only the adapter pytree*. The validator/averager reconstruct
+the effective delta as ``(A @ B) * (alpha / rank)`` per kernel — the delta
+algebra (delta.py) and merge strategies then apply unchanged.
+
+Design: functional and model-agnostic. We never wrap modules — we select 2-D
+kernels from a params pytree by path predicate and build a parallel adapter
+pytree whose adapted nodes are ``LoRAPair`` pytree dataclasses (so jax.grad
+and optax traverse them) and whose non-adapted nodes are ``None`` (an empty
+subtree to JAX). The train step stays a pure function of
+(base_params, lora_params, batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+Params = Any
+
+DEFAULT_TARGETS = ("c_attn", "wq", "wk", "wv", "wo", "c_proj")
+
+
+@struct.dataclass
+class LoRAPair:
+    """One adapted kernel's low-rank factors: a [in, r], b [r, out]."""
+    a: jax.Array
+    b: jax.Array
+
+
+def _is_adapter_node(x) -> bool:
+    return x is None or isinstance(x, LoRAPair)
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # kernel is adapted iff any path component matches one of these names
+    target_patterns: tuple = DEFAULT_TARGETS
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def is_target(path, leaf, cfg: LoRAConfig) -> bool:
+    from ..serialization import path_components
+    comps = path_components(path)
+    if comps and comps[-1] != "kernel":
+        return False
+    if jnp.ndim(leaf) != 2:
+        return False
+    return any(pat in comp for comp in comps for pat in cfg.target_patterns)
+
+
+def init_lora(rng: jax.Array, base_params: Params, cfg: LoRAConfig) -> Params:
+    """Build the adapter pytree: for each targeted [in, out] kernel a
+    ``LoRAPair(a=gaussian, b=zeros)``; ``None`` elsewhere.
+
+    b=0 makes the initial effective delta exactly zero, so a freshly
+    initialized LoRA miner is a no-op submission (scores 0, never harms the
+    base).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(base_params)
+    keys = jax.random.split(rng, len(flat))
+    leaves = []
+    for k, (path, leaf) in zip(keys, flat):
+        if is_target(path, leaf, cfg):
+            fan_in, fan_out = leaf.shape
+            a = jax.random.normal(k, (fan_in, cfg.rank), jnp.float32) * 0.02
+            b = jnp.zeros((cfg.rank, fan_out), jnp.float32)
+            leaves.append(LoRAPair(a=a, b=b))
+        else:
+            leaves.append(None)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def apply_lora(base_params: Params, lora_params: Params, cfg: LoRAConfig) -> Params:
+    """Effective params = base + scaling * (A @ B) on adapted kernels.
+
+    Jittable and differentiable w.r.t. ``lora_params`` — this is the forward
+    substitution inside the LoRA train step.
+    """
+    def leaf(l, b):
+        if l is None:
+            return b
+        return b + ((l.a @ l.b) * cfg.scaling).astype(b.dtype)
+    return jax.tree_util.tree_map(leaf, lora_params, base_params,
+                                  is_leaf=_is_adapter_node)
+
+
+def lora_to_full_delta(base_params: Params, lora_params: Params,
+                       cfg: LoRAConfig) -> Params:
+    """Dense delta matching base structure (zeros off-target) — what a
+    validator applies when scoring a LoRA submission alongside full-param
+    peers, and what the averager stacks."""
+    def leaf(l, b):
+        if l is None:
+            return jnp.zeros_like(b)
+        return ((l.a @ l.b) * cfg.scaling).astype(b.dtype)
+    return jax.tree_util.tree_map(leaf, lora_params, base_params,
+                                  is_leaf=_is_adapter_node)
+
+
+def adapted_pairs(lora_params: Params) -> list[LoRAPair]:
+    return [x for x in jax.tree_util.tree_leaves(
+        lora_params, is_leaf=_is_adapter_node) if isinstance(x, LoRAPair)]

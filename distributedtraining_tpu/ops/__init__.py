@@ -1,0 +1,15 @@
+"""Hot-path ops: attention (dense / flash-pallas / ring), losses.
+
+These are the MXU-bound inner loops; everything is shaped for XLA fusion
+(static shapes, fp32 softmax accumulation over bf16 operands).
+"""
+
+from .attention import causal_attention, make_causal_mask
+from .losses import causal_lm_loss, cross_entropy_with_logits
+
+__all__ = [
+    "causal_attention",
+    "make_causal_mask",
+    "causal_lm_loss",
+    "cross_entropy_with_logits",
+]

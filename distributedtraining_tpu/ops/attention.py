@@ -1,0 +1,100 @@
+"""Causal self-attention for TPU.
+
+Implementations:
+- "dense": einsum QK^T -> fp32 softmax -> PV. XLA fuses this well on TPU for
+  the reference's sequence lengths (64-512 tokens); it is the default and the
+  correctness oracle for the fancier paths.
+- "flash": Pallas blockwise-softmax kernel (ops/flash_attention.py), used for
+  long sequences where the [T, T] score matrix stops fitting in VMEM.
+- ring attention for sequence-parallel meshes lives in ops/ring_attention.py
+  (it calls back into these per-block primitives).
+
+Supports padding masks and packed-sequence segment ids (block-diagonal
+attention), which the data pipeline uses to avoid the reference's pad-to-64
+token waste (neurons/miner.py:70).
+
+Shapes: q, k, v are [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # large-negative in bf16-safe range (bf16 max ~3.4e38, fine)
+
+
+def make_causal_mask(q_len: int, kv_len: int | None = None,
+                     *, q_offset: int = 0) -> jax.Array:
+    """Boolean [q_len, kv_len] mask, True = may attend.
+
+    ``q_offset`` shifts query positions — used by ring attention where the
+    local query block sits at a global offset relative to the key block.
+    """
+    kv_len = q_len if kv_len is None else kv_len
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return q_pos >= kv_pos
+
+
+def combine_masks(causal: jax.Array,
+                  attention_mask: Optional[jax.Array],
+                  segment_ids: Optional[jax.Array],
+                  kv_segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Fold padding + packing masks into the causal mask.
+
+    attention_mask: [B, kv_len] with 1 = real token.
+    segment_ids:    [B, q_len] packing ids; tokens attend only within their
+                    own segment (block-diagonal).
+    Returns [B, 1, q_len, kv_len] boolean.
+    """
+    mask = causal[None, None, :, :]
+    if attention_mask is not None:
+        mask = mask & attention_mask[:, None, None, :].astype(bool)
+    if segment_ids is not None:
+        kv_seg = segment_ids if kv_segment_ids is None else kv_segment_ids
+        same = segment_ids[:, :, None] == kv_seg[:, None, :]
+        mask = mask & same[:, None, :, :]
+    return mask
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array]) -> jax.Array:
+    """Masked attention with fp32 softmax accumulation.
+
+    q/k/v: [B, T, H, D] (any float dtype; scores accumulate in fp32).
+    mask: broadcastable to [B, H, Tq, Tkv], True = attend.
+    """
+    depth = q.shape[-1]
+    scale = depth ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     *,
+                     attention_mask: Optional[jax.Array] = None,
+                     segment_ids: Optional[jax.Array] = None,
+                     impl: str = "dense") -> jax.Array:
+    """Causal self-attention entry point used by the models.
+
+    impl: "dense" (XLA), "flash" (Pallas kernel when available, falls back to
+    dense on non-TPU backends).
+    """
+    B, T, H, D = q.shape
+    if impl == "flash":
+        from . import flash_attention
+        out = flash_attention.flash_attention(
+            q, k, v, attention_mask=attention_mask, segment_ids=segment_ids)
+        if out is not None:
+            return out
+        # fall through to dense when the kernel declines (e.g. CPU backend)
+    mask = combine_masks(make_causal_mask(T), attention_mask, segment_ids)
+    return dot_product_attention(q, k, v, mask)

@@ -1,0 +1,103 @@
+"""Pallas blockwise (flash) attention for TPU.
+
+Online-softmax attention that never materializes the [T, T] score matrix in
+HBM — the long-sequence path. Grid: (batch*heads, q_blocks); the kernel scans
+kv blocks with running max/denominator in VMEM scratch.
+
+``flash_attention`` returns None when it declines (non-TPU backend, unpadded
+shapes, or unsupported masks) and the caller falls back to the dense XLA path
+(ops/attention.py) — identical numerics, different memory profile.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    *,
+                    attention_mask: Optional[jax.Array] = None,
+                    segment_ids: Optional[jax.Array] = None,
+                    block_q: int = 256, block_kv: int = 256
+                    ) -> Optional[jax.Array]:
+    """[B, T, H, D] causal flash attention. Returns None to decline."""
+    B, T, H, D = q.shape
+    if not _on_tpu():
+        return None
+    if attention_mask is not None or segment_ids is not None:
+        # masked variants ride the dense path for now
+        return None
+    if T % block_q or T % block_kv or D % 128 and D not in (64,):
+        return None
+    try:
+        from jax.experimental import pallas as pl
+    except ImportError:
+        return None
+
+    orig_dtype = q.dtype
+    scale = D ** -0.5
+    nq = T // block_q
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        qb = q_ref[...].astype(jnp.float32) * scale  # [block_q, D]
+
+        def body(ki, carry):
+            acc, m_prev, l_prev = carry
+            kb = pl.load(k_ref, (pl.dslice(ki * block_kv, block_kv), slice(None)))
+            vb = pl.load(v_ref, (pl.dslice(ki * block_kv, block_kv), slice(None)))
+            s = qb @ kb.astype(jnp.float32).T  # [block_q, block_kv]
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kv_pos = ki * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + p @ vb.astype(jnp.float32)
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((block_q, D), jnp.float32)
+        m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((block_q,), jnp.float32)
+        # causal: kv blocks past the diagonal contribute nothing — skip them.
+        # Last query position in this block is (qi+1)*block_q - 1, so the
+        # number of kv blocks that intersect the causal triangle is
+        # floor(last_pos / block_kv) + 1.
+        num_kv = ((qi + 1) * block_q - 1) // block_kv + 1
+        acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
+        o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+    # fold batch and heads into the grid's first axis
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    try:
+        out = pl.pallas_call(
+            kernel,
+            grid=(B * H, nq),
+            in_specs=[
+                pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((B * H, T, D), orig_dtype),
+        )(qt, kt, vt)
+    except Exception:
+        return None  # kernel unsupported on this backend/version — dense fallback
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,53 @@
+"""Loss functions for causal LM training/eval.
+
+Reproduces the reference's HF-style ``labels=input_ids`` shifted
+cross-entropy (hivetrain/training_manager.py:380-385,
+hivetrain/validation_logic.py:86-91) as explicit jittable functions, with
+fp32 log-softmax over bf16 logits and padding-aware token counting (the
+reference masks pad via HF's internal -100 handling; here the mask is an
+explicit argument).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-token CE, fp32. logits [..., V], labels [...] int."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - label_logits
+
+
+def causal_lm_loss(logits: jax.Array, input_ids: jax.Array,
+                   loss_mask: Optional[jax.Array] = None
+                   ) -> tuple[jax.Array, jax.Array]:
+    """Shifted next-token loss.
+
+    logits: [B, T, V]; input_ids: [B, T]; loss_mask: [B, T] 1.0 where the
+    *label* token is real (pad and cross-segment boundaries excluded by the
+    data pipeline).
+
+    Returns (mean_loss, token_count) — token_count lets callers aggregate
+    exactly across shards/batches (sum(loss*count)/sum(count)).
+    """
+    shift_logits = logits[:, :-1, :]
+    shift_labels = input_ids[:, 1:]
+    per_tok = cross_entropy_with_logits(shift_logits, shift_labels)
+    if loss_mask is not None:
+        m = loss_mask[:, 1:].astype(per_tok.dtype)
+    else:
+        m = jnp.ones_like(per_tok)
+    total = jnp.sum(per_tok * m)
+    count = jnp.maximum(jnp.sum(m), 1.0)
+    return total / count, count
+
+
+def perplexity(mean_loss: jax.Array) -> jax.Array:
+    """The validator's second metric (hivetrain/validation_logic.py:93-97)."""
+    return jnp.exp(mean_loss)

@@ -1,0 +1,229 @@
+"""Safe tensor-pytree serialization: msgpack and safetensors, never pickle.
+
+The reference ships miner deltas as pickled ``torch.save`` files and loads
+them with ``torch.load`` (hivetrain/hf_manager.py:186-197) — arbitrary code
+execution from untrusted peers. This module replaces that with two safe
+formats plus an admission validator:
+
+- msgpack (flax.serialization): compact, preserves pytree structure, used for
+  deltas and full states on the wire.
+- safetensors: flat name->tensor mapping, zero-copy reads, interoperable with
+  the HF ecosystem.
+
+Both loaders restore *by example*: the caller supplies a template pytree, and
+the payload must match its structure (and, for the validator, shapes) before
+any values are accepted.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import serialization as flax_ser
+
+Params = Any
+
+# Hard cap on accepted payloads (bytes). An untrusted miner must not be able
+# to OOM a validator with one submission. 8 GiB covers an 8B-param bf16 delta.
+DEFAULT_MAX_BYTES = 8 * 1024**3
+
+_SEP = "::"  # path separator for flattened safetensors keys
+
+
+class PayloadError(ValueError):
+    """Raised when an untrusted payload fails validation."""
+
+
+def path_components(path) -> list[str]:
+    """jax key-path -> list of string components (shared by safetensors key
+    naming here and LoRA target selection in models/lora.py)."""
+    return [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+
+
+def _check_leaf_shapes(tree: Params, template: Params) -> None:
+    """Template-restoring loads must also match per-leaf shapes — a peer
+    payload with right names but wrong-shaped tensors would otherwise
+    broadcast silently through delta arithmetic."""
+    for (path, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(tree)[0],
+            jax.tree_util.tree_flatten_with_path(template)[0]):
+        if tuple(np.shape(a)) != tuple(np.shape(b)):
+            key = "/".join(path_components(path))
+            raise PayloadError(
+                f"shape mismatch at {key!r}: {np.shape(a)} vs {np.shape(b)}")
+
+
+# ---------------------------------------------------------------------------
+# msgpack
+# ---------------------------------------------------------------------------
+
+def to_msgpack(tree: Params) -> bytes:
+    """Serialize a pytree of arrays to msgpack bytes (host transfer included)."""
+    host = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+    return flax_ser.msgpack_serialize(host)
+
+
+def from_msgpack(data: bytes, template: Params | None = None,
+                 *, max_bytes: int = DEFAULT_MAX_BYTES) -> Params:
+    """Deserialize msgpack bytes.
+
+    With a ``template``, the result is restored into the template's structure
+    and rejected on mismatch — this is the only loader the validator/averager
+    should use for peer submissions.
+    """
+    if len(data) > max_bytes:
+        raise PayloadError(f"payload {len(data)} bytes exceeds cap {max_bytes}")
+    try:
+        raw = flax_ser.msgpack_restore(data)
+    except Exception as e:  # malformed bytes from an untrusted peer
+        raise PayloadError(f"malformed msgpack: {e}") from e
+    if template is None:
+        return raw
+    try:
+        tree = flax_ser.from_state_dict(template, raw)
+    except Exception as e:
+        raise PayloadError(f"structure mismatch: {e}") from e
+    _check_leaf_shapes(tree, template)
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# safetensors
+# ---------------------------------------------------------------------------
+
+def flatten_tree(tree: Params) -> dict[str, np.ndarray]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = _SEP.join(path_components(path))
+        out[key] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def unflatten_to_template(flat: dict[str, np.ndarray], template: Params) -> Params:
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tmpl_leaf in paths:
+        key = _SEP.join(path_components(path))
+        if key not in flat:
+            raise PayloadError(f"missing tensor {key!r}")
+        if tuple(np.shape(flat[key])) != tuple(np.shape(tmpl_leaf)):
+            raise PayloadError(
+                f"shape mismatch at {key!r}: "
+                f"{np.shape(flat[key])} vs {np.shape(tmpl_leaf)}")
+        leaves.append(flat[key])
+    extra = set(flat) - {_SEP.join(path_components(path)) for path, _ in paths}
+    if extra:
+        raise PayloadError(f"unexpected tensors: {sorted(extra)[:5]}")
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), leaves
+    )
+
+
+def to_safetensors(tree: Params) -> bytes:
+    # safetensors.flax (not .numpy) — numpy has no native bfloat16, the flax
+    # backend round-trips BF16 tensors through jnp arrays.
+    from safetensors.flax import save
+    flat = {k: jnp.asarray(v) for k, v in flatten_tree(tree).items()}
+    return save(flat)
+
+
+def from_safetensors(data: bytes, template: Params | None = None,
+                     *, max_bytes: int = DEFAULT_MAX_BYTES) -> Params:
+    if len(data) > max_bytes:
+        raise PayloadError(f"payload {len(data)} bytes exceeds cap {max_bytes}")
+    try:
+        flat = _parse_safetensors(data)
+    except PayloadError:
+        raise
+    except Exception as e:
+        raise PayloadError(f"malformed safetensors: {e}") from e
+    if template is None:
+        return flat
+    return unflatten_to_template(flat, template)
+
+
+def _st_dtypes():
+    import ml_dtypes
+    return {
+        "F64": np.float64, "F32": np.float32, "F16": np.float16,
+        "BF16": ml_dtypes.bfloat16,
+        "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+        "U64": np.uint64, "U32": np.uint32, "U16": np.uint16, "U8": np.uint8,
+        "BOOL": np.bool_,
+    }
+
+
+def _parse_safetensors(data: bytes) -> dict[str, np.ndarray]:
+    """Minimal safetensors reader with bfloat16 support (the installed
+    safetensors.numpy loader rejects BF16). Format: u64-le header length,
+    JSON header {name: {dtype, shape, data_offsets}}, raw little-endian
+    buffer. Offsets are bounds-checked — this parses untrusted bytes."""
+    import json
+    if len(data) < 8:
+        raise PayloadError("truncated safetensors header")
+    n = int.from_bytes(data[:8], "little")
+    if n > len(data) - 8 or n > 100 * 1024 * 1024:
+        raise PayloadError("bad safetensors header length")
+    header = json.loads(data[8:8 + n].decode("utf-8"))
+    buf = memoryview(data)[8 + n:]
+    dtypes = _st_dtypes()
+    out: dict[str, np.ndarray] = {}
+    for name, info in header.items():
+        if name == "__metadata__":
+            continue
+        if info["dtype"] not in dtypes:
+            raise PayloadError(f"unsupported dtype {info['dtype']!r}")
+        dt = np.dtype(dtypes[info["dtype"]])
+        shape = tuple(int(s) for s in info["shape"])
+        start, end = (int(x) for x in info["data_offsets"])
+        nbytes = dt.itemsize * int(np.prod(shape)) if shape else dt.itemsize
+        if not (0 <= start <= end <= len(buf)) or end - start != nbytes:
+            raise PayloadError(f"bad offsets for tensor {name!r}")
+        out[name] = np.frombuffer(buf[start:end], dtype=dt).reshape(shape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Validated file IO (the transport layer calls these)
+# ---------------------------------------------------------------------------
+
+def save_file(tree: Params, path: str) -> None:
+    """Write a pytree to ``path``; format chosen by extension
+    (.safetensors or .msgpack)."""
+    data = to_safetensors(tree) if path.endswith(".safetensors") else to_msgpack(tree)
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # atomic publish; readers never see a torn file
+
+
+def load_file(path: str, template: Params | None = None,
+              *, max_bytes: int = DEFAULT_MAX_BYTES) -> Params:
+    size = os.path.getsize(path)
+    if size > max_bytes:
+        raise PayloadError(f"file {path} is {size} bytes, exceeds cap {max_bytes}")
+    with open(path, "rb") as f:
+        data = f.read()
+    if path.endswith(".safetensors"):
+        return from_safetensors(data, template, max_bytes=max_bytes)
+    return from_msgpack(data, template, max_bytes=max_bytes)
+
+
+def validated_load(data: bytes, template: Params, *, fmt: str = "msgpack",
+                   max_bytes: int = DEFAULT_MAX_BYTES,
+                   check_shapes: bool = True) -> Params:
+    """One-stop loader for untrusted peer bytes: parse, restore into the
+    template structure, and verify per-leaf shapes."""
+    from . import delta as _delta
+
+    loader = from_safetensors if fmt == "safetensors" else from_msgpack
+    tree = loader(data, template, max_bytes=max_bytes)
+    if check_shapes and not _delta.shapes_match(tree, template):
+        raise PayloadError("leaf shape mismatch against template")
+    return tree

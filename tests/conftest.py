@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip behavior (dp/fsdp/tp shardings, psum merges, ring attention) is
+tested without TPU hardware by splitting the host CPU into 8 XLA devices —
+the same technique the driver's dryrun uses. Must run before any JAX backend
+initialization; the axon sitecustomize force-selects the TPU platform via
+jax.config, so we override the config (env vars alone are not enough).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,124 @@
+"""Delta algebra: round-trip, screening, stacking, merge gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import delta
+
+
+def small_tree(seed=0, scale=1.0):
+    k = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(k, 3)
+    return {
+        "layer": {"kernel": jax.random.normal(k1, (4, 8)) * scale,
+                  "bias": jax.random.normal(k2, (8,)) * scale},
+        "head": jax.random.normal(k3, (8, 2)) * scale,
+    }
+
+
+def test_delta_roundtrip():
+    base = small_tree(0)
+    trained = small_tree(1)
+    d = delta.compute_delta(trained, base)
+    restored = delta.apply_delta(base, d)
+    for a, b in zip(jax.tree_util.tree_leaves(restored),
+                    jax.tree_util.tree_leaves(trained)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_nan_screen():
+    t = small_tree(0)
+    assert not delta.has_nonfinite(t)
+    t["head"] = t["head"].at[0, 0].set(jnp.nan)
+    assert delta.has_nonfinite(t)
+    t["head"] = t["head"].at[0, 0].set(jnp.inf)
+    assert delta.has_nonfinite(t)
+
+
+def test_shape_screen():
+    base = small_tree(0)
+    good = small_tree(1)
+    assert delta.shapes_match(good, base)
+    bad = dict(good)
+    bad["head"] = jnp.zeros((8, 3))
+    assert not delta.shapes_match(bad, base)
+    missing = {"layer": good["layer"]}
+    assert not delta.shapes_match(missing, base)
+
+
+def test_dtype_screen_catches_f64_wire_payload():
+    """jnp.asarray would downcast f64->f32 under x64-disabled JAX and make the
+    dtype check vacuous; screen must compare numpy-side (live-probe regression)."""
+    base = small_tree(0)
+    d64 = jax.tree_util.tree_map(lambda x: np.zeros(x.shape, np.float64), base)
+    ok, reason = delta.screen_delta(d64, base)
+    assert not ok and reason == "shape_mismatch"
+
+
+def test_screen_delta_magnitude():
+    base = small_tree(0)
+    d = delta.compute_delta(small_tree(1), base)
+    ok, reason = delta.screen_delta(d, base, max_abs=1e-6)
+    assert not ok and reason.startswith("magnitude_exceeded")
+    ok, reason = delta.screen_delta(d, base, max_abs=1e6)
+    assert ok
+
+
+def test_stack_and_weighted_merge():
+    base = small_tree(0)
+    deltas = [delta.compute_delta(small_tree(i), base) for i in range(1, 4)]
+    stacked = delta.stack_deltas(deltas)
+    assert jax.tree_util.tree_leaves(stacked)[0].shape[0] == 3
+
+    w = jnp.array([1.0, 0.0, 0.0])
+    merged = delta.weighted_merge(base, stacked, w)
+    expect = delta.apply_delta(base, deltas[0])
+    for a, b in zip(jax.tree_util.tree_leaves(merged),
+                    jax.tree_util.tree_leaves(expect)):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+    # uniform weights = plain average
+    w = jnp.full((3,), 1.0 / 3)
+    merged = delta.weighted_merge(base, stacked, w)
+    mean_delta = jax.tree_util.tree_map(
+        lambda *xs: sum(xs) / 3, *deltas)
+    expect = delta.apply_delta(base, mean_delta)
+    for a, b in zip(jax.tree_util.tree_leaves(merged),
+                    jax.tree_util.tree_leaves(expect)):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_merge_weight_gradient_matches_finite_difference():
+    """jax.grad through the merge must equal numeric meta-gradient — this is
+    the correctness core of the parameterized averager."""
+    base = small_tree(0)
+    deltas = [delta.compute_delta(small_tree(i), base) for i in range(1, 4)]
+    stacked = delta.stack_deltas(deltas)
+
+    def loss(w):
+        merged = delta.weighted_merge(base, stacked, w)
+        return sum(jnp.sum(l * l) for l in jax.tree_util.tree_leaves(merged))
+
+    w0 = jnp.array([0.3, 0.5, 0.2])
+    g = jax.grad(loss)(w0)
+    eps = 1e-3
+    for i in range(3):
+        wp = w0.at[i].add(eps)
+        wm = w0.at[i].add(-eps)
+        fd = (loss(wp) - loss(wm)) / (2 * eps)
+        np.testing.assert_allclose(g[i], fd, rtol=1e-2)
+
+
+def test_per_tensor_merge():
+    base = small_tree(0)
+    deltas = [delta.compute_delta(small_tree(i), base) for i in range(1, 3)]
+    stacked = delta.stack_deltas(deltas)
+    w = delta.init_merge_weights(base, 2, per_tensor=True)
+    merged = delta.per_tensor_weighted_merge(base, stacked, w)
+    mean_delta = jax.tree_util.tree_map(lambda *xs: sum(xs) / 2, *deltas)
+    expect = delta.apply_delta(base, mean_delta)
+    for a, b in zip(jax.tree_util.tree_leaves(merged),
+                    jax.tree_util.tree_leaves(expect)):
+        np.testing.assert_allclose(a, b, rtol=1e-5)

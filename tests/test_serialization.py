@@ -1,0 +1,90 @@
+"""Serialization: msgpack/safetensors round-trips and hostile-payload rejection."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import serialization as ser
+
+
+def tree(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {"a": {"w": jax.random.normal(k, (3, 5)), "b": jnp.arange(4.0)},
+            "c": jnp.ones((2, 2), jnp.bfloat16)}
+
+
+@pytest.mark.parametrize("fmt", ["msgpack", "safetensors"])
+def test_roundtrip(fmt):
+    t = tree()
+    if fmt == "msgpack":
+        data = ser.to_msgpack(t)
+        out = ser.from_msgpack(data, t)
+    else:
+        data = ser.to_safetensors(t)
+        out = ser.from_safetensors(data, t)
+    for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(t)):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+def test_file_roundtrip(tmp_path):
+    t = tree()
+    for name in ["x.msgpack", "x.safetensors"]:
+        p = str(tmp_path / name)
+        ser.save_file(t, p)
+        out = ser.load_file(p, t)
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(t)):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+
+def test_size_cap():
+    t = tree()
+    data = ser.to_msgpack(t)
+    with pytest.raises(ser.PayloadError):
+        ser.from_msgpack(data, t, max_bytes=10)
+
+
+def test_malformed_rejected():
+    with pytest.raises(ser.PayloadError):
+        ser.from_msgpack(b"\x00garbage\xff\xff", tree())
+
+
+def test_wrong_structure_rejected():
+    t = tree()
+    evil = {"totally": jnp.zeros((1,))}
+    data = ser.to_msgpack(evil)
+    with pytest.raises(ser.PayloadError):
+        ser.validated_load(data, t)
+
+
+def test_same_structure_wrong_leaf_shape_rejected():
+    """Right names, wrong-shaped tensor: must not broadcast through delta
+    arithmetic (review finding repro)."""
+    t = tree()
+    evil = jax.tree_util.tree_map(lambda x: x, t)
+    evil["a"]["w"] = jnp.zeros((1,), jnp.float32)
+    with pytest.raises(ser.PayloadError):
+        ser.from_msgpack(ser.to_msgpack(evil), t)
+    with pytest.raises(ser.PayloadError):
+        ser.from_safetensors(ser.to_safetensors(evil), t)
+
+
+def test_wrong_shape_rejected():
+    t = tree()
+    evil = jax.tree_util.tree_map(lambda x: jnp.zeros((7,) + x.shape, x.dtype), t)
+    data = ser.to_msgpack(evil)
+    with pytest.raises(ser.PayloadError):
+        ser.validated_load(data, t)
+
+
+def test_no_pickle_used():
+    """The wire format must never invoke pickle (reference RCE hole,
+    hf_manager.py:186-197)."""
+    import distributedtraining_tpu.serialization as m
+    import inspect
+    src = inspect.getsource(m)
+    assert "import pickle" not in src and "import torch" not in src
