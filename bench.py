@@ -1,0 +1,67 @@
+"""Headline benchmark: miner train-step throughput, GPT-2-124M, one chip.
+
+North-star metric per BASELINE.json: miner tokens/sec/chip for GPT-2-124M.
+The reference publishes no numbers (BASELINE.md) — `vs_baseline` is reported
+against the framework's own first recorded measurement (BENCH_r1), i.e. 1.0
+establishes the baseline in round 1.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 8
+SEQ = 1024
+WARMUP = 3
+ITERS = 20
+BASELINE_TOKENS_PER_SEC = None  # set from BENCH_r1 once recorded
+
+
+def main() -> None:
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.models import gpt2
+
+    model, cfg = gpt2.make_model("gpt2-124m")
+    engine = TrainEngine(model, seq_len=SEQ)
+    state = engine.init_state(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32),
+    }
+
+    for _ in range(WARMUP):
+        state, m = engine.train_step(state, batch)
+    float(m["loss"])  # full host sync — the axon backend's block_until_ready
+    # does not actually block, so timing must end on a value fetch
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, m = engine.train_step(state, batch)
+    final_loss = float(m["loss"])  # forces the whole dependency chain
+    dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "loss is NaN"
+
+    tokens_per_sec = BATCH * SEQ * ITERS / dt
+    vs = (tokens_per_sec / BASELINE_TOKENS_PER_SEC
+          if BASELINE_TOKENS_PER_SEC else 1.0)
+    print(json.dumps({
+        "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Chain layer: the framework's control plane.
+
+Two protocols mirror the reference's split:
+
+- ``AddressStore`` — hotkey -> artifact-repo mapping via chain commitments
+  (hivetrain/chain_manager.py)
+- ``Network`` — identity, metagraph sync, score EMA + weight emission,
+  validator selection, anomaly detection, rate limiting
+  (hivetrain/btt_connector.py)
+
+``LocalChain`` is the JSON-file simulator (the reference's
+LocalBittensorNetwork + LocalAddressStore, btt_connector.py:530-671,
+chain_manager.py:124-168); ``bittensor_chain`` holds the real substrate
+implementation, import-gated so the framework never needs the bittensor SDK
+to function.
+"""
+
+from .base import AddressStore, Metagraph, Network
+from .local import LocalAddressStore, LocalChain
+
+__all__ = ["AddressStore", "Metagraph", "Network",
+           "LocalAddressStore", "LocalChain"]

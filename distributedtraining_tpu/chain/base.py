@@ -1,0 +1,102 @@
+"""Chain protocols + shared weight-processing math.
+
+The score path reproduces BittensorNetwork.set_weights
+(btt_connector.py:310-356): EMA smoothing (alpha=1/3), normalization, uint16
+quantization for emission. The math lives here as pure functions so both the
+local simulator and the real chain share one implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+EMA_ALPHA = 1.0 / 3.0  # btt_connector.py:317-318
+U16_MAX = 65535
+
+
+@dataclasses.dataclass
+class Metagraph:
+    """Snapshot of subnet membership."""
+    hotkeys: list[str]
+    uids: list[int]
+    stakes: list[float]
+    block: int
+
+    def uid_of(self, hotkey: str) -> int | None:
+        try:
+            return self.uids[self.hotkeys.index(hotkey)]
+        except ValueError:
+            return None
+
+
+class AddressStore(Protocol):
+    """hotkey -> artifact repo id (chain commitments, chain_manager.py:57-115)."""
+
+    def store_repo(self, hotkey: str, repo_id: str) -> None: ...
+    def retrieve_repo(self, hotkey: str) -> Optional[str]: ...
+
+
+class Network(Protocol):
+    """Subnet membership + score emission (btt_connector.py:264-506)."""
+
+    @property
+    def my_hotkey(self) -> str: ...
+
+    def sync(self) -> Metagraph: ...
+    def current_block(self) -> int: ...
+    def set_weights(self, scores: dict[str, float]) -> bool: ...
+    def should_set_weights(self) -> bool: ...
+    def get_validator_uids(self, stake_limit: float = 1000.0) -> list[int]: ...
+
+
+# ---------------------------------------------------------------------------
+# Pure score-processing math (shared by all Network impls)
+# ---------------------------------------------------------------------------
+
+def ema_update(prev: dict[str, float], new: dict[str, float],
+               alpha: float = EMA_ALPHA) -> dict[str, float]:
+    """score <- alpha*new + (1-alpha)*prev per hotkey (btt_connector.py:315-321)."""
+    out = dict(prev)
+    for k, v in new.items():
+        out[k] = alpha * v + (1 - alpha) * out.get(k, 0.0)
+    return out
+
+
+def normalize_scores(scores: dict[str, float]) -> dict[str, float]:
+    total = sum(max(v, 0.0) for v in scores.values())
+    if total <= 0:
+        return {k: 0.0 for k in scores}
+    return {k: max(v, 0.0) / total for k, v in scores.items()}
+
+
+def quantize_u16(weights: Sequence[float]) -> list[int]:
+    """Normalized float weights -> uint16 emission values
+    (convert_weights_and_uids_for_emit, btt_connector.py:339-345)."""
+    w = np.asarray(list(weights), dtype=np.float64)
+    if w.size == 0 or w.max() <= 0:
+        return [0] * w.size
+    return [int(round(x)) for x in (w / w.max()) * U16_MAX]
+
+
+def mad_anomaly_mask(values: Sequence[float], *, threshold: float = 3.5
+                     ) -> list[bool]:
+    """Median-absolute-deviation outlier flags (True = anomalous) —
+    the reference's cheater detection (detect_metric_anomaly,
+    btt_connector.py:388-426) using the modified z-score."""
+    v = np.asarray(list(values), dtype=np.float64)
+    if v.size < 3:
+        return [False] * v.size
+    med = np.median(v)
+    mad = np.median(np.abs(v - med))
+    if mad == 0:
+        # degenerate spread (e.g. several tied scores): fall back to a ratio
+        # test so a merely-better value is not flagged, only wildly
+        # disproportionate ones (5x the median)
+        if med <= 0:
+            return [False] * v.size
+        return [bool(x > 5.0 * med) for x in v]
+    mz = 0.6745 * (v - med) / mad
+    return [bool(abs(z) > threshold) for z in mz]

@@ -1,0 +1,121 @@
+"""Real substrate-chain backend (import-gated).
+
+Production counterpart of chain/local.py, implementing the Network and
+AddressStore protocols over the Bittensor SDK — the reference's
+BittensorNetwork facade (btt_connector.py:264-506) and
+ChainMultiAddressStore (chain_manager.py:57-115) rebuilt without the
+import-time side effects (training_manager.py:22-24 parses argv and opens
+wallets at import; here everything happens in __init__).
+
+Every chain RPC runs through ``run_with_timeout`` (utils/timeout.py), the
+reference's fork-with-60s-TTL hygiene (chain_manager.py:22-54) without the
+fork: chain ops run on a worker thread with a deadline, and a hung substrate
+connection surfaces as ChainTimeout instead of wedging the engine loop.
+
+The bittensor SDK is not part of this environment; the module raises a clear
+RuntimeError at construction when it is absent, and the whole framework
+operates on the Local*/InMemory twins instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import spec_version
+from ..utils.timeout import ChainTimeout, run_with_timeout
+from .base import EMA_ALPHA, Metagraph, ema_update, normalize_scores, quantize_u16
+
+CHAIN_OP_TIMEOUT = 60.0  # chain_manager.py:68,86,105
+
+
+def _require_bittensor():
+    try:
+        import bittensor  # noqa: F401
+        return bittensor
+    except ImportError as e:  # pragma: no cover — SDK absent in this image
+        raise RuntimeError(
+            "bittensor SDK not installed; use chain.LocalChain / "
+            "chain.LocalAddressStore for offline operation") from e
+
+
+class BittensorAddressStore:
+    """Chain commitments as the hotkey -> repo registry."""
+
+    def __init__(self, subtensor, netuid: int, wallet=None):
+        self.subtensor = subtensor
+        self.netuid = netuid
+        self.wallet = wallet
+
+    def store_repo(self, hotkey: str, repo_id: str) -> None:
+        def op():
+            self.subtensor.commit(self.wallet, self.netuid, repo_id)
+        run_with_timeout(op, CHAIN_OP_TIMEOUT, name="store_repo")
+
+    def retrieve_repo(self, hotkey: str) -> Optional[str]:
+        def op():
+            meta = self.subtensor.get_commitment(self.netuid, hotkey)
+            return meta or None
+        try:
+            return run_with_timeout(op, CHAIN_OP_TIMEOUT, name="retrieve_repo")
+        except ChainTimeout:
+            return None
+
+
+class BittensorChain:
+    """Network impl over a live subtensor."""
+
+    def __init__(self, *, netuid: int, wallet_name: str, wallet_hotkey: str,
+                 network: str = "finney", epoch_length: int = 100):
+        bt = _require_bittensor()
+        self.bt = bt
+        self.netuid = netuid
+        self.epoch_length = epoch_length
+        self.wallet = bt.wallet(name=wallet_name, hotkey=wallet_hotkey)
+        self.subtensor = bt.subtensor(network=network)
+        self.metagraph = self.subtensor.metagraph(netuid)
+        self._ema: dict[str, float] = {}
+        self._last_weight_block = -(10**9)
+        if self.wallet.hotkey.ss58_address not in self.metagraph.hotkeys:
+            raise RuntimeError(
+                f"hotkey not registered on netuid {netuid}")  # :302-307
+
+    @property
+    def my_hotkey(self) -> str:
+        return self.wallet.hotkey.ss58_address
+
+    def sync(self) -> Metagraph:
+        def op():
+            self.metagraph.sync(subtensor=self.subtensor, lite=True)
+            return self.metagraph
+        m = run_with_timeout(op, CHAIN_OP_TIMEOUT, name="metagraph_sync")
+        return Metagraph(hotkeys=list(m.hotkeys), uids=list(range(len(m.hotkeys))),
+                         stakes=[float(s) for s in m.S],
+                         block=self.current_block())
+
+    def current_block(self) -> int:
+        return int(run_with_timeout(lambda: self.subtensor.block,
+                                    CHAIN_OP_TIMEOUT, name="block"))
+
+    def should_set_weights(self) -> bool:
+        return (self.current_block() - self._last_weight_block) >= self.epoch_length
+
+    def get_validator_uids(self, stake_limit: float = 1000.0) -> list[int]:
+        m = self.metagraph
+        return [i for i, s in enumerate(m.S) if float(s) >= stake_limit]
+
+    def set_weights(self, scores: dict[str, float]) -> bool:
+        self._ema = ema_update(self._ema, scores, EMA_ALPHA)
+        norm = normalize_scores(self._ema)
+        hotkeys = list(self.metagraph.hotkeys)
+        uids = [i for i, h in enumerate(hotkeys) if h in norm]
+        weights = quantize_u16([norm[hotkeys[u]] for u in uids])
+
+        def op():
+            return self.subtensor.set_weights(
+                wallet=self.wallet, netuid=self.netuid, uids=uids,
+                weights=weights, version_key=spec_version(),
+                wait_for_inclusion=False)
+        ok = bool(run_with_timeout(op, CHAIN_OP_TIMEOUT, name="set_weights"))
+        if ok:
+            self._last_weight_block = self.current_block()
+        return ok

@@ -1,0 +1,15 @@
+"""Data pipeline: tokenization, sequence packing, batch iterators.
+
+The reference tokenizes per-item and pads every example to max_length
+(64 tokens for the miner, neurons/miner.py:70; 512 for the validator,
+neurons/validator.py:63) — on wikitext that wastes most of the batch on pad.
+Here documents are packed end-to-end into fixed-shape rows with segment ids
+and per-segment positions, so every MXU cycle sees real tokens and XLA gets
+fully static shapes.
+"""
+
+from .packing import pack_documents, PackedBatch
+from .datasets import ByteTokenizer, load_tokenizer, text_corpus, batch_iterator
+
+__all__ = ["pack_documents", "PackedBatch", "ByteTokenizer", "load_tokenizer",
+           "text_corpus", "batch_iterator"]

@@ -1,0 +1,117 @@
+"""Tokenizers and corpora, zero-egress friendly.
+
+The reference streams wikitext-103 via HF datasets and tokenizes with the
+GPT-2 tokenizer (neurons/miner.py:54-106). Both are available here when the
+HF cache is warm; when the environment has no network and no cache, a
+byte-level tokenizer plus a deterministic synthetic corpus keep every code
+path exercisable (training still *learns* on it — it has real n-gram
+structure).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from .packing import pack_documents
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + 1 offset; id 0 is reserved as pad. vocab_size 257."""
+
+    pad_id = 0
+    vocab_size = 257
+
+    def encode(self, text: str) -> list[int]:
+        return [b + 1 for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(max(i - 1, 0) for i in ids if i != 0).decode(
+            "utf-8", errors="replace")
+
+
+def load_tokenizer(name: str = "gpt2"):
+    """HF tokenizer when importable+cached; ByteTokenizer otherwise."""
+    try:
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(name, local_files_only=True)
+
+        class _Wrap:
+            vocab_size = len(tok)
+            pad_id = tok.pad_token_id or 0
+
+            def encode(self, text):
+                return tok.encode(text)
+
+            def decode(self, ids):
+                return tok.decode(ids)
+
+        return _Wrap()
+    except Exception:
+        return ByteTokenizer()
+
+
+_WORDS = ("the of and to in is was for on that with as by at from it an be "
+          "this are or his which their has had were been its not they but "
+          "one all can more when time state also two first new only world "
+          "year over system model train data loss weight merge chain score "
+          "miner validator average delta network").split()
+
+
+def text_corpus(*, split: str = "train", n_docs: int = 256,
+                seed: int = 0, source: str = "auto") -> list[str]:
+    """Document list. source="wikitext" forces HF wikitext-103 (needs cache);
+    "synthetic" forces the offline corpus; "auto" tries wikitext then falls
+    back."""
+    if source in ("auto", "wikitext"):
+        try:
+            from datasets import load_dataset
+            ds = load_dataset("wikitext", "wikitext-103-v1", split=split,
+                              download_mode="reuse_cache_if_exists")
+            texts = [t for t in ds["text"][: n_docs * 4] if t.strip()]
+            if texts:
+                return texts[:n_docs]
+        except Exception:
+            if source == "wikitext":
+                raise
+    # synthetic: markov-ish word stream, deterministic per (split, seed)
+    h = int(hashlib.sha256(f"{split}:{seed}".encode()).hexdigest()[:8], 16)
+    rng = np.random.default_rng(h)
+    docs = []
+    for _ in range(n_docs):
+        n = int(rng.integers(20, 200))
+        idx = rng.integers(0, len(_WORDS), size=n)
+        # simple bigram bias: repeat previous word sometimes for structure
+        words = [_WORDS[i] for i in idx]
+        for j in range(1, n):
+            if rng.random() < 0.15:
+                words[j] = words[j - 1]
+        docs.append(" ".join(words) + ".")
+    return docs
+
+
+def batch_iterator(docs: Iterable[str], tokenizer, *, batch_size: int,
+                   seq_len: int, repeat: bool = False,
+                   max_vocab: int | None = None) -> Iterator[dict]:
+    """Tokenize -> pack -> batch. Yields dicts of [B, T] numpy arrays ready
+    for TrainEngine.place_batch."""
+    docs = list(docs)  # materialize: a one-shot iterator + repeat=True would
+    # otherwise busy-loop forever on the exhausted iterator
+
+    def rows():
+        while True:
+            token_docs = (tokenizer.encode(d) for d in docs)
+            if max_vocab is not None:
+                token_docs = ([t % max_vocab for t in d] for d in token_docs)
+            yield from pack_documents(token_docs, seq_len)
+            if not repeat:
+                return
+
+    buf = []
+    for row in rows():
+        buf.append(row)
+        if len(buf) == batch_size:
+            yield {k: np.stack([r[k] for r in buf]) for k in buf[0]}
+            buf = []

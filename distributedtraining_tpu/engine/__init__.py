@@ -1,0 +1,25 @@
+"""Role engines: train (miner), validate (validator), average (averager).
+
+Each engine is a thin stateful loop around jitted pure step functions;
+network/chain access goes exclusively through the Transport and Chain
+protocols (transport/, chain/), so every engine runs identically against the
+in-memory, local-filesystem, and real backends — the reference's Local*-twin
+pattern made first-class (SURVEY.md §4).
+"""
+
+from .scheduler import Clock, RealClock, FakeClock, PeriodicAction
+from .train import TrainEngine, MinerLoop, TrainState
+from .validate import Validator
+from .average import (
+    AveragerLoop,
+    GeneticMerge,
+    ParameterizedMerge,
+    WeightedAverage,
+)
+
+__all__ = [
+    "Clock", "RealClock", "FakeClock", "PeriodicAction",
+    "TrainEngine", "MinerLoop", "TrainState",
+    "Validator",
+    "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
+]

@@ -1,0 +1,67 @@
+"""Clocks and periodic actions.
+
+The reference interleaves wall-clock interval checks directly into its hot
+loops (``time.time() - last_pull > check_update_interval`` at
+training_manager.py:361-378, 405-427; ``time.sleep`` loops at
+validation_logic.py:191-196, averaging_logic.py:544-583). Here the same
+cadences are expressed against a Clock protocol so tests drive them with a
+FakeClock in microseconds instead of real seconds.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Protocol
+
+
+class Clock(Protocol):
+    def now(self) -> float: ...
+    def sleep(self, seconds: float) -> None: ...
+
+
+class RealClock:
+    def now(self) -> float:
+        return time.time()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+
+class FakeClock:
+    """Deterministic test clock; sleep() advances it instantly."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = start
+
+    def now(self) -> float:
+        return self._t
+
+    def sleep(self, seconds: float) -> None:
+        self._t += seconds
+
+    def advance(self, seconds: float) -> None:
+        self._t += seconds
+
+
+class PeriodicAction:
+    """Fire ``fn`` at most once per ``interval`` seconds, polled in-loop.
+
+    ``fire_immediately`` controls whether the first poll fires (the miner's
+    push timer starts counting from loop start — training_manager.py:358 —
+    while its pull check fires on the first batch).
+    """
+
+    def __init__(self, interval: float, fn: Callable[[], None], clock: Clock,
+                 *, fire_immediately: bool = False):
+        self.interval = interval
+        self.fn = fn
+        self.clock = clock
+        self.last_fired = float("-inf") if fire_immediately else clock.now()
+
+    def poll(self) -> bool:
+        now = self.clock.now()
+        if now - self.last_fired >= self.interval:
+            self.last_fired = now
+            self.fn()
+            return True
+        return False

@@ -1,0 +1,273 @@
+"""Train engine + miner loop.
+
+TPU rebuild of the reference miner (TrainingLoop/DeltaLoop,
+hivetrain/training_manager.py:28-168, 345-433):
+
+- the train step is one jitted pure function
+  ``(state, batch) -> (state, metrics)`` with donated state — params,
+  optimizer update, and loss live on device; nothing crosses the host
+  boundary per step except scalar metrics
+- sharding-aware: given a Mesh, params/opt-state are placed by the logical
+  rules (parallel/sharding.py) and the same step function runs dp/fsdp/tp
+  without code changes (the reference is single-device only)
+- the outer loop reproduces the reference's cadences: poll for a new base
+  model every ``check_update_interval`` (ref :361-378), push the weight delta
+  every ``send_interval`` seconds (ref :405-427), and — deliberately —
+  reinitialize optimizer state on every base update (ref :371-377; this
+  affects training dynamics and is part of the protocol's semantics)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from .. import delta as delta_lib
+from ..ops.losses import causal_lm_loss
+from ..parallel.sharding import batch_sharding, mesh_shardings, opt_state_shardings
+from .scheduler import Clock, PeriodicAction, RealClock
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Params
+    opt_state: Any
+
+
+def default_optimizer(learning_rate: float = 5e-4,
+                      *, grad_clip: float | None = None,
+                      weight_decay: float = 0.01) -> optax.GradientTransformation:
+    """AdamW @ 5e-4, the reference's operating point (neurons/miner.py:121-128).
+    Gradient clipping is off by default for parity (the reference has none in
+    its live path) but first-class because real runs want it."""
+    tx = optax.adamw(learning_rate, weight_decay=weight_decay)
+    if grad_clip is not None:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    return tx
+
+
+class TrainEngine:
+    """Owns the jitted step functions for one model + optimizer."""
+
+    def __init__(self, model, *, optimizer: optax.GradientTransformation | None = None,
+                 mesh=None, seq_len: int = 8):
+        self.model = model
+        self.tx = optimizer or default_optimizer()
+        self.mesh = mesh
+        self._param_shardings = None
+        self._batch_sharding = None
+        if mesh is not None:
+            self._param_shardings = mesh_shardings(model, mesh, seq_len=seq_len)
+            seq_parallel = mesh.shape.get("sp", 1) > 1
+            self._batch_sharding = batch_sharding(mesh,
+                                                  seq_sharded=seq_parallel)
+            if seq_parallel:
+                # route impl="ring" attention onto this mesh's sp axis
+                from ..ops.ring_attention import set_ring_mesh
+                set_ring_mesh(mesh)
+
+        def loss_fn(params, batch):
+            logits = model.apply(
+                {"params": params}, batch["input_ids"],
+                attention_mask=batch.get("attention_mask"),
+                segment_ids=batch.get("segment_ids"),
+                position_ids=batch.get("position_ids"))
+            return causal_lm_loss(logits, batch["input_ids"],
+                                  batch.get("loss_mask"))
+
+        def train_step(state: TrainState, batch):
+            (loss, tokens), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, batch), has_aux=True)(state.params)
+            updates, opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(step=state.step + 1, params=params,
+                                   opt_state=opt_state)
+            return new_state, {"loss": loss, "tokens": tokens}
+
+        def eval_step(params, batch):
+            loss, tokens = loss_fn(params, batch)
+            return loss * tokens, tokens  # weighted for exact aggregation
+
+        self.train_step = jax.jit(train_step, donate_argnums=(0,))
+        self.eval_step = jax.jit(eval_step)
+
+    # -- state management ---------------------------------------------------
+    def init_state(self, rng: jax.Array | None = None,
+                   params: Params | None = None) -> TrainState:
+        """Fresh optimizer around given or newly initialized params."""
+        if params is None:
+            params = self.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
+        # independent copy: train_step donates the state, and donated buffers
+        # must never alias a tree the caller still holds (base snapshots,
+        # validator bases) or those arrays get deleted underneath them
+        params = jax.tree_util.tree_map(lambda x: x.copy(),
+                                        self.place_params(params))
+        opt_state = jax.jit(self.tx.init)(params) if self.mesh is None \
+            else self._sharded_opt_init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state)
+
+    def place_params(self, params: Params) -> Params:
+        if self._param_shardings is None:
+            return jax.tree_util.tree_map(jnp.asarray, params)
+        return jax.tree_util.tree_map(jax.device_put, params,
+                                      self._param_shardings)
+
+    def _sharded_opt_init(self, params):
+        abstract = jax.eval_shape(self.tx.init, params)
+        shardings = opt_state_shardings(abstract, self._param_shardings,
+                                        self.mesh)
+        return jax.jit(self.tx.init, out_shardings=shardings)(params)
+
+    def place_batch(self, batch: dict) -> dict:
+        if self._batch_sharding is None:
+            return batch
+        return {k: jax.device_put(v, self._batch_sharding)
+                for k, v in batch.items()}
+
+    # -- eval ---------------------------------------------------------------
+    def evaluate(self, params: Params, batches: Iterable[dict]
+                 ) -> tuple[float, float]:
+        """(mean loss, perplexity) over an eval set — exact token-weighted
+        aggregation across batches (ModelValidator.evaluate_model parity,
+        validation_logic.py:78-97)."""
+        total, count = 0.0, 0.0
+        for batch in batches:
+            l, c = self.eval_step(params, self.place_batch(batch))
+            total += float(l)
+            count += float(c)
+        if count == 0:
+            return float("nan"), float("nan")
+        mean = total / count
+        return mean, float(jnp.exp(mean))
+
+
+def _snapshot(params: Params) -> Params:
+    """Independent copy of a param tree. The train step donates its input
+    state (in-place buffer reuse on TPU), so the miner's base snapshot must
+    not alias live training params or its buffers get deleted underneath it
+    (training_manager.py:349-351 does this with .clone())."""
+    return jax.tree_util.tree_map(lambda x: x.copy(), params)
+
+
+@dataclasses.dataclass
+class MinerReport:
+    steps: int = 0
+    pushes: int = 0
+    base_pulls: int = 0
+    last_loss: float = float("nan")
+
+
+class MinerLoop:
+    """The reference's DeltaLoop (training_manager.py:345-433), structured
+    around injected Transport/Clock instead of globals."""
+
+    def __init__(self, engine: TrainEngine, transport, miner_id: str, *,
+                 clock: Clock | None = None,
+                 send_interval: float = 800.0,        # neurons/miner.py:125
+                 check_update_interval: float = 300.0,
+                 metrics=None,
+                 log_every: int = 1000,               # ref :394-402
+                 nan_guard: bool = True):
+        self.engine = engine
+        self.transport = transport
+        self.miner_id = miner_id
+        self.clock = clock or RealClock()
+        self.metrics = metrics
+        self.log_every = log_every
+        self.nan_guard = nan_guard
+        self.report = MinerReport()
+
+        self.state: TrainState | None = None
+        self.base_params: Params | None = None
+        self._base_revision = None
+        self._last_base_time = self.clock.now()
+
+        self._pull_action = PeriodicAction(check_update_interval,
+                                           self._check_pull, self.clock)
+        self._push_action = PeriodicAction(send_interval, self._push_delta,
+                                           self.clock)
+
+    # -- base model lifecycle ----------------------------------------------
+    def bootstrap(self, rng: jax.Array | None = None) -> None:
+        """Pull the published base if one exists, else self-initialize."""
+        fetched = None
+        template = self.engine.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
+        if self.transport.base_revision() is not None:
+            fetched = self.transport.fetch_base(template)
+        if fetched is not None:
+            params, rev = fetched
+            self._base_revision = rev
+            self.state = self.engine.init_state(params=params)
+        else:
+            self.state = self.engine.init_state(params=template)
+        self.base_params = _snapshot(self.state.params)
+
+    def _check_pull(self) -> None:
+        rev = self.transport.base_revision()
+        if rev is None or rev == self._base_revision:
+            return
+        fetched = self.transport.fetch_base(self.base_params)
+        if fetched is None:
+            return
+        params, rev = fetched
+        logger.info("miner %s: new base model %s — resetting optimizer",
+                    self.miner_id, rev and rev[:8])
+        # protocol semantics: optimizer state is discarded on base update
+        # (training_manager.py:371-377)
+        self.state = self.engine.init_state(params=params)
+        self.base_params = _snapshot(self.state.params)
+        self._base_revision = rev
+        self._last_base_time = self.clock.now()
+        self.report.base_pulls += 1
+
+    def _push_delta(self) -> None:
+        if self.state is None:
+            return
+        d = delta_lib.compute_delta(self.state.params, self.base_params)
+        if self.nan_guard and delta_lib.has_nonfinite(d):
+            logger.warning("miner %s: delta has non-finite values, not pushing",
+                           self.miner_id)
+            return
+        try:
+            self.transport.publish_delta(self.miner_id, d)
+            self.report.pushes += 1
+        except Exception:  # push failures must not kill training (ref :410-431)
+            logger.exception("miner %s: delta push failed", self.miner_id)
+
+    # -- the loop -----------------------------------------------------------
+    def run(self, batches: Iterable[dict], *, max_steps: int | None = None
+            ) -> MinerReport:
+        if self.state is None:
+            self.bootstrap()
+        start_steps = self.report.steps  # max_steps bounds *this* call
+        for batch in batches:
+            if max_steps is not None and self.report.steps - start_steps >= max_steps:
+                break
+            self._pull_action.poll()
+            self.state, m = self.engine.train_step(
+                self.state, self.engine.place_batch(batch))
+            self.report.steps += 1
+            self.report.last_loss = float(m["loss"])
+            if self.metrics and self.report.steps % self.log_every == 0:
+                self.metrics.log(
+                    {"train_loss": self.report.last_loss,
+                     "staleness_s": self.clock.now() - self._last_base_time},
+                    step=self.report.steps)
+            self._push_action.poll()
+        return self.report
+
+    def flush(self) -> None:
+        """Force a delta push now (end-of-run)."""
+        self._push_delta()

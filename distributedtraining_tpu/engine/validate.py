@@ -1,0 +1,141 @@
+"""Validator engine.
+
+Rebuild of ModelValidator/DeltaValidator (hivetrain/validation_logic.py):
+score every miner's delta by measured loss/perplexity improvement over the
+current base on a held-out shard, normalize, emit to the chain.
+
+The functional core removes the reference's most fragile machinery: where it
+deep-copies the model state, mutates it per miner, and restores it afterwards
+(validation_logic.py:123-139), here scoring is just
+``evaluate(apply_delta(base, d))`` — base params are never mutated, so there
+is nothing to restore and a crash mid-round cannot corrupt the model.
+
+Scoring rule parity (validation_logic.py:136-166):
+  score = max(0, base_loss - new_loss)   [loss mode]
+  score = max(0, base_ppl - new_ppl)     [perplexity mode]
+  missing/invalid delta -> 0
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Iterable, Optional
+
+import jax
+
+from .. import delta as delta_lib
+from .scheduler import Clock, RealClock
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+
+@dataclasses.dataclass
+class MinerScore:
+    hotkey: str
+    score: float
+    loss: float | None = None
+    perplexity: float | None = None
+    reason: str = "ok"
+
+
+class Validator:
+    def __init__(self, engine, transport, chain, *,
+                 eval_batches: Callable[[], Iterable[dict]],
+                 metric: str = "loss",          # "loss" | "perplexity"
+                 max_delta_abs: float | None = 1e3,
+                 clock: Clock | None = None,
+                 metrics=None):
+        self.engine = engine
+        self.transport = transport
+        self.chain = chain
+        self.eval_batches = eval_batches
+        self.metric = metric
+        self.max_delta_abs = max_delta_abs
+        self.clock = clock or RealClock()
+        self.metrics = metrics
+
+        self.base_params: Params | None = None
+        self._base_revision = None
+        self.base_loss: float | None = None
+        self.base_ppl: float | None = None
+
+    # -- base model ---------------------------------------------------------
+    def bootstrap(self, rng=None) -> None:
+        template = self.engine.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
+        fetched = self.transport.fetch_base(template) \
+            if self.transport.base_revision() is not None else None
+        if fetched is not None:
+            self.base_params, self._base_revision = fetched
+            self.base_params = self.engine.place_params(self.base_params)
+        else:
+            self.base_params = self.engine.place_params(template)
+        self._eval_base()
+
+    def _eval_base(self) -> None:
+        # full eval pass at startup/base-change (validation_logic.py:48)
+        self.base_loss, self.base_ppl = self.engine.evaluate(
+            self.base_params, self.eval_batches())
+        logger.info("validator: base loss=%.4f ppl=%.2f",
+                    self.base_loss, self.base_ppl)
+
+    def _maybe_refresh_base(self) -> None:
+        rev = self.transport.base_revision()
+        if rev is None or rev == self._base_revision:
+            return
+        fetched = self.transport.fetch_base(self.base_params)
+        if fetched is None:
+            return
+        self.base_params = self.engine.place_params(fetched[0])
+        self._base_revision = fetched[1]
+        self._eval_base()
+
+    # -- scoring ------------------------------------------------------------
+    def score_miner(self, hotkey: str) -> MinerScore:
+        d = self.transport.fetch_delta(hotkey, self.base_params)
+        if d is None:
+            return MinerScore(hotkey, 0.0, reason="no_delta")
+        ok, reason = delta_lib.screen_delta(d, self.base_params,
+                                            max_abs=self.max_delta_abs)
+        if not ok:
+            return MinerScore(hotkey, 0.0, reason=reason)
+        candidate = delta_lib.apply_delta(self.base_params, d)
+        loss, ppl = self.engine.evaluate(candidate, self.eval_batches())
+        if self.metric == "perplexity":
+            score = max(0.0, (self.base_ppl or 0.0) - ppl)
+        else:
+            score = max(0.0, (self.base_loss or 0.0) - loss)
+        return MinerScore(hotkey, score, loss=loss, perplexity=ppl)
+
+    def validate_and_score(self) -> list[MinerScore]:
+        """One validation round (validate_and_score,
+        validation_logic.py:99-189)."""
+        meta = self.chain.sync()
+        self._maybe_refresh_base()
+        results: list[MinerScore] = []
+        for hotkey in meta.hotkeys:
+            if hotkey == self.chain.my_hotkey:
+                continue
+            s = self.score_miner(hotkey)
+            results.append(s)
+            if self.metrics:
+                self.metrics.log({f"loss_{s.hotkey}": s.loss,
+                                  f"score_{s.hotkey}": s.score})
+        scored = {s.hotkey: s.score for s in results}
+        if self.chain.should_set_weights():
+            self.chain.set_weights(scored)  # EMA+normalize inside the chain
+        return results
+
+    def run_periodic(self, *, interval: float = 1800.0,   # neurons/validator.py:112
+                     rounds: int | None = None) -> None:
+        done = 0
+        while rounds is None or done < rounds:
+            try:
+                self.validate_and_score()
+            except Exception:
+                logger.exception("validation round failed; continuing")
+            done += 1
+            if rounds is None or done < rounds:
+                self.clock.sleep(interval)

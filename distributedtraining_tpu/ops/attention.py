@@ -86,9 +86,16 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Causal self-attention entry point used by the models.
 
     impl: "dense" (XLA), "flash" (Pallas kernel when available, falls back to
-    dense on non-TPU backends).
+    dense on non-TPU backends), "ring" (sequence-parallel over the sp mesh
+    axis; needs set_ring_mesh and unmasked/unpacked inputs).
     """
     B, T, H, D = q.shape
+    if impl == "ring" and attention_mask is None and segment_ids is None:
+        from . import ring_attention as ring
+        mesh, _ = ring.get_ring_mesh()
+        if mesh is not None:
+            return ring.ring_attention(q, k, v)
+        # no mesh installed -> dense fallback below
     if impl == "flash":
         from . import flash_attention
         out = flash_attention.flash_attention(

@@ -1,0 +1,23 @@
+"""Mesh + sharding: how the framework scales.
+
+The reference has no intra-node parallelism at all (one torch device per
+role, SURVEY.md §2.2). Here scaling is a mesh-configuration change, not a
+code change: every engine jits pure step functions whose params/optimizer
+shardings come from logical-axis rules resolved against a
+``jax.sharding.Mesh`` with axes (dp, fsdp, sp, tp).
+"""
+
+from .mesh import MeshConfig, make_mesh, best_mesh_shape
+from .sharding import (
+    DEFAULT_RULES,
+    logical_param_specs,
+    mesh_shardings,
+    shard_batch_spec,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig", "make_mesh", "best_mesh_shape",
+    "DEFAULT_RULES", "logical_param_specs", "mesh_shardings",
+    "shard_batch_spec", "shard_params",
+]

@@ -1,0 +1,82 @@
+"""Explicit-collective merge paths (shard_map over ICI).
+
+BASELINE.json config 3 calls for the averager's weighted merge to run as an
+ICI all-reduce over pod chips instead of host tensor arithmetic. The pattern:
+each device holds a shard of miners' deltas along the stacked miner axis,
+computes its local weighted partial sum, and one ``psum`` over the mesh axis
+produces the merged model on every device — the classic
+partial-sum/all-reduce recipe from the scaling book.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8 top-level API, experimental path as fallback
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+Params = Any
+
+
+def shard_stacked_deltas(stacked: Params, mesh: Mesh, axis: str = "dp") -> Params:
+    """Place a [M, ...]-leaved stacked-delta tree with the miner axis sharded
+    over ``axis``. M must divide the axis size evenly (pad with zero-deltas
+    and zero weights otherwise)."""
+    def place(x):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, stacked)
+
+
+def pad_miner_axis(stacked: Params, weights: jax.Array, multiple: int
+                   ) -> tuple[Params, jax.Array]:
+    """Pad M up to a multiple of the mesh axis with zero deltas + zero
+    weights so sharding divides evenly; padding contributes nothing."""
+    m = weights.shape[0]
+    target = ((m + multiple - 1) // multiple) * multiple
+    if target == m:
+        return stacked, weights
+    pad = target - m
+
+    def pad_leaf(x):
+        return jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+
+    return (jax.tree_util.tree_map(pad_leaf, stacked),
+            jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)]))
+
+
+def psum_weighted_merge(base: Params, stacked: Params, weights: jax.Array,
+                        mesh: Mesh, *, axis: str = "dp") -> Params:
+    """merged = base + sum_i w_i * delta_i, with the sum over the miner axis
+    executed as local partial sums + one ICI all-reduce.
+
+    ``stacked``/``weights`` may live on host or be pre-sharded; they are
+    placed with the miner axis over ``axis``. Result is replicated.
+    """
+    axis_size = mesh.shape[axis]
+    stacked, weights = pad_miner_axis(stacked, weights, axis_size)
+
+    in_specs = (
+        P(),                                     # base replicated
+        jax.tree_util.tree_map(
+            lambda x: P(axis, *([None] * (x.ndim - 1))), stacked),
+        P(axis),
+    )
+
+    def local_merge(b_tree, d_tree, w):
+        def leaf(b, d):
+            wv = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
+            partial = jnp.sum(wv * d, axis=0)
+            return b + jax.lax.psum(partial, axis)
+        return jax.tree_util.tree_map(leaf, b_tree, d_tree)
+
+    fn = _shard_map(local_merge, mesh=mesh, in_specs=in_specs, out_specs=P())
+    return fn(base, stacked, weights)

@@ -1,0 +1,88 @@
+"""Device-mesh construction.
+
+Axes (any may be 1 and collapse away):
+- dp:   pure data parallel (replicated params, sharded batch)
+- fsdp: data parallel with parameter sharding (ZeRO-3-like, free via pjit)
+- sp:   sequence/context parallel (ring attention over ICI)
+- tp:   tensor parallel (vocab/mlp/heads sharded)
+
+On TPU, ``mesh_utils.create_device_mesh`` lays the mesh out so the innermost
+axes ride the fastest ICI links; tp should be innermost, dp outermost
+(jax-ml.github.io/scaling-book recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.fsdp, self.sp, self.tp)
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.shape)
+
+
+def make_mesh(cfg: MeshConfig | None = None, *, devices=None) -> Mesh:
+    """Build a Mesh with axes (dp, fsdp, sp, tp).
+
+    With no config, all visible devices go to dp (the reference-parity
+    default: federated outer loop + per-miner data parallel).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if cfg is None:
+        cfg = MeshConfig(dp=len(devices))
+    if cfg.n_devices > len(devices):
+        raise ValueError(
+            f"mesh {cfg.shape} needs {cfg.n_devices} devices, have {len(devices)}")
+    devices = devices[: cfg.n_devices]
+    try:
+        dev_array = mesh_utils.create_device_mesh(cfg.shape, devices=devices)
+    except Exception:
+        # CPU virtual devices or odd topologies: plain reshape is fine
+        dev_array = np.asarray(devices).reshape(cfg.shape)
+    return Mesh(dev_array, AXES)
+
+
+def best_mesh_shape(n_devices: int, *, model_params: int = 0,
+                    per_device_memory: int = 16 * 1024**3) -> MeshConfig:
+    """Heuristic mesh for N devices: shard params (fsdp) only once the model
+    stops fitting replicated; add tp for very large models.
+
+    Rough sizing: Adam training state is ~16 bytes/param fp32
+    (p + m + v + grad). tp is capped at 8 so it stays inside one ICI ring.
+    """
+    if n_devices == 1:
+        return MeshConfig()
+    state_bytes = model_params * 16
+    if model_params and state_bytes > per_device_memory * n_devices // 2:
+        tp = min(8, _largest_pow2_divisor(n_devices))
+        rest = n_devices // tp
+        return MeshConfig(fsdp=rest, tp=tp)
+    if model_params and state_bytes > per_device_memory // 2:
+        return MeshConfig(fsdp=n_devices)
+    return MeshConfig(dp=n_devices)
+
+
+def _largest_pow2_divisor(n: int) -> int:
+    p = 1
+    while n % (p * 2) == 0:
+        p *= 2
+    return p

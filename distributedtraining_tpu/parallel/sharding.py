@@ -1,0 +1,99 @@
+"""Logical-axis -> mesh-axis resolution for model params and batches.
+
+Models annotate every parameter with logical axis names
+(``nn.with_logical_partitioning`` in models/gpt2.py, models/llama.py). This
+module resolves those names against a mesh via rules, producing
+``NamedSharding``s for params, optimizer state, and batches — the entire
+sharding story lives here, the models never mention mesh axes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Any
+
+# logical axis -> mesh axis (None = replicated). The embed axis maps to fsdp
+# so ZeRO-3-style parameter sharding falls out of the same rules; with
+# fsdp=1 meshes every spec collapses to replication automatically.
+DEFAULT_RULES = (
+    ("vocab", "tp"),
+    ("qkv", "tp"),
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("embed", "fsdp"),
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+)
+
+
+def logical_param_specs(model: nn.Module, *, seq_len: int = 8) -> Params:
+    """PartitionSpecs-of-logical-names for every param, via shape-only init."""
+    import jax.numpy as jnp
+
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    abstract = jax.eval_shape(model.init, jax.random.PRNGKey(0), dummy)
+    return nn.get_partition_spec(abstract["params"])
+
+
+def mesh_shardings(model: nn.Module, mesh: Mesh, *, seq_len: int = 8,
+                   rules=DEFAULT_RULES) -> Params:
+    """NamedShardings for every param on ``mesh`` (feed to jit in/out_shardings
+    or device_put)."""
+    logical = logical_param_specs(model, seq_len=seq_len)
+    return nn.logical_to_mesh_sharding(logical, mesh, rules)
+
+
+def shard_params(params: Params, shardings: Params) -> Params:
+    """Place a (host or differently-sharded) param tree onto the mesh."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), params, shardings)
+
+
+def shard_batch_spec(*, seq_sharded: bool = False) -> P:
+    """[batch, seq] input sharding: batch over (dp, fsdp); seq over sp when
+    ring attention is active."""
+    return P(("dp", "fsdp"), "sp" if seq_sharded else None)
+
+
+def batch_sharding(mesh: Mesh, *, seq_sharded: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, shard_batch_spec(seq_sharded=seq_sharded))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def opt_state_shardings(opt_state, param_shardings: Params, mesh: Mesh):
+    """Optimizer-state shardings: any leaf shaped like a param inherits that
+    param's sharding (adam m/v); scalars replicate.
+
+    Works by matching optax state pytrees whose subtrees mirror the params
+    tree (ScaleByAdamState.mu/nu etc.).
+    """
+    flat_params = {
+        tuple(_path_key(p) for p in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(param_shardings)[0]
+    }
+
+    def resolve(path, leaf):
+        if getattr(leaf, "ndim", 0) == 0:
+            return replicated(mesh)
+        key = tuple(_path_key(p) for p in path)
+        # suffix-match against the params tree: optimizer states embed the
+        # params structure under extra prefix levels
+        for plen in range(len(key)):
+            suffix = key[plen:]
+            if suffix in flat_params:
+                return flat_params[suffix]
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map_with_path(resolve, opt_state)
+
+
+def _path_key(p):
+    return str(getattr(p, "key", getattr(p, "idx", p)))

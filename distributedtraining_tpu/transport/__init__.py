@@ -1,0 +1,23 @@
+"""Artifact transport: the framework's tensor plane.
+
+The reference's "communication backend" is HuggingFace Hub git repos
+(hivetrain/hf_manager.py): miners push ``weight_diff.pt`` to per-miner repos,
+the averager pushes ``averaged_model.pt`` to a shared repo, and everyone
+polls commit SHAs for change detection. Here the same contract is a
+``Transport`` protocol with three interchangeable backends:
+
+- InMemoryTransport — process-local dicts (unit tests, simulations)
+- LocalFSTransport  — directory + content-hash revisions (the reference's
+  LocalHFManager twin, hf_manager.py:200-241, made first-class)
+- HFHubTransport    — the real Hub: safetensors/msgpack artifacts, commit-SHA
+  revisions, history squashing as GC (network-gated)
+
+All payloads cross the boundary as validated msgpack/safetensors — never
+pickle.
+"""
+
+from .base import Transport, Revision
+from .memory import InMemoryTransport
+from .localfs import LocalFSTransport
+
+__all__ = ["Transport", "Revision", "InMemoryTransport", "LocalFSTransport"]

@@ -1,0 +1,55 @@
+"""Transport protocol.
+
+Method mapping to the reference's HFManager (hivetrain/hf_manager.py):
+
+| here                      | reference                                  |
+|---------------------------|--------------------------------------------|
+| publish_delta             | push_changes("weight_diff.pt") :91-114     |
+| fetch_delta               | receive_gradients :186-197                 |
+| publish_base              | push_to_hf_hub("averaged_model.pt") :116-136 |
+| fetch_base                | pull_latest_model + update_model :161-184  |
+| base_revision             | check_for_new_submissions (shared repo) :151-159 |
+| delta_revision            | check_for_new_submissions (miner repo)     |
+| gc                        | super_squash_history + git lfs prune :73-114 |
+
+Revisions are opaque strings (commit SHA / content hash); ``None`` means "no
+artifact yet". Change detection is revision inequality, exactly like the
+reference's commit-SHA polling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Protocol
+
+Params = Any
+Revision = Optional[str]
+
+
+class Transport(Protocol):
+    # -- miner side ---------------------------------------------------------
+    def publish_delta(self, miner_id: str, delta: Params) -> Revision:
+        """Upload this miner's current weight delta (overwrites previous)."""
+        ...
+
+    # -- validator / averager side -----------------------------------------
+    def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
+        """Download + validate a miner's delta; None if absent or invalid."""
+        ...
+
+    def delta_revision(self, miner_id: str) -> Revision:
+        ...
+
+    # -- base model (averager publishes, everyone pulls) -------------------
+    def publish_base(self, base: Params) -> Revision:
+        ...
+
+    def fetch_base(self, template: Params) -> tuple[Params, Revision] | None:
+        ...
+
+    def base_revision(self) -> Revision:
+        ...
+
+    # -- lifecycle ----------------------------------------------------------
+    def gc(self) -> None:
+        """Bound storage (the reference squashes git history + prunes LFS)."""
+        ...

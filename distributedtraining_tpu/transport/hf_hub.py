@@ -1,0 +1,125 @@
+"""HuggingFace Hub transport — the production tensor plane.
+
+Parity with hivetrain/hf_manager.py, minus its hazards:
+
+- artifacts are msgpack/safetensors, never pickled .pt (ref: torch.load of
+  untrusted peer files, hf_manager.py:186-197)
+- uploads use the HTTP API (upload_file) instead of a local git clone per
+  repo, so there is no blocking git subprocess in the training loop
+- change detection = commit-SHA polling (ref: check_for_new_submissions,
+  hf_manager.py:151-159)
+- gc = server-side history squash (ref: super_squash_history + lfs prune,
+  hf_manager.py:73-114)
+
+Network-gated: constructing it without huggingface_hub installed or a token
+raises a clear error; everything in-process still works through the
+InMemory/LocalFS backends.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any
+
+from .. import serialization as ser
+from .base import Revision
+
+Params = Any
+
+DELTA_FILE = "weight_diff.msgpack"
+BASE_FILE = "averaged_model.msgpack"
+
+
+class HFHubTransport:
+    def __init__(self, *, averaged_model_repo_id: str,
+                 my_repo_id: str | None = None,
+                 token: str | None = None,
+                 max_bytes: int = ser.DEFAULT_MAX_BYTES):
+        try:
+            import huggingface_hub  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(
+                "HFHubTransport requires huggingface_hub; use "
+                "LocalFSTransport/InMemoryTransport for offline operation"
+            ) from e
+        from huggingface_hub import HfApi
+
+        self.api = HfApi(token=token or os.environ.get("HF_TOKEN"))
+        self.my_repo_id = my_repo_id
+        self.base_repo_id = averaged_model_repo_id
+        self.max_bytes = max_bytes
+        # miner_id -> repo_id mapping is supplied by the chain store
+        # (chain/base.py); transports only see repo ids.
+
+    # -- helpers ------------------------------------------------------------
+    def _upload(self, repo_id: str, filename: str, tree: Params) -> Revision:
+        data = ser.to_msgpack(tree)
+        with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
+            f.write(data)
+            tmp = f.name
+        try:
+            info = self.api.upload_file(
+                path_or_fileobj=tmp, path_in_repo=filename,
+                repo_id=repo_id, repo_type="model")
+        finally:
+            os.unlink(tmp)
+        return getattr(info, "oid", None) or self._revision(repo_id)
+
+    def _download(self, repo_id: str, filename: str,
+                  template: Params) -> Params | None:
+        from huggingface_hub import hf_hub_download
+        from huggingface_hub.utils import EntryNotFoundError, RepositoryNotFoundError
+        try:
+            path = hf_hub_download(repo_id=repo_id, filename=filename,
+                                   token=self.api.token)
+        except (EntryNotFoundError, RepositoryNotFoundError):
+            return None
+        try:
+            return ser.load_file(path, template, max_bytes=self.max_bytes)
+        except ser.PayloadError:
+            return None
+        finally:
+            # the reference deletes after load to bound disk (hf_manager.py:195)
+            try:
+                os.unlink(os.path.realpath(path))
+            except OSError:
+                pass
+
+    def _revision(self, repo_id: str) -> Revision:
+        try:
+            refs = self.api.list_repo_refs(repo_id)
+            return refs.branches[0].target_commit if refs.branches else None
+        except Exception:
+            return None
+
+    # -- Transport API ------------------------------------------------------
+    def publish_delta(self, miner_id: str, delta: Params) -> Revision:
+        repo = self.my_repo_id or miner_id
+        return self._upload(repo, DELTA_FILE, delta)
+
+    def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
+        return self._download(miner_id, DELTA_FILE, template)
+
+    def delta_revision(self, miner_id: str) -> Revision:
+        return self._revision(miner_id)
+
+    def publish_base(self, base: Params) -> Revision:
+        return self._upload(self.base_repo_id, BASE_FILE, base)
+
+    def fetch_base(self, template: Params):
+        tree = self._download(self.base_repo_id, BASE_FILE, template)
+        if tree is None:
+            return None
+        return tree, self._revision(self.base_repo_id)
+
+    def base_revision(self) -> Revision:
+        return self._revision(self.base_repo_id)
+
+    def gc(self) -> None:
+        """Squash history on our own repos to bound Hub storage."""
+        for repo in filter(None, [self.my_repo_id]):
+            try:
+                self.api.super_squash_history(repo_id=repo)
+            except Exception:
+                pass  # GC is best-effort, like the reference's try/except

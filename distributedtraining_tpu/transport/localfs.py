@@ -1,0 +1,97 @@
+"""Local-filesystem transport.
+
+The reference's LocalHFManager (hf_manager.py:200-241) — a directory with
+SHA-256 content-hash change detection — promoted to a first-class backend.
+Multiple OS processes can run a full miner → validator → averager round
+against one shared directory with no network, which is also how multi-node
+topologies are exercised on a single box (SURVEY.md §4.1).
+
+Layout:
+    root/
+      deltas/<miner_id>.msgpack        one artifact per miner, overwritten
+      base/averaged_model.msgpack      the shared base model
+
+Writes are atomic (tmp + rename, see serialization.save_file) so a reader
+never sees a torn artifact — the reference has no such guarantee.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Any
+
+from .. import serialization as ser
+from .base import Revision
+
+Params = Any
+
+_DELTA_FMT = "%s.msgpack"
+_BASE_NAME = "averaged_model.msgpack"
+
+
+def _hash_file(path: str) -> Revision:
+    if not os.path.exists(path):
+        return None
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class LocalFSTransport:
+    def __init__(self, root: str, *, max_bytes: int = ser.DEFAULT_MAX_BYTES):
+        self.root = root
+        self.max_bytes = max_bytes
+        os.makedirs(os.path.join(root, "deltas"), exist_ok=True)
+        os.makedirs(os.path.join(root, "base"), exist_ok=True)
+
+    def _delta_path(self, miner_id: str) -> str:
+        safe = miner_id.replace("/", "_").replace("..", "_")
+        return os.path.join(self.root, "deltas", _DELTA_FMT % safe)
+
+    @property
+    def _base_path(self) -> str:
+        return os.path.join(self.root, "base", _BASE_NAME)
+
+    # -- miner side ---------------------------------------------------------
+    def publish_delta(self, miner_id: str, delta: Params) -> Revision:
+        path = self._delta_path(miner_id)
+        ser.save_file(delta, path)
+        return _hash_file(path)
+
+    # -- validator / averager side -----------------------------------------
+    def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
+        path = self._delta_path(miner_id)
+        if not os.path.exists(path):
+            return None
+        try:
+            return ser.load_file(path, template, max_bytes=self.max_bytes)
+        except ser.PayloadError:
+            return None
+
+    def delta_revision(self, miner_id: str) -> Revision:
+        return _hash_file(self._delta_path(miner_id))
+
+    # -- base model ---------------------------------------------------------
+    def publish_base(self, base: Params) -> Revision:
+        ser.save_file(base, self._base_path)
+        return _hash_file(self._base_path)
+
+    def fetch_base(self, template: Params):
+        if not os.path.exists(self._base_path):
+            return None
+        try:
+            tree = ser.load_file(self._base_path, template,
+                                 max_bytes=self.max_bytes)
+        except ser.PayloadError:
+            # a torn/corrupt base must read as "absent", not crash the node
+            return None
+        return tree, _hash_file(self._base_path)
+
+    def base_revision(self) -> Revision:
+        return _hash_file(self._base_path)
+
+    def gc(self) -> None:
+        pass  # overwrite-in-place layout never accumulates history

@@ -1,0 +1,60 @@
+"""In-memory transport: the fastest test backend.
+
+Stores serialized bytes (not live pytrees) so the full serialize → validate →
+deserialize path runs exactly as it would over the wire.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+from .. import serialization as ser
+from .base import Revision
+
+Params = Any
+
+
+class InMemoryTransport:
+    def __init__(self):
+        self._deltas: dict[str, bytes] = {}
+        self._base: bytes | None = None
+
+    # -- miner side ---------------------------------------------------------
+    def publish_delta(self, miner_id: str, delta: Params) -> Revision:
+        self._deltas[miner_id] = ser.to_msgpack(delta)
+        return self.delta_revision(miner_id)
+
+    # -- validator / averager side -----------------------------------------
+    def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
+        data = self._deltas.get(miner_id)
+        if data is None:
+            return None
+        try:
+            return ser.validated_load(data, template)
+        except ser.PayloadError:
+            return None
+
+    def delta_revision(self, miner_id: str) -> Revision:
+        data = self._deltas.get(miner_id)
+        return None if data is None else hashlib.sha256(data).hexdigest()
+
+    # -- base model ---------------------------------------------------------
+    def publish_base(self, base: Params) -> Revision:
+        self._base = ser.to_msgpack(base)
+        return self.base_revision()
+
+    def fetch_base(self, template: Params):
+        if self._base is None:
+            return None
+        try:
+            tree = ser.validated_load(self._base, template)
+        except ser.PayloadError:
+            return None
+        return tree, self.base_revision()
+
+    def base_revision(self) -> Revision:
+        return None if self._base is None else hashlib.sha256(self._base).hexdigest()
+
+    def gc(self) -> None:
+        pass  # nothing accumulates: publishes overwrite

@@ -1,0 +1,7 @@
+"""Cross-cutting utilities: timeouts, metrics, logging."""
+
+from .timeout import ChainTimeout, run_with_timeout
+from .metrics import MetricsSink, InMemorySink, JSONLSink, multi_sink
+
+__all__ = ["ChainTimeout", "run_with_timeout",
+           "MetricsSink", "InMemorySink", "JSONLSink", "multi_sink"]

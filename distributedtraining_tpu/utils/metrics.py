@@ -1,0 +1,111 @@
+"""Observability: metric sinks with the reference's metric vocabulary.
+
+The reference logs through MLflow (utils/mlflow_utils.py): per-role runs,
+train loss every N steps, gradient staleness, per-hotkey validator scores,
+merged-model loss/ppl, plus system metrics. Here a ``MetricsSink`` protocol
+decouples engines from the backend: InMemory (tests), JSONL (always works,
+zero deps), MLflow (optional, gated), and a TPU device-metrics helper
+replacing ``torch.cuda.utilization``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Protocol
+
+
+class MetricsSink(Protocol):
+    def log(self, metrics: dict[str, Any], *, step: int | None = None) -> None: ...
+    def log_params(self, params: dict[str, Any]) -> None: ...
+
+
+class InMemorySink:
+    def __init__(self):
+        self.records: list[dict] = []
+        self.params: dict[str, Any] = {}
+
+    def log(self, metrics: dict[str, Any], *, step: int | None = None) -> None:
+        self.records.append({"step": step, **metrics})
+
+    def log_params(self, params: dict[str, Any]) -> None:
+        self.params.update(params)
+
+
+class JSONLSink:
+    """One JSON object per line; the default production sink."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def log(self, metrics: dict[str, Any], *, step: int | None = None) -> None:
+        rec = {"ts": time.time(), "step": step, **metrics}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, default=float) + "\n")
+
+    def log_params(self, params: dict[str, Any]) -> None:
+        self.log({"params": params})
+
+
+class MLflowSink:
+    """Optional MLflow backend (initialize_mlflow/log_model_metrics parity,
+    utils/mlflow_utils.py:85-140). Constructing without mlflow installed or
+    reachable raises; callers treat it as strictly optional, mirroring
+    MLFLOW_ACTIVE=False in the reference (config/mlflow_config.py:3)."""
+
+    def __init__(self, *, tracking_uri: str, experiment: str, run_name: str):
+        import mlflow  # gated import
+        self._mlflow = mlflow
+        mlflow.set_tracking_uri(tracking_uri)
+        mlflow.set_experiment(experiment)
+        self._run = mlflow.start_run(run_name=run_name)
+
+    def log(self, metrics: dict[str, Any], *, step: int | None = None) -> None:
+        clean = {k: float(v) for k, v in metrics.items()
+                 if isinstance(v, (int, float))}
+        self._mlflow.log_metrics(clean, step=step)
+
+    def log_params(self, params: dict[str, Any]) -> None:
+        self._mlflow.log_params(params)
+
+
+class _MultiSink:
+    def __init__(self, sinks):
+        self.sinks = list(sinks)
+
+    def log(self, metrics, *, step=None):
+        for s in self.sinks:
+            s.log(metrics, step=step)
+
+    def log_params(self, params):
+        for s in self.sinks:
+            s.log_params(params)
+
+
+def multi_sink(*sinks: MetricsSink) -> MetricsSink:
+    return _MultiSink(sinks)
+
+
+def device_metrics() -> dict[str, float]:
+    """TPU-side system metrics (replaces torch.cuda.utilization,
+    utils/mlflow_utils.py:15-29): per-device HBM in use, via JAX
+    memory_stats when the backend exposes it."""
+    import jax
+    out: dict[str, float] = {}
+    for i, d in enumerate(jax.local_devices()):
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            out[f"device{i}_bytes_in_use"] = float(stats.get("bytes_in_use", 0))
+            lim = stats.get("bytes_limit")
+            if lim:
+                out[f"device{i}_mem_fraction"] = (
+                    float(stats.get("bytes_in_use", 0)) / float(lim))
+    try:
+        import psutil
+        out["cpu_percent"] = psutil.cpu_percent()
+        out["rss_mb"] = psutil.Process().memory_info().rss / 1e6
+    except Exception:
+        pass
+    return out

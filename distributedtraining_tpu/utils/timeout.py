@@ -1,0 +1,42 @@
+"""Deadline wrapper for hang-prone external calls.
+
+The reference forks a subprocess per chain RPC with a 60 s TTL
+(run_in_subprocess, chain_manager.py:22-54) because substrate connections
+wedge. Forking breaks under JAX (the child inherits TPU handles), so the
+same hygiene is a daemon worker thread + deadline: the caller gets
+ChainTimeout and moves on; an abandoned thread parks on dead IO and never
+touches device state.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class ChainTimeout(TimeoutError):
+    pass
+
+
+def run_with_timeout(fn: Callable[[], T], timeout: float, *,
+                     name: str = "op") -> T:
+    q: queue.Queue = queue.Queue(maxsize=1)
+
+    def worker():
+        try:
+            q.put(("ok", fn()))
+        except BaseException as e:  # propagate any failure to the caller
+            q.put(("err", e))
+
+    t = threading.Thread(target=worker, daemon=True, name=f"timeout-{name}")
+    t.start()
+    try:
+        kind, val = q.get(timeout=timeout)
+    except queue.Empty:
+        raise ChainTimeout(f"{name} exceeded {timeout}s") from None
+    if kind == "err":
+        raise val
+    return val

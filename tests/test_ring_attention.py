@@ -1,0 +1,101 @@
+"""Ring attention vs dense oracle on the 8-device mesh; sp training E2E."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.ops.attention import (
+    dot_product_attention, make_causal_mask)
+from distributedtraining_tpu.ops import ring_attention as ring
+from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+
+@pytest.fixture(autouse=True)
+def clean_ring_mesh():
+    yield
+    ring.set_ring_mesh(None)
+
+
+def dense_oracle(q, k, v):
+    mask = make_causal_mask(q.shape[1])[None, None, :, :]
+    return dot_product_attention(q, k, v, mask)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense(sp, devices):
+    mesh = make_mesh(MeshConfig(sp=sp))
+    k0 = jax.random.PRNGKey(0)
+    B, T, H, D = 2, 64, 4, 16
+    q, k, v = (jax.random.normal(kk, (B, T, H, D))
+               for kk in jax.random.split(k0, 3))
+    out = ring.ring_attention(q, k, v, mesh=mesh)
+    expect = dense_oracle(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_under_jit_with_sharded_inputs(devices):
+    """The production shape: inputs sharded over sp, ring inside jit."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(MeshConfig(sp=8))
+    B, T, H, D = 2, 128, 4, 16
+    k0 = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D))
+               for kk in jax.random.split(k0, 3))
+    sh = NamedSharding(mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ring.ring_attention(a, b, c, mesh=mesh))(
+        qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense_oracle(q, k, v)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_seq_not_divisible_raises(devices):
+    mesh = make_mesh(MeshConfig(sp=8))
+    q = jnp.zeros((1, 12, 2, 8))
+    with pytest.raises(ValueError):
+        ring.ring_attention(q, q, q, mesh=mesh)
+
+
+def test_ring_fallback_without_mesh():
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 16, 2, 8))
+    out = ring.ring_attention(q, q, q)  # no mesh installed -> dense
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_oracle(q, q, q)), rtol=1e-5)
+
+
+def test_sequence_parallel_training_matches_single_device(devices):
+    """Full train step with attention_impl='ring' on an sp=4 mesh must match
+    the dense single-device step."""
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.data import ByteTokenizer, batch_iterator, text_corpus
+
+    SEQ = 64
+    cfg_ring = gpt2.GPT2Config(vocab_size=512, n_positions=128, n_embd=64,
+                               n_layer=2, n_head=4, attention_impl="ring")
+    cfg_dense = gpt2.GPT2Config(vocab_size=512, n_positions=128, n_embd=64,
+                                n_layer=2, n_head=4)
+    docs = text_corpus(split="train", n_docs=32, source="synthetic")
+    # ring path has no segment support: use plain (unpacked) token rows
+    rng = np.random.default_rng(0)
+    bs = [{"input_ids": rng.integers(1, 256, (4, SEQ)).astype(np.int32)}
+          for _ in range(4)]
+
+    ref = TrainEngine(gpt2.GPT2(cfg_dense), seq_len=SEQ)
+    ref_state = ref.init_state(jax.random.PRNGKey(0))
+    ref_losses = []
+    for b in bs:
+        ref_state, m = ref.train_step(ref_state, b)
+        ref_losses.append(float(m["loss"]))
+
+    mesh = make_mesh(MeshConfig(dp=2, sp=4))
+    eng = TrainEngine(gpt2.GPT2(cfg_ring), mesh=mesh, seq_len=SEQ)
+    state = eng.init_state(jax.random.PRNGKey(0))
+    losses = []
+    for b in bs:
+        state, m = eng.train_step(state, eng.place_batch(b))
+        losses.append(float(m["loss"]))
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-3)

@@ -1,0 +1,192 @@
+"""Transport backends, local chain simulator, scheduler, timeout wrapper."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import delta
+from distributedtraining_tpu.chain import LocalAddressStore, LocalChain
+from distributedtraining_tpu.chain.base import (
+    ema_update, mad_anomaly_mask, normalize_scores, quantize_u16)
+from distributedtraining_tpu.engine.scheduler import FakeClock, PeriodicAction
+from distributedtraining_tpu.transport import InMemoryTransport, LocalFSTransport
+from distributedtraining_tpu.utils.timeout import ChainTimeout, run_with_timeout
+
+
+def tree(seed=0):
+    k = jax.random.PRNGKey(seed)
+    return {"w": jax.random.normal(k, (4, 4)), "b": jnp.ones((4,))}
+
+
+@pytest.fixture(params=["memory", "localfs"])
+def transport(request, tmp_path):
+    if request.param == "memory":
+        return InMemoryTransport()
+    return LocalFSTransport(str(tmp_path / "t"))
+
+
+def test_delta_roundtrip_and_revision(transport):
+    base = tree(0)
+    d = delta.compute_delta(tree(1), base)
+    assert transport.delta_revision("m1") is None
+    assert transport.fetch_delta("m1", base) is None
+    rev1 = transport.publish_delta("m1", d)
+    assert rev1 is not None
+    out = transport.fetch_delta("m1", base)
+    for a, b in zip(jax.tree_util.tree_leaves(out), jax.tree_util.tree_leaves(d)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # change detection: same content -> same revision; new content -> new
+    assert transport.publish_delta("m1", d) == rev1
+    rev2 = transport.publish_delta("m1", delta.tree_scale(d, 2.0))
+    assert rev2 != rev1
+
+
+def test_base_roundtrip(transport):
+    base = tree(2)
+    assert transport.base_revision() is None
+    assert transport.fetch_base(base) is None
+    rev = transport.publish_base(base)
+    fetched, rev2 = transport.fetch_base(base)
+    assert rev == rev2
+    for a, b in zip(jax.tree_util.tree_leaves(fetched),
+                    jax.tree_util.tree_leaves(base)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_corrupt_base_reads_as_absent(tmp_path):
+    """Zero-length/garbage base file must not crash a bootstrapping node
+    (live-probe regression)."""
+    import os
+    t = LocalFSTransport(str(tmp_path / "t"))
+    os.makedirs(str(tmp_path / "t" / "base"), exist_ok=True)
+    with open(str(tmp_path / "t" / "base" / "averaged_model.msgpack"), "wb") as f:
+        f.write(b"")
+    assert t.fetch_base(tree(0)) is None
+    with open(str(tmp_path / "t" / "base" / "averaged_model.msgpack"), "wb") as f:
+        f.write(b"\xff" * 100)
+    assert t.fetch_base(tree(0)) is None
+
+
+def test_malformed_delta_returns_none(transport):
+    base = tree(0)
+    evil = {"completely": jnp.zeros((2,))}
+    transport.publish_delta("evil", evil)
+    assert transport.fetch_delta("evil", base) is None
+
+
+def test_localfs_path_traversal_guard(tmp_path):
+    t = LocalFSTransport(str(tmp_path / "t"))
+    t.publish_delta("../../escape", tree(0))
+    import os
+    assert not os.path.exists(str(tmp_path / "escape.msgpack"))
+    files = os.listdir(str(tmp_path / "t" / "deltas"))
+    assert len(files) == 1
+
+
+# -- chain ------------------------------------------------------------------
+
+def test_local_chain_genesis(tmp_path):
+    c = LocalChain(str(tmp_path), my_hotkey="hotkey_95")
+    m = c.sync()
+    assert len(m.hotkeys) == 100
+    assert c.get_validator_uids() == list(range(91, 100))
+    assert m.stakes[0] == 10.0 and m.stakes[95] == 10000.0
+
+
+def test_chain_weight_emission_and_consensus(tmp_path):
+    clock = FakeClock()
+    c = LocalChain(str(tmp_path), my_hotkey="hotkey_95", epoch_length=0,
+                   clock=clock)
+    scores = {f"hotkey_{i}": float(i % 5) for i in range(10)}
+    assert c.should_set_weights()
+    assert c.set_weights(scores)
+    w = c.get_weights()
+    assert max(w.values()) == 65535
+    cons = c.consensus_scores()
+    assert cons  # stake-weighted view exists
+    top = max(cons, key=cons.get)
+    assert scores[top] == max(scores[k] for k in scores)
+
+
+def test_chain_epoch_gating(tmp_path):
+    clock = FakeClock()
+    c = LocalChain(str(tmp_path), epoch_length=100, clock=clock)
+    assert c.should_set_weights()  # never set before
+    c.set_weights({"hotkey_1": 1.0})
+    assert not c.should_set_weights()
+    clock.advance(100 * 12.0)  # one epoch of 12s blocks
+    assert c.should_set_weights()
+
+
+def test_chain_ema_smoothing(tmp_path):
+    clock = FakeClock()
+    c = LocalChain(str(tmp_path), my_hotkey="hotkey_95", epoch_length=0,
+                   clock=clock)
+    c.set_weights({"hotkey_1": 3.0})
+    s = c._state()["ema_scores"]["hotkey_95"]["hotkey_1"]
+    assert abs(s - 1.0) < 1e-9  # 1/3 * 3.0 + 2/3 * 0
+
+
+def test_address_store(tmp_path):
+    s = LocalAddressStore(str(tmp_path))
+    assert s.retrieve_repo("hk") is None
+    s.store_repo("hk", "org/repo")
+    assert s.retrieve_repo("hk") == "org/repo"
+    s2 = LocalAddressStore(str(tmp_path))  # persisted
+    assert s2.retrieve_repo("hk") == "org/repo"
+
+
+def test_rate_limiter_blacklists(tmp_path):
+    clock = FakeClock()
+    c = LocalChain(str(tmp_path), clock=clock, rate_limit_seconds=5.0)
+    assert c.rate_limit("addr")
+    clock.advance(1.0)
+    assert not c.rate_limit("addr")   # too fast -> refused (violation 1)
+    clock.advance(100.0)
+    assert c.rate_limit("addr")       # transient offense forgiven
+    for _ in range(3):                # persistent hammering -> blacklist
+        clock.advance(0.1)
+        assert not c.rate_limit("addr")
+    clock.advance(100.0)
+    assert not c.rate_limit("addr")   # blacklist persists
+
+
+# -- pure score math --------------------------------------------------------
+
+def test_score_math():
+    assert ema_update({"a": 1.0}, {"a": 4.0})["a"] == pytest.approx(2.0)
+    n = normalize_scores({"a": 1.0, "b": 3.0, "c": -5.0})
+    assert n["c"] == 0 and abs(sum(n.values()) - 1.0) < 1e-9
+    q = quantize_u16([0.25, 0.5])
+    assert q == [32768, 65535]
+    flags = mad_anomaly_mask([1.0, 1.1, 0.9, 1.05, 50.0])
+    assert flags == [False, False, False, False, True]
+
+
+# -- scheduler + timeout ----------------------------------------------------
+
+def test_periodic_action():
+    clock = FakeClock()
+    fired = []
+    a = PeriodicAction(10.0, lambda: fired.append(clock.now()), clock)
+    assert not a.poll()
+    clock.advance(9.9)
+    assert not a.poll()
+    clock.advance(0.2)
+    assert a.poll()
+    assert not a.poll()
+    clock.advance(10.0)
+    assert a.poll()
+    assert len(fired) == 2
+
+
+def test_run_with_timeout():
+    assert run_with_timeout(lambda: 42, 5.0) == 42
+    with pytest.raises(ChainTimeout):
+        import time
+        run_with_timeout(lambda: time.sleep(10), 0.1)
+    with pytest.raises(ValueError):
+        def boom():
+            raise ValueError("x")
+        run_with_timeout(boom, 5.0)
