@@ -82,7 +82,8 @@ class LocalChain:
 
     def __init__(self, directory: str, *, my_hotkey: str = "hotkey_0",
                  epoch_length: int = 100, clock: Clock | None = None,
-                 rate_limit_seconds: float = 0.0):
+                 rate_limit_seconds: float = 0.0,
+                 vpermit_stake_limit: float = 1000.0):
         self.directory = directory
         self.path = os.path.join(directory, "metagraph.json")
         self._my_hotkey = my_hotkey
@@ -90,6 +91,7 @@ class LocalChain:
         self.clock = clock or RealClock()
         self._epoch_start = self.clock.now()
         self.rate_limit_seconds = rate_limit_seconds
+        self.vpermit_stake_limit = vpermit_stake_limit
         self._last_request: dict[str, float] = {}
         self._violations: dict[str, int] = {}
         self._blacklist: set[str] = set()
@@ -127,9 +129,12 @@ class LocalChain:
     def current_block(self) -> int:
         return int((self.clock.now() - self._epoch_start) / BLOCK_SECONDS)
 
-    def get_validator_uids(self, stake_limit: float = 1000.0) -> list[int]:
+    def get_validator_uids(self, stake_limit: float | None = None) -> list[int]:
+        """UIDs with stake >= the vpermit limit (btt_connector.py:358-380;
+        --neuron.vpermit_tao_limit, base_subnet_config.py:178-183)."""
+        limit = self.vpermit_stake_limit if stake_limit is None else stake_limit
         s = self._state()
-        return [u for u, st in zip(s["uids"], s["stakes"]) if st >= stake_limit]
+        return [u for u, st in zip(s["uids"], s["stakes"]) if st >= limit]
 
     def should_set_weights(self) -> bool:
         """Block-epoch gate (btt_connector.py:382-385)."""

@@ -1,0 +1,169 @@
+"""Run configuration: dataclasses + a CLI builder, parsed only from main().
+
+The reference merges bittensor arg groups with subnet args into one bt.config
+namespace (hivetrain/config/config.py:44-60) and — worse — parses sys.argv at
+module import time (training_manager.py:22-24), which SURVEY.md §1 flags as
+the defect that makes the library unimportable without a chain. Here the
+config is a plain dataclass; ``RunConfig.from_args`` is called explicitly by
+the role entry points (neurons/) and never at import.
+
+Flag parity map (reference → here):
+  --netuid                      → --netuid           (base_subnet_config.py)
+  --wallet.hotkey               → --hotkey
+  --storage.my_repo_id          → --my-repo-id       (hivetrain_config.py:14)
+  --storage.averaged_model_repo_id → --averaged-model-repo-id (:15)
+  --storage.gradient_dir/model_dir → --work-dir      (:16-17)
+  --batch_size                  → --batch-size       (:34-41)
+  --neuron.epoch_length         → --epoch-length     (base_subnet_config.py:72)
+  --neuron.vpermit_tao_limit    → --vpermit-stake-limit (:178-183)
+  --mock                        → --backend local    (:79-84)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """dp×fsdp×sp×tp axis sizes; 0 for dp means "all visible devices"."""
+    dp: int = 0
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+
+@dataclasses.dataclass
+class RunConfig:
+    role: str = "miner"                      # miner | validator | averager
+
+    # -- identity / chain ---------------------------------------------------
+    netuid: int = 25                         # prod subnet (README.md:93)
+    hotkey: str = "hotkey_0"
+    epoch_length: int = 100                  # blocks between weight sets
+    vpermit_stake_limit: float = 1000.0
+
+    # -- storage / transport ------------------------------------------------
+    backend: str = "local"                   # local | memory | hf
+    work_dir: str = "./hivetrain_run"
+    my_repo_id: Optional[str] = None
+    averaged_model_repo_id: Optional[str] = None
+
+    # -- model / optimization ----------------------------------------------
+    model: str = "gpt2-124m"                 # gpt2 preset name
+    seq_len: int = 64                        # miner train len (miner.py:70)
+    eval_seq_len: int = 512                  # validator len (validator.py:63)
+    batch_size: int = 8
+    eval_batches: int = 12                   # ~100 texts / batch 8 (ref :49,98)
+    learning_rate: float = 5e-4              # neurons/miner.py:121-128
+    grad_clip: Optional[float] = None
+    dataset: str = "auto"                    # auto | wikitext | synthetic
+    tokenizer: str = "auto"                  # auto | byte | <hf name>
+
+    # -- mesh ---------------------------------------------------------------
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+
+    # -- cadences (seconds) -------------------------------------------------
+    send_interval: float = 800.0             # miner.py:125
+    check_update_interval: float = 300.0
+    validation_interval: float = 1800.0      # validator.py:112
+    averaging_interval: float = 1200.0       # averager.py:106
+
+    # -- averager strategy --------------------------------------------------
+    strategy: str = "parameterized"          # weighted | parameterized | genetic
+    meta_epochs: int = 7                     # averager.py:106
+    meta_lr: float = 0.01
+
+    # -- bounded runs (tests / smoke) --------------------------------------
+    max_steps: Optional[int] = None
+    rounds: Optional[int] = None
+
+    # -- observability ------------------------------------------------------
+    metrics_path: Optional[str] = None       # JSONL sink
+    mlflow_uri: Optional[str] = None
+
+    @classmethod
+    def from_args(cls, role: str, argv: Sequence[str] | None = None
+                  ) -> "RunConfig":
+        ns = build_parser(role).parse_args(argv)
+        mesh = MeshSpec(dp=ns.dp, fsdp=ns.fsdp, sp=ns.sp, tp=ns.tp)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in vars(ns).items() if k in fields}
+        kw.pop("mesh", None)
+        return cls(role=role, mesh=mesh, **kw)
+
+
+def build_parser(role: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=f"neurons/{role}.py",
+                                description=f"hivetrain-tpu {role}")
+    d = RunConfig()
+
+    g = p.add_argument_group("chain")
+    g.add_argument("--netuid", type=int, default=d.netuid)
+    g.add_argument("--hotkey", default=d.hotkey)
+    g.add_argument("--epoch-length", dest="epoch_length", type=int,
+                   default=d.epoch_length)
+    g.add_argument("--vpermit-stake-limit", dest="vpermit_stake_limit",
+                   type=float, default=d.vpermit_stake_limit)
+
+    g = p.add_argument_group("storage")
+    g.add_argument("--backend", choices=("local", "memory", "hf"),
+                   default=d.backend)
+    g.add_argument("--work-dir", dest="work_dir", default=d.work_dir)
+    g.add_argument("--my-repo-id", dest="my_repo_id", default=None)
+    g.add_argument("--averaged-model-repo-id", dest="averaged_model_repo_id",
+                   default=None)
+
+    g = p.add_argument_group("model")
+    g.add_argument("--model", default=d.model)
+    g.add_argument("--seq-len", dest="seq_len", type=int, default=d.seq_len)
+    g.add_argument("--eval-seq-len", dest="eval_seq_len", type=int,
+                   default=d.eval_seq_len)
+    g.add_argument("--batch-size", dest="batch_size", type=int,
+                   default=d.batch_size)
+    g.add_argument("--eval-batches", dest="eval_batches", type=int,
+                   default=d.eval_batches)
+    g.add_argument("--learning-rate", dest="learning_rate", type=float,
+                   default=d.learning_rate)
+    g.add_argument("--grad-clip", dest="grad_clip", type=float, default=None)
+    g.add_argument("--dataset", choices=("auto", "wikitext", "synthetic"),
+                   default=d.dataset)
+    g.add_argument("--tokenizer", default=d.tokenizer)
+
+    g = p.add_argument_group("mesh")
+    g.add_argument("--dp", type=int, default=d.mesh.dp,
+                   help="data-parallel axis; 0 = all visible devices")
+    g.add_argument("--fsdp", type=int, default=d.mesh.fsdp)
+    g.add_argument("--sp", type=int, default=d.mesh.sp)
+    g.add_argument("--tp", type=int, default=d.mesh.tp)
+
+    g = p.add_argument_group("cadence")
+    g.add_argument("--send-interval", dest="send_interval", type=float,
+                   default=d.send_interval)
+    g.add_argument("--check-update-interval", dest="check_update_interval",
+                   type=float, default=d.check_update_interval)
+    g.add_argument("--validation-interval", dest="validation_interval",
+                   type=float, default=d.validation_interval)
+    g.add_argument("--averaging-interval", dest="averaging_interval",
+                   type=float, default=d.averaging_interval)
+
+    if role == "averager":
+        g = p.add_argument_group("strategy")
+        g.add_argument("--strategy",
+                       choices=("weighted", "parameterized", "genetic"),
+                       default=d.strategy)
+        g.add_argument("--meta-epochs", dest="meta_epochs", type=int,
+                       default=d.meta_epochs)
+        g.add_argument("--meta-lr", dest="meta_lr", type=float,
+                       default=d.meta_lr)
+
+    g = p.add_argument_group("run bounds")
+    g.add_argument("--max-steps", dest="max_steps", type=int, default=None)
+    g.add_argument("--rounds", type=int, default=None)
+
+    g = p.add_argument_group("observability")
+    g.add_argument("--metrics-path", dest="metrics_path", default=None)
+    g.add_argument("--mlflow-uri", dest="mlflow_uri", default=None)
+    return p

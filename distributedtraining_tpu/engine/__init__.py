@@ -8,7 +8,7 @@ pattern made first-class (SURVEY.md §4).
 """
 
 from .scheduler import Clock, RealClock, FakeClock, PeriodicAction
-from .train import TrainEngine, MinerLoop, TrainState
+from .train import TrainEngine, MinerLoop, TrainState, default_optimizer
 from .validate import Validator
 from .average import (
     AveragerLoop,
@@ -19,7 +19,7 @@ from .average import (
 
 __all__ = [
     "Clock", "RealClock", "FakeClock", "PeriodicAction",
-    "TrainEngine", "MinerLoop", "TrainState",
+    "TrainEngine", "MinerLoop", "TrainState", "default_optimizer",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
 ]

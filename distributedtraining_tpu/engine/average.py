@@ -296,13 +296,17 @@ class AveragerLoop:
         return True
 
     def run_periodic(self, *, interval: float = 1200.0,   # neurons/averager.py:106
-                     rounds: int | None = None) -> None:
-        done = 0
+                     rounds: int | None = None) -> int:
+        """Run rounds forever (or ``rounds`` times); returns how many rounds
+        actually merged (no exception and at least one accepted delta)."""
+        done = merged = 0
         while rounds is None or done < rounds:
             try:
-                self.run_round()
+                if self.run_round():
+                    merged += 1
             except Exception:
                 logger.exception("averaging round failed; continuing")
             done += 1
             if rounds is None or done < rounds:
                 self.clock.sleep(interval)
+        return merged

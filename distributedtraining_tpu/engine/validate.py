@@ -129,13 +129,18 @@ class Validator:
         return results
 
     def run_periodic(self, *, interval: float = 1800.0,   # neurons/validator.py:112
-                     rounds: int | None = None) -> None:
-        done = 0
+                     rounds: int | None = None) -> int:
+        """Run rounds forever (or ``rounds`` times); returns how many
+        completed without an exception so callers can exit non-zero when
+        every round failed."""
+        done = succeeded = 0
         while rounds is None or done < rounds:
             try:
                 self.validate_and_score()
+                succeeded += 1
             except Exception:
                 logger.exception("validation round failed; continuing")
             done += 1
             if rounds is None or done < rounds:
                 self.clock.sleep(interval)
+        return succeeded

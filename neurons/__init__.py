@@ -1,0 +1,1 @@
+"""Role entry points: miner, validator, averager (SURVEY.md L5)."""

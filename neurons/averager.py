@@ -1,0 +1,55 @@
+"""Averager entry point: merge miner deltas into the next base model.
+
+Rebuild of the reference averager (neurons/averager.py:39-106 →
+ParameterizedAverager, hivetrain/averaging_logic.py:335-583). Run offline:
+
+    python neurons/averager.py --backend local --work-dir /tmp/run \
+        --model tiny --dataset synthetic --strategy parameterized --rounds 1
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributedtraining_tpu.config import RunConfig           # noqa: E402
+from distributedtraining_tpu.engine import (                   # noqa: E402
+    AveragerLoop, GeneticMerge, ParameterizedMerge, WeightedAverage)
+from neurons.common import build                               # noqa: E402
+
+
+def make_strategy(cfg: RunConfig, model):
+    if cfg.strategy == "weighted":
+        return WeightedAverage()
+    if cfg.strategy == "genetic":
+        return GeneticMerge()
+    return ParameterizedMerge(model, meta_epochs=cfg.meta_epochs,
+                              meta_lr=cfg.meta_lr)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    cfg = RunConfig.from_args("averager", argv)
+    c = build(cfg)
+    loop = AveragerLoop(c.engine, c.transport, c.chain,
+                        make_strategy(cfg, c.model),
+                        val_batches=c.eval_batches(),
+                        address_store=c.address_store,
+                        metrics=c.metrics)
+    loop.bootstrap()
+    merged = loop.run_periodic(interval=cfg.averaging_interval,
+                               rounds=cfg.rounds)
+    logging.info("averager done: rounds=%d accepted=%d rejected=%d loss=%.4f",
+                 loop.report.rounds, loop.report.last_accepted,
+                 loop.report.last_rejected, loop.report.last_loss)
+    return 0 if merged else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,134 @@
+"""Shared composition for the role entry points.
+
+The reference's neurons/{miner,validator,averager}.py each hand-assemble
+dataset + tokenizer + model + HF/chain managers with copy-pasted Dataset
+classes (neurons/miner.py:69-99 vs validator.py:62-93 vs averager.py:71-90).
+Here composition is one function, driven by RunConfig, with no import-time
+side effects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable, Iterable
+
+from distributedtraining_tpu.chain import LocalAddressStore, LocalChain
+from distributedtraining_tpu.config import RunConfig
+from distributedtraining_tpu.data import (ByteTokenizer, batch_iterator,
+                                          load_tokenizer, text_corpus)
+from distributedtraining_tpu.engine import TrainEngine, default_optimizer
+from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+from distributedtraining_tpu.transport import (InMemoryTransport,
+                                               LocalFSTransport)
+from distributedtraining_tpu.utils import JSONLSink, multi_sink
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Components:
+    cfg: RunConfig
+    model: Any
+    model_cfg: Any
+    engine: TrainEngine
+    transport: Any
+    chain: Any
+    address_store: Any
+    tokenizer: Any
+    metrics: Any
+
+    def train_batches(self, *, repeat: bool = True) -> Iterable[dict]:
+        docs = text_corpus(split="train", source=self.cfg.dataset)
+        return batch_iterator(docs, self.tokenizer,
+                              batch_size=self.cfg.batch_size,
+                              seq_len=self.cfg.seq_len, repeat=repeat,
+                              max_vocab=self.model_cfg.vocab_size)
+
+    def eval_batches(self) -> Callable[[], Iterable[dict]]:
+        """Factory over a fixed held-out shard (the reference evaluates the
+        first ~100 test texts, neurons/validator.py:49,98)."""
+        docs = text_corpus(split="test", source=self.cfg.dataset)
+        cfg = self.cfg
+
+        def factory():
+            it = batch_iterator(docs, self.tokenizer,
+                                batch_size=cfg.batch_size,
+                                seq_len=cfg.eval_seq_len,
+                                max_vocab=self.model_cfg.vocab_size)
+            for i, b in enumerate(it):
+                if i >= cfg.eval_batches:
+                    break
+                yield b
+
+        return factory
+
+
+def build(cfg: RunConfig) -> Components:
+    import jax
+
+    model, model_cfg = gpt2.make_model(cfg.model)
+
+    mesh = None
+    spec = cfg.mesh
+    n_visible = len(jax.devices())
+    dp = spec.dp or max(1, n_visible // (spec.fsdp * spec.sp * spec.tp))
+    mcfg = MeshConfig(dp=dp, fsdp=spec.fsdp, sp=spec.sp, tp=spec.tp)
+    if mcfg.n_devices > 1:
+        mesh = make_mesh(mcfg)
+
+    seq = cfg.seq_len if cfg.role == "miner" else cfg.eval_seq_len
+    engine = TrainEngine(
+        model,
+        optimizer=default_optimizer(cfg.learning_rate,
+                                    grad_clip=cfg.grad_clip),
+        mesh=mesh, seq_len=seq)
+
+    if cfg.backend == "memory":
+        transport = InMemoryTransport()
+    elif cfg.backend == "hf":
+        if not cfg.averaged_model_repo_id:
+            raise SystemExit(
+                "--backend hf requires --averaged-model-repo-id")
+        if cfg.role == "miner" and not cfg.my_repo_id:
+            raise SystemExit("--backend hf miner requires --my-repo-id")
+        from distributedtraining_tpu.transport import HFHubTransport
+        transport = HFHubTransport(
+            averaged_model_repo_id=cfg.averaged_model_repo_id,
+            my_repo_id=cfg.my_repo_id)
+    else:
+        transport = LocalFSTransport(os.path.join(cfg.work_dir, "artifacts"))
+
+    chain_dir = os.path.join(cfg.work_dir, "chain")
+    chain = LocalChain(chain_dir, my_hotkey=cfg.hotkey,
+                       epoch_length=cfg.epoch_length,
+                       vpermit_stake_limit=cfg.vpermit_stake_limit)
+    address_store = LocalAddressStore(chain_dir)
+    if cfg.my_repo_id:
+        # advertise our repo like the reference miner does on-chain
+        # (neurons/miner.py:36-44)
+        address_store.store_repo(cfg.hotkey, cfg.my_repo_id)
+
+    if cfg.tokenizer == "byte" or (cfg.tokenizer == "auto"
+                                   and model_cfg.vocab_size < 50257):
+        tokenizer = ByteTokenizer()
+    else:
+        tokenizer = load_tokenizer(
+            "gpt2" if cfg.tokenizer == "auto" else cfg.tokenizer)
+
+    sinks = []
+    if cfg.metrics_path:
+        sinks.append(JSONLSink(cfg.metrics_path))
+    if cfg.mlflow_uri:
+        from distributedtraining_tpu.utils.metrics import MLflowSink
+        sinks.append(MLflowSink(tracking_uri=cfg.mlflow_uri,
+                                experiment=f"hivetrain-{cfg.netuid}",
+                                run_name=f"{cfg.role}-{cfg.hotkey}"))
+    metrics = multi_sink(*sinks) if sinks else None
+
+    return Components(cfg=cfg, model=model, model_cfg=model_cfg,
+                      engine=engine, transport=transport, chain=chain,
+                      address_store=address_store, tokenizer=tokenizer,
+                      metrics=metrics)

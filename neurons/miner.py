@@ -1,0 +1,47 @@
+"""Miner entry point: train on the current base, publish weight deltas.
+
+Rebuild of the reference miner (neurons/miner.py:30-129 → DeltaLoop,
+hivetrain/training_manager.py:345-433). Run offline end-to-end with:
+
+    python neurons/miner.py --backend local --work-dir /tmp/run \
+        --model tiny --dataset synthetic --max-steps 50
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributedtraining_tpu.config import RunConfig   # noqa: E402
+from distributedtraining_tpu.engine import MinerLoop   # noqa: E402
+from neurons.common import build                       # noqa: E402
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    cfg = RunConfig.from_args("miner", argv)
+    c = build(cfg)
+    loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
+                     send_interval=cfg.send_interval,
+                     check_update_interval=cfg.check_update_interval,
+                     metrics=c.metrics)
+    loop.bootstrap()
+    try:
+        report = loop.run(c.train_batches(), max_steps=cfg.max_steps)
+    except KeyboardInterrupt:
+        report = loop.report
+    loop.flush()  # final delta so short runs still publish
+    logging.info("miner done: steps=%d pushes=%d base_pulls=%d loss=%.4f",
+                 report.steps, report.pushes, report.base_pulls,
+                 report.last_loss)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

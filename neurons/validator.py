@@ -1,0 +1,40 @@
+"""Validator entry point: score every miner's delta, emit chain weights.
+
+Rebuild of the reference validator (neurons/validator.py:26-115 →
+ModelValidator/DeltaValidator, hivetrain/validation_logic.py). Run offline:
+
+    python neurons/validator.py --backend local --work-dir /tmp/run \
+        --model tiny --dataset synthetic --hotkey hotkey_91 --rounds 1
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributedtraining_tpu.config import RunConfig   # noqa: E402
+from distributedtraining_tpu.engine import Validator   # noqa: E402
+from neurons.common import build                       # noqa: E402
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    cfg = RunConfig.from_args("validator", argv)
+    c = build(cfg)
+    validator = Validator(c.engine, c.transport, c.chain,
+                          eval_batches=c.eval_batches(),
+                          metrics=c.metrics)
+    validator.bootstrap()
+    ok = validator.run_periodic(interval=cfg.validation_interval,
+                                rounds=cfg.rounds)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,88 @@
+"""End-to-end offline round through the role entry points (CLI surface).
+
+The reference is "tested" by running its Local* twins as a full
+miner → validator → averager round on one box (SURVEY.md §4.1); this test is
+that round, driven through neurons/{miner,validator,averager}.main with the
+LocalFS transport + LocalJSON chain in a tmp dir.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from neurons import averager, miner, validator  # noqa: E402
+
+
+def _common(tmp_path, hotkey, extra=()):
+    return [
+        "--backend", "local", "--work-dir", str(tmp_path),
+        "--model", "tiny", "--dataset", "synthetic",
+        "--hotkey", hotkey, "--dp", "1",
+        "--batch-size", "4", "--seq-len", "32", "--eval-seq-len", "32",
+        "--eval-batches", "2",
+        *extra,
+    ]
+
+
+def test_full_offline_round(tmp_path):
+    # -- miner trains and publishes a delta --------------------------------
+    rc = miner.main(_common(
+        tmp_path, "hotkey_0",
+        ["--max-steps", "30", "--send-interval", "1e9",
+         "--metrics-path", str(tmp_path / "miner_metrics.jsonl")]))
+    assert rc == 0
+    delta_path = tmp_path / "artifacts" / "deltas" / "hotkey_0.msgpack"
+    assert delta_path.exists(), "miner flush must publish a delta"
+
+    # -- validator scores it and sets chain weights ------------------------
+    rc = validator.main(_common(tmp_path, "hotkey_91", ["--rounds", "1"]))
+    assert rc == 0
+    meta = json.loads((tmp_path / "chain" / "metagraph.json").read_text())
+    weights = meta["weights"]["hotkey_91"]
+    assert weights, "validator must emit weights"
+    # the only delta came from hotkey_0; if anyone scored, it must be them
+    if any(weights.values()):
+        assert weights.get("hotkey_0", 0) == max(weights.values())
+
+    # -- averager merges and publishes a new base --------------------------
+    base_path = tmp_path / "artifacts" / "base" / "averaged_model.msgpack"
+    rc = averager.main(_common(
+        tmp_path, "hotkey_99",
+        ["--rounds", "1", "--strategy", "weighted"]))
+    assert rc == 0
+    assert base_path.exists(), "averager must publish the merged base"
+
+    # -- miner picks up the new base (optimizer-reset semantics) -----------
+    rc = miner.main(_common(
+        tmp_path, "hotkey_1",
+        ["--max-steps", "5", "--send-interval", "1e9",
+         "--check-update-interval", "0"]))
+    assert rc == 0
+    assert (tmp_path / "artifacts" / "deltas" / "hotkey_1.msgpack").exists()
+
+
+def test_parameterized_strategy_cli(tmp_path):
+    miner.main(_common(tmp_path, "hotkey_0",
+                       ["--max-steps", "10", "--send-interval", "1e9"]))
+    rc = averager.main(_common(
+        tmp_path, "hotkey_99",
+        ["--rounds", "1", "--strategy", "parameterized",
+         "--meta-epochs", "1"]))
+    assert rc == 0
+    assert (tmp_path / "artifacts" / "base" / "averaged_model.msgpack").exists()
+
+
+def test_config_defaults_match_reference():
+    from distributedtraining_tpu.config import RunConfig
+    cfg = RunConfig.from_args("miner", [])
+    assert cfg.learning_rate == 5e-4          # neurons/miner.py:121-128
+    assert cfg.send_interval == 800.0         # neurons/miner.py:125
+    assert cfg.validation_interval == 1800.0  # neurons/validator.py:112
+    assert cfg.averaging_interval == 1200.0   # neurons/averager.py:106
+    assert cfg.meta_epochs == 7               # neurons/averager.py:106
+    assert cfg.epoch_length == 100            # base_subnet_config.py:72-77
+    assert cfg.seq_len == 64 and cfg.eval_seq_len == 512
