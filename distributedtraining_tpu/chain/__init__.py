@@ -16,7 +16,9 @@ to function.
 """
 
 from .base import AddressStore, Metagraph, Network
+from .bittensor_chain import BittensorAddressStore, BittensorChain
 from .local import LocalAddressStore, LocalChain
 
 __all__ = ["AddressStore", "Metagraph", "Network",
+           "BittensorAddressStore", "BittensorChain",
            "LocalAddressStore", "LocalChain"]

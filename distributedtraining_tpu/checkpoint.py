@@ -1,0 +1,140 @@
+"""Local checkpoint/resume (Orbax) for long-running roles.
+
+The reference has no local checkpointing: HF Hub *is* its checkpoint store
+(`averaged_model.pt` in the shared repo, hivetrain/averaging_logic.py:481-488,
+hivetrain/hf_manager.py:161-173), and a restarted miner loses its optimizer
+state by design (training_manager.py:371-377). This module keeps the Hub as
+the *protocol* checkpoint (see transport/) and adds what the reference lacks:
+a crash-safe local store so a preempted miner resumes mid-round with its
+optimizer moments and step counter intact — on TPU, preemption is routine, so
+this is a first-class subsystem, not an afterthought.
+
+Design:
+- Orbax `CheckpointManager` under the hood (async off: checkpoints here are
+  small relative to the push cadence, and synchronous saves keep restart
+  semantics trivially correct).
+- The unit of persistence is a *composite* pytree: the engine ``TrainState``
+  plus the miner's base snapshot and the base revision string, so a resumed
+  miner pushes deltas against the same base it was training against.
+- Restore is template-driven (like serialization.py): the caller supplies an
+  abstract/concrete example tree, so a corrupt or stale checkpoint directory
+  fails loudly instead of materializing garbage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """What a role persists between process lives."""
+    state: Any                    # engine TrainState (params, opt_state, step)
+    base_params: Params | None    # miner's delta base (None for validator)
+    base_revision: str | None     # transport revision the base came from
+    lifetime_steps: int | None = None  # monotonic across base pulls (metrics)
+
+    def as_tree(self) -> dict:
+        tree = {"state": self.state}
+        if self.base_params is not None:
+            tree["base_params"] = self.base_params
+        return tree
+
+
+class CheckpointStore:
+    """Numbered local checkpoints with retention GC.
+
+    ``save``/``restore`` round-trip a :class:`Snapshot`; the revision string
+    travels in Orbax per-step metadata (it is not an array, so it does not
+    belong in the pytree).
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    # -- write --------------------------------------------------------------
+    def save(self, step: int, snapshot: Snapshot) -> None:
+        ocp = self._ocp
+        self._mgr.save(
+            int(step),
+            args=ocp.args.Composite(
+                tree=ocp.args.StandardSave(snapshot.as_tree()),
+                meta=ocp.args.JsonSave(
+                    {"base_revision": snapshot.base_revision,
+                     "lifetime_steps": snapshot.lifetime_steps}),
+            ),
+        )
+        self._mgr.wait_until_finished()
+
+    def next_step(self) -> int:
+        """Next free checkpoint key. Keys are a monotonic save sequence, NOT
+        the training step — the miner's step counter resets to 0 on every
+        base-model pull (protocol semantics), so using it as the key would
+        make ``latest_step`` resolve to a stale pre-reset checkpoint and
+        collide on re-used step numbers."""
+        latest = self._mgr.latest_step()
+        return 0 if latest is None else latest + 1
+
+    # -- read ---------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, template: Snapshot, step: int | None = None
+                ) -> Optional[Snapshot]:
+        """Restore the latest (or given) checkpoint into the template's
+        structure; returns None when the store is empty."""
+        ocp = self._ocp
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape") else x,
+            template.as_tree())
+        restored = self._mgr.restore(
+            int(step),
+            args=ocp.args.Composite(
+                tree=ocp.args.StandardRestore(abstract),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+        tree, meta = restored["tree"], restored["meta"] or {}
+        return Snapshot(
+            state=tree["state"],
+            base_params=tree.get("base_params"),
+            base_revision=meta.get("base_revision"),
+            lifetime_steps=meta.get("lifetime_steps"),
+        )
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

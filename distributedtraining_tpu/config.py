@@ -40,8 +40,12 @@ class RunConfig:
     role: str = "miner"                      # miner | validator | averager
 
     # -- identity / chain ---------------------------------------------------
+    chain: str = "local"                     # local | bittensor
     netuid: int = 25                         # prod subnet (README.md:93)
     hotkey: str = "hotkey_0"
+    wallet_name: str = "default"             # bittensor wallet (cold) name
+    wallet_hotkey: str = "default"           # bittensor wallet hotkey name
+    subtensor_network: str = "finney"        # bittensor network endpoint
     epoch_length: int = 100                  # blocks between weight sets
     vpermit_stake_limit: float = 1000.0
 
@@ -68,6 +72,8 @@ class RunConfig:
     # -- cadences (seconds) -------------------------------------------------
     send_interval: float = 800.0             # miner.py:125
     check_update_interval: float = 300.0
+    checkpoint_interval: float = 600.0       # 0 disables local checkpointing
+    checkpoint_dir: Optional[str] = None     # default: <work_dir>/checkpoints/<hotkey>
     validation_interval: float = 1800.0      # validator.py:112
     averaging_interval: float = 1200.0       # averager.py:106
 
@@ -101,8 +107,19 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     d = RunConfig()
 
     g = p.add_argument_group("chain")
+    g.add_argument("--chain", choices=("local", "bittensor"), default=d.chain,
+                   help="local = JSON-file chain under --work-dir (single "
+                        "box / tests); bittensor = substrate chain via the "
+                        "bittensor SDK. A multi-host --backend hf deployment "
+                        "needs --chain bittensor or every role sees only its "
+                        "own local scores.")
     g.add_argument("--netuid", type=int, default=d.netuid)
     g.add_argument("--hotkey", default=d.hotkey)
+    g.add_argument("--wallet-name", dest="wallet_name", default=d.wallet_name)
+    g.add_argument("--wallet-hotkey", dest="wallet_hotkey",
+                   default=d.wallet_hotkey)
+    g.add_argument("--subtensor-network", dest="subtensor_network",
+                   default=d.subtensor_network)
     g.add_argument("--epoch-length", dest="epoch_length", type=int,
                    default=d.epoch_length)
     g.add_argument("--vpermit-stake-limit", dest="vpermit_stake_limit",
@@ -142,6 +159,14 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g = p.add_argument_group("cadence")
     g.add_argument("--send-interval", dest="send_interval", type=float,
                    default=d.send_interval)
+    if role == "miner":  # only the miner wires a CheckpointStore today
+        g.add_argument("--checkpoint-interval", dest="checkpoint_interval",
+                       type=float, default=d.checkpoint_interval,
+                       help="seconds between local Orbax checkpoints; "
+                            "0 disables")
+        g.add_argument("--checkpoint-dir", dest="checkpoint_dir",
+                       default=None,
+                       help="default: <work_dir>/checkpoints/<hotkey>")
     g.add_argument("--check-update-interval", dest="check_update_interval",
                    type=float, default=d.check_update_interval)
     g.add_argument("--validation-interval", dest="validation_interval",

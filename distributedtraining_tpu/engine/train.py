@@ -130,6 +130,39 @@ class TrainEngine:
                                         self.mesh)
         return jax.jit(self.tx.init, out_shardings=shardings)(params)
 
+    def abstract_state(self) -> TrainState:
+        """Shape/dtype skeleton of a TrainState with zero device allocation
+        (restore templates — building a concrete state just to strip it would
+        briefly double peak HBM on large models). On a mesh engine the
+        skeleton carries the engine's shardings so the checkpoint store
+        restores directly sharded — materializing the full unsharded tree
+        first would OOM exactly the models FSDP exists to fit."""
+        params = jax.eval_shape(
+            lambda: self.model.init_params(jax.random.PRNGKey(0)))
+        opt_state = jax.eval_shape(self.tx.init, params)
+        if self._param_shardings is not None:
+            attach = lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                       sharding=s)
+            params = jax.tree_util.tree_map(attach, params,
+                                            self._param_shardings)
+            opt_state = jax.tree_util.tree_map(
+                attach, opt_state,
+                opt_state_shardings(opt_state, self._param_shardings,
+                                    self.mesh))
+        return TrainState(step=jax.ShapeDtypeStruct((), jnp.int32),
+                          params=params, opt_state=opt_state)
+
+    def place_opt_state(self, opt_state):
+        """Re-place a restored optimizer state on this engine's mesh (restored
+        arrays come back unsharded from the checkpoint store; feeding them to
+        the jitted step raw would replicate full moments per device)."""
+        if self.mesh is None or self._param_shardings is None:
+            return jax.tree_util.tree_map(jnp.asarray, opt_state)
+        abstract = jax.eval_shape(lambda x: x, opt_state)
+        shardings = opt_state_shardings(abstract, self._param_shardings,
+                                        self.mesh)
+        return jax.tree_util.tree_map(jax.device_put, opt_state, shardings)
+
     def place_batch(self, batch: dict) -> dict:
         if self._batch_sharding is None:
             return batch
@@ -179,7 +212,9 @@ class MinerLoop:
                  check_update_interval: float = 300.0,
                  metrics=None,
                  log_every: int = 1000,               # ref :394-402
-                 nan_guard: bool = True):
+                 nan_guard: bool = True,
+                 checkpoint_store=None,
+                 checkpoint_interval: float = 600.0):
         self.engine = engine
         self.transport = transport
         self.miner_id = miner_id
@@ -187,6 +222,7 @@ class MinerLoop:
         self.metrics = metrics
         self.log_every = log_every
         self.nan_guard = nan_guard
+        self.checkpoint_store = checkpoint_store
         self.report = MinerReport()
 
         self.state: TrainState | None = None
@@ -198,10 +234,24 @@ class MinerLoop:
                                            self._check_pull, self.clock)
         self._push_action = PeriodicAction(send_interval, self._push_delta,
                                            self.clock)
+        self._last_ckpt_key = None
+        self._ckpt_action = None
+        if checkpoint_store is not None:
+            self._ckpt_action = PeriodicAction(checkpoint_interval,
+                                               self._save_checkpoint,
+                                               self.clock)
 
     # -- base model lifecycle ----------------------------------------------
     def bootstrap(self, rng: jax.Array | None = None) -> None:
-        """Pull the published base if one exists, else self-initialize."""
+        """Resume from a local checkpoint if one exists; else pull the
+        published base if one exists; else self-initialize.
+
+        The checkpoint path is strictly better than the reference's restart
+        behavior (it preserves optimizer moments across a preemption); the
+        base-pull path matches the reference (fresh optimizer,
+        training_manager.py:371-377)."""
+        if self._restore_checkpoint(rng):
+            return
         fetched = None
         template = self.engine.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
         if self.transport.base_revision() is not None:
@@ -231,6 +281,69 @@ class MinerLoop:
         self._base_revision = rev
         self._last_base_time = self.clock.now()
         self.report.base_pulls += 1
+
+    # -- local checkpoint/resume (checkpoint.py) ----------------------------
+    def _save_checkpoint(self) -> None:
+        if self.checkpoint_store is None or self.state is None:
+            return
+        from ..checkpoint import Snapshot
+        key = (int(self.state.step), self._base_revision)
+        if key == self._last_ckpt_key:  # nothing new (e.g. flush right after
+            return                      # a periodic save on the final step)
+        if self.nan_guard and (delta_lib.has_nonfinite(self.state.params)
+                               or delta_lib.has_nonfinite(self.state.opt_state)):
+            # never persist a poisoned state: restore prefers the checkpoint,
+            # so saving NaNs would wedge the miner across restarts and lose
+            # the restart-recovers-from-base escape hatch. Optimizer moments
+            # can overflow a step before params do, so both are screened.
+            logger.warning("miner %s: state non-finite, not checkpointing",
+                           self.miner_id)
+            return
+        try:
+            self.checkpoint_store.save(
+                self.checkpoint_store.next_step(),
+                Snapshot(state=self.state, base_params=self.base_params,
+                         base_revision=self._base_revision,
+                         lifetime_steps=self.report.steps))
+            self._last_ckpt_key = key
+        except Exception:  # a failed save must not kill training
+            logger.exception("miner %s: checkpoint save failed", self.miner_id)
+
+    def _restore_checkpoint(self, rng) -> bool:
+        if self.checkpoint_store is None:
+            return False
+        if self.checkpoint_store.latest_step() is None:
+            return False
+        from ..checkpoint import Snapshot
+        abstract = self.engine.abstract_state()
+        template = Snapshot(state=abstract, base_params=abstract.params,
+                            base_revision=None)
+        snap = self.checkpoint_store.restore(template)
+        if snap is None:
+            return False
+        self.state = TrainState(
+            step=jnp.asarray(snap.state.step, jnp.int32),
+            params=self.engine.place_params(snap.state.params),
+            opt_state=self.engine.place_opt_state(snap.state.opt_state))
+        self.base_params = _snapshot(self.engine.place_params(snap.base_params))
+        self._base_revision = snap.base_revision
+        # lifetime counter drives metrics step numbering; falling back to the
+        # in-base step would replay step numbers into the sink after a resume
+        self.report.steps = (snap.lifetime_steps
+                             if snap.lifetime_steps is not None
+                             else int(self.state.step))
+        self._last_ckpt_key = (int(self.state.step), self._base_revision)
+        logger.info("miner %s: resumed from checkpoint at step %d "
+                    "(lifetime %d)", self.miner_id, int(self.state.step),
+                    self.report.steps)
+        # the published base may have moved while we were down — resuming
+        # against a superseded revision would push deltas the validator
+        # applies to the wrong base
+        if self.transport.base_revision() not in (None, self._base_revision):
+            logger.info("miner %s: base moved while preempted, pulling",
+                        self.miner_id)
+            self._check_pull()
+        return True
 
     def _push_delta(self) -> None:
         if self.state is None:
@@ -266,8 +379,11 @@ class MinerLoop:
                      "staleness_s": self.clock.now() - self._last_base_time},
                     step=self.report.steps)
             self._push_action.poll()
+            if self._ckpt_action is not None:
+                self._ckpt_action.poll()
         return self.report
 
     def flush(self) -> None:
-        """Force a delta push now (end-of-run)."""
+        """Force a delta push (and checkpoint, if configured) now."""
         self._push_delta()
+        self._save_checkpoint()

@@ -43,8 +43,11 @@ def main(argv=None) -> int:
                         address_store=c.address_store,
                         metrics=c.metrics)
     loop.bootstrap()
-    merged = loop.run_periodic(interval=cfg.averaging_interval,
-                               rounds=cfg.rounds)
+    try:
+        merged = loop.run_periodic(interval=cfg.averaging_interval,
+                                   rounds=cfg.rounds)
+    except KeyboardInterrupt:
+        merged = loop.report.rounds > 0
     logging.info("averager done: rounds=%d accepted=%d rejected=%d loss=%.4f",
                  loop.report.rounds, loop.report.last_accepted,
                  loop.report.last_rejected, loop.report.last_loss)

@@ -101,11 +101,29 @@ def build(cfg: RunConfig) -> Components:
     else:
         transport = LocalFSTransport(os.path.join(cfg.work_dir, "artifacts"))
 
-    chain_dir = os.path.join(cfg.work_dir, "chain")
-    chain = LocalChain(chain_dir, my_hotkey=cfg.hotkey,
-                       epoch_length=cfg.epoch_length,
-                       vpermit_stake_limit=cfg.vpermit_stake_limit)
-    address_store = LocalAddressStore(chain_dir)
+    if cfg.chain == "bittensor":
+        from distributedtraining_tpu.chain import (BittensorAddressStore,
+                                                   BittensorChain)
+        chain = BittensorChain(netuid=cfg.netuid,
+                               wallet_name=cfg.wallet_name,
+                               wallet_hotkey=cfg.wallet_hotkey,
+                               network=cfg.subtensor_network,
+                               epoch_length=cfg.epoch_length)
+        address_store = BittensorAddressStore(chain.subtensor, cfg.netuid,
+                                              wallet=chain.wallet)
+    else:
+        if cfg.backend == "hf":
+            # deltas would flow through the Hub while scores stay in a
+            # machine-local JSON no other participant can read
+            logger.warning(
+                "--backend hf with --chain local: chain state (scores, "
+                "weights, repo registry) is local to this machine; use "
+                "--chain bittensor for a multi-host deployment")
+        chain_dir = os.path.join(cfg.work_dir, "chain")
+        chain = LocalChain(chain_dir, my_hotkey=cfg.hotkey,
+                           epoch_length=cfg.epoch_length,
+                           vpermit_stake_limit=cfg.vpermit_stake_limit)
+        address_store = LocalAddressStore(chain_dir)
     if cfg.my_repo_id:
         # advertise our repo like the reference miner does on-chain
         # (neurons/miner.py:36-44)

@@ -27,16 +27,30 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(message)s")
     cfg = RunConfig.from_args("miner", argv)
     c = build(cfg)
+
+    store = None
+    if cfg.checkpoint_interval > 0:
+        from distributedtraining_tpu.checkpoint import CheckpointStore
+        ckpt_dir = cfg.checkpoint_dir or os.path.join(
+            cfg.work_dir, "checkpoints", cfg.hotkey)
+        store = CheckpointStore(ckpt_dir)
+
     loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
                      send_interval=cfg.send_interval,
                      check_update_interval=cfg.check_update_interval,
-                     metrics=c.metrics)
-    loop.bootstrap()
+                     metrics=c.metrics,
+                     checkpoint_store=store,
+                     checkpoint_interval=cfg.checkpoint_interval)
     try:
+        loop.bootstrap()
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)
+        loop.flush()  # final delta + checkpoint so short runs still publish
     except KeyboardInterrupt:
         report = loop.report
-    loop.flush()  # final delta so short runs still publish
+        loop.flush()
+    finally:
+        if store is not None:
+            store.close()
     logging.info("miner done: steps=%d pushes=%d base_pulls=%d loss=%.4f",
                  report.steps, report.pushes, report.base_pulls,
                  report.last_loss)

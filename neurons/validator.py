@@ -31,8 +31,12 @@ def main(argv=None) -> int:
                           eval_batches=c.eval_batches(),
                           metrics=c.metrics)
     validator.bootstrap()
-    ok = validator.run_periodic(interval=cfg.validation_interval,
-                                rounds=cfg.rounds)
+    try:
+        ok = validator.run_periodic(interval=cfg.validation_interval,
+                                    rounds=cfg.rounds)
+    except KeyboardInterrupt:
+        logging.info("validator interrupted; exiting")
+        return 0
     return 0 if ok else 1
 
 

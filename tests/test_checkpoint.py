@@ -1,0 +1,249 @@
+"""Checkpoint/resume: Orbax round-trip + miner preemption recovery.
+
+The reference has no local checkpointing (HF Hub is its only store,
+SURVEY.md §5); these tests cover the stronger guarantee this framework adds —
+a preempted miner resumes with optimizer moments, base snapshot, and base
+revision intact.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.checkpoint import CheckpointStore, Snapshot
+from distributedtraining_tpu.data import ByteTokenizer, batch_iterator, text_corpus
+from distributedtraining_tpu.engine import FakeClock, MinerLoop, TrainEngine
+from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.transport import InMemoryTransport
+
+SEQ = 32
+BATCH = 4
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model, cfg = gpt2.make_model("tiny")
+    engine = TrainEngine(model, seq_len=SEQ)
+    tok = ByteTokenizer()
+    docs = text_corpus(split="train", n_docs=24, source="synthetic")
+
+    def batches():
+        return batch_iterator(docs, tok, batch_size=BATCH, seq_len=SEQ,
+                              repeat=True, max_vocab=cfg.vocab_size)
+
+    return model, cfg, engine, batches
+
+
+def _tree_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+def test_store_round_trip(tmp_path, setup):
+    model, cfg, engine, _ = setup
+    state = engine.init_state(jax.random.PRNGKey(1))
+    snap = Snapshot(state=state, base_params=state.params,
+                    base_revision="rev-abc")
+
+    with CheckpointStore(str(tmp_path / "ckpt")) as store:
+        assert store.latest_step() is None
+        store.save(0, snap)
+        assert store.latest_step() == 0
+
+        template = Snapshot(state=engine.init_state(jax.random.PRNGKey(2)),
+                            base_params=model.init_params(jax.random.PRNGKey(2)),
+                            base_revision=None)
+        restored = store.restore(template)
+
+    assert restored.base_revision == "rev-abc"
+    assert _tree_equal(restored.state.params, snap.state.params)
+    assert _tree_equal(restored.state.opt_state, snap.state.opt_state)
+    assert _tree_equal(restored.base_params, snap.base_params)
+
+
+def test_store_retention_gc(tmp_path, setup):
+    model, cfg, engine, _ = setup
+    state = engine.init_state(jax.random.PRNGKey(1))
+    snap = Snapshot(state=state, base_params=state.params, base_revision=None)
+    with CheckpointStore(str(tmp_path / "ckpt"), max_to_keep=2) as store:
+        for step in (1, 2, 3, 4):
+            store.save(step, snap)
+        assert store.all_steps() == [3, 4]
+        assert store.latest_step() == 4
+
+
+def test_miner_resume_after_preemption(tmp_path, setup):
+    model, cfg, engine, batches = setup
+    transport = InMemoryTransport()
+    ckpt_dir = str(tmp_path / "miner-ckpt")
+
+    clock = FakeClock()
+    with CheckpointStore(ckpt_dir) as store:
+        miner = MinerLoop(engine, transport, "m0", clock=clock,
+                          send_interval=1e9, check_update_interval=1e9,
+                          checkpoint_store=store, checkpoint_interval=1e9)
+        miner.bootstrap(jax.random.PRNGKey(0))
+        miner.run(batches(), max_steps=7)
+        miner.flush()  # checkpoint + delta push
+        params_before = jax.device_get(miner.state.params)
+        opt_before = jax.device_get(miner.state.opt_state)
+
+    # "preemption": a brand-new process (fresh loop + store)
+    with CheckpointStore(ckpt_dir) as store2:
+        miner2 = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                           send_interval=1e9, check_update_interval=1e9,
+                           checkpoint_store=store2, checkpoint_interval=1e9)
+        miner2.bootstrap(jax.random.PRNGKey(99))  # rng must NOT matter
+        assert int(miner2.state.step) == 7
+        assert miner2.report.steps == 7
+        assert _tree_equal(miner2.state.params, params_before)
+        assert _tree_equal(miner2.state.opt_state, opt_before)
+        # resumed miner keeps training from where it left off
+        miner2.run(batches(), max_steps=3)
+        assert int(miner2.state.step) == 10
+        # and its delta base survived: delta = params - base is nonzero
+        d = jax.tree_util.tree_leaves(miner2.state.params)
+        b = jax.tree_util.tree_leaves(miner2.base_params)
+        assert any(not np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(d, b))
+
+
+def test_resume_after_base_pull_step_reset(tmp_path, setup):
+    """Checkpoint keys must stay monotonic across base pulls: the training
+    step resets to 0 on every base update, so a step-keyed store would
+    resolve 'latest' to a stale pre-reset checkpoint."""
+    model, cfg, engine, batches = setup
+    transport = InMemoryTransport()
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    clock = FakeClock()
+    with CheckpointStore(ckpt_dir) as store:
+        miner = MinerLoop(engine, transport, "m0", clock=clock,
+                          send_interval=1e9, check_update_interval=1e9,
+                          checkpoint_store=store, checkpoint_interval=1e9)
+        miner.bootstrap(jax.random.PRNGKey(0))
+        miner.run(batches(), max_steps=9)
+        miner.flush()  # seq 0: step 9, no base revision
+
+        # operator publishes a new base -> miner pulls, step resets to 0
+        new_base = model.init_params(jax.random.PRNGKey(7))
+        transport.publish_base(new_base)
+        clock.advance(2e9)
+        miner._check_pull()
+        assert int(miner.state.step) == 0
+        miner.run(batches(), max_steps=3)  # periodic action also fires here
+        miner.flush()  # newest save: step 3 < 9, against the NEW base
+        new_rev = miner._base_revision
+        assert new_rev is not None
+        # flush right after a save with identical content must not duplicate
+        n_saves = len(store.all_steps())
+        miner.flush()
+        assert len(store.all_steps()) == n_saves
+
+    with CheckpointStore(ckpt_dir) as store2:
+        miner2 = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                           send_interval=1e9, check_update_interval=1e9,
+                           checkpoint_store=store2, checkpoint_interval=1e9)
+        miner2.bootstrap(jax.random.PRNGKey(0))
+        # must resume the NEWEST save (post-base-pull), not the highest step
+        assert int(miner2.state.step) == 3
+        assert miner2._base_revision == new_rev
+
+
+def test_resume_on_mesh_replaces_shardings(tmp_path, setup, devices):
+    """Restored params AND optimizer moments must be re-placed per the mesh
+    sharding rules — raw restored arrays are unsharded and would replicate
+    full moments on every device."""
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+    model, cfg, _, batches = setup
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2), devices=devices)
+    engine = TrainEngine(model, mesh=mesh, seq_len=SEQ)
+    transport = InMemoryTransport()
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    with CheckpointStore(ckpt_dir) as store:
+        miner = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                          send_interval=1e9, check_update_interval=1e9,
+                          checkpoint_store=store, checkpoint_interval=1e9)
+        miner.bootstrap(jax.random.PRNGKey(0))
+        expected_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, miner.state.opt_state)
+        miner.run(batches(), max_steps=2)
+        miner.flush()
+
+    with CheckpointStore(ckpt_dir) as store2:
+        miner2 = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                           send_interval=1e9, check_update_interval=1e9,
+                           checkpoint_store=store2, checkpoint_interval=1e9)
+        miner2.bootstrap(jax.random.PRNGKey(0))
+        assert int(miner2.state.step) == 2
+        restored_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, miner2.state.opt_state)
+        for want, got in zip(jax.tree_util.tree_leaves(expected_shardings),
+                             jax.tree_util.tree_leaves(restored_shardings)):
+            assert want == got, (want, got)
+        # and it keeps training on the mesh
+        for i, b in enumerate(batches()):
+            if i >= 2:
+                break
+            miner2.state, m = engine.train_step(miner2.state,
+                                                engine.place_batch(b))
+        assert np.isfinite(float(m["loss"]))
+
+
+def test_nan_state_never_checkpointed(tmp_path, setup):
+    """A NaN'd miner must stay recoverable by restart: persisting poisoned
+    params would wedge it forever (restore prefers the checkpoint)."""
+    model, cfg, engine, batches = setup
+    with CheckpointStore(str(tmp_path / "ckpt")) as store:
+        miner = MinerLoop(engine, InMemoryTransport(), "m0", clock=FakeClock(),
+                          send_interval=1e9, check_update_interval=1e9,
+                          checkpoint_store=store, checkpoint_interval=1e9)
+        miner.bootstrap(jax.random.PRNGKey(0))
+        miner.run(batches(), max_steps=2)
+        poisoned = jax.tree_util.tree_map(
+            lambda x: jnp.full_like(x, jnp.nan), miner.state.params)
+        miner.state = miner.state.replace(params=poisoned)
+        miner.flush()
+        assert store.latest_step() is None  # nothing persisted
+
+
+def test_resume_pulls_when_base_moved(tmp_path, setup):
+    """A miner that was down while the averager published a new base must
+    pull it at resume, not push deltas against the superseded revision."""
+    model, cfg, engine, batches = setup
+    transport = InMemoryTransport()
+    ckpt_dir = str(tmp_path / "ckpt")
+
+    with CheckpointStore(ckpt_dir) as store:
+        miner = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                          send_interval=1e9, check_update_interval=1e9,
+                          checkpoint_store=store, checkpoint_interval=1e9)
+        miner.bootstrap(jax.random.PRNGKey(0))
+        miner.run(batches(), max_steps=5)
+        miner.flush()
+
+    # while the miner is down: new base published
+    transport.publish_base(model.init_params(jax.random.PRNGKey(7)))
+
+    with CheckpointStore(ckpt_dir) as store2:
+        miner2 = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                           send_interval=1e9, check_update_interval=1e9,
+                           checkpoint_store=store2, checkpoint_interval=1e9)
+        miner2.bootstrap(jax.random.PRNGKey(0))
+        assert miner2.report.base_pulls == 1
+        assert miner2._base_revision == transport.base_revision()
+        assert int(miner2.state.step) == 0  # fresh optimizer on the new base
+        assert miner2.report.steps == 5     # lifetime counter survives
+
+
+def test_restore_empty_store_returns_none(tmp_path, setup):
+    model, cfg, engine, _ = setup
+    with CheckpointStore(str(tmp_path / "empty")) as store:
+        template = Snapshot(state=engine.init_state(jax.random.PRNGKey(0)),
+                            base_params=None, base_revision=None)
+        assert store.restore(template) is None
