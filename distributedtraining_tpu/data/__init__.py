@@ -10,6 +10,8 @@ fully static shapes.
 
 from .packing import pack_documents, PackedBatch
 from .datasets import ByteTokenizer, load_tokenizer, text_corpus, batch_iterator
+from .vision import image_batches, synthetic_images
 
 __all__ = ["pack_documents", "PackedBatch", "ByteTokenizer", "load_tokenizer",
-           "text_corpus", "batch_iterator"]
+           "text_corpus", "batch_iterator", "image_batches",
+           "synthetic_images"]

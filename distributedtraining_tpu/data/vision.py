@@ -1,0 +1,51 @@
+"""Synthetic image-classification data for the toy smoke path.
+
+The reference smoke-tests its engines on torchvision MNIST
+(hivetrain/training_manager.py:472-486); this environment has no download
+path, so the stand-in is a deterministic generative task of comparable
+difficulty: each class is a fixed random spatial template, each example a
+noisy draw of its class template. Linearly separable enough that the toy
+nets (models/toy.py) reach high accuracy in a few hundred steps, noisy
+enough that accuracy actually has to be learned.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Iterator
+
+import numpy as np
+
+
+def synthetic_images(*, n_classes: int = 10, image_size: int = 28,
+                     noise: float = 0.6, seed: int = 0):
+    """Returns (templates, sampler): class templates [C, H, W, 1] and a
+    ``sampler(rng, n) -> (images, labels)`` draw function."""
+    rng = np.random.default_rng(seed)
+    templates = rng.normal(0.0, 1.0,
+                           (n_classes, image_size, image_size, 1)
+                           ).astype(np.float32)
+
+    def sampler(draw_rng: np.random.Generator, n: int):
+        labels = draw_rng.integers(0, n_classes, n)
+        images = templates[labels] + draw_rng.normal(
+            0.0, noise, (n, image_size, image_size, 1)).astype(np.float32)
+        return images, labels.astype(np.int32)  # sum is already float32
+
+    return templates, sampler
+
+
+def image_batches(*, batch_size: int = 32, n_classes: int = 10,
+                  image_size: int = 28, noise: float = 0.6,
+                  seed: int = 0, split: str = "train"
+                  ) -> Iterator[dict]:
+    """Endless batch stream {"images": [B,H,W,1] f32, "labels": [B] i32}.
+    ``split`` seeds the draw stream so train/val/test never overlap."""
+    _, sampler = synthetic_images(n_classes=n_classes, image_size=image_size,
+                                  noise=noise, seed=seed)
+    # crc32, not hash(): the split->stream mapping must survive process
+    # restarts (hash() is salted per interpreter)
+    draw = np.random.default_rng(zlib.crc32(split.encode()) + seed)
+    while True:
+        images, labels = sampler(draw, batch_size)
+        yield {"images": images, "labels": labels}

@@ -56,11 +56,32 @@ def default_optimizer(learning_rate: float = 5e-4,
     return tx
 
 
+def _default_lm_loss(model, params, batch):
+    logits = model.apply(
+        {"params": params}, batch["input_ids"],
+        attention_mask=batch.get("attention_mask"),
+        segment_ids=batch.get("segment_ids"),
+        position_ids=batch.get("position_ids"))
+    return causal_lm_loss(logits, batch["input_ids"], batch.get("loss_mask"))
+
+
 class TrainEngine:
     """Owns the jitted step functions for one model + optimizer."""
 
     def __init__(self, model, *, optimizer: optax.GradientTransformation | None = None,
-                 mesh=None, seq_len: int = 8):
+                 mesh=None, seq_len: int = 8,
+                 loss_fn: Callable | None = None):
+        """``loss_fn(model, params, batch) -> (mean_loss, count)`` overrides
+        the causal-LM default — the toy classification harnesses
+        (models/toy.py + ops.losses.classification_loss) plug in here. The
+        jit/delta/transport facilities are task-agnostic; the *sharding*
+        rules are not (they assume [B, T] token batches and LM parameter
+        axes), so a mesh cannot be combined with a custom loss_fn."""
+        if mesh is not None and loss_fn is not None:
+            raise ValueError(
+                "mesh sharding assumes causal-LM batches ([B, T] input_ids) "
+                "and LM parameter axis names; run custom-loss models "
+                "unsharded (mesh=None)")
         self.model = model
         self.tx = optimizer or default_optimizer()
         self.mesh = mesh
@@ -76,14 +97,10 @@ class TrainEngine:
                 from ..ops.ring_attention import set_ring_mesh
                 set_ring_mesh(mesh)
 
+        task_loss = loss_fn or _default_lm_loss
+
         def loss_fn(params, batch):
-            logits = model.apply(
-                {"params": params}, batch["input_ids"],
-                attention_mask=batch.get("attention_mask"),
-                segment_ids=batch.get("segment_ids"),
-                position_ids=batch.get("position_ids"))
-            return causal_lm_loss(logits, batch["input_ids"],
-                                  batch.get("loss_mask"))
+            return task_loss(model, params, batch)
 
         def train_step(state: TrainState, batch):
             (loss, tokens), grads = jax.value_and_grad(

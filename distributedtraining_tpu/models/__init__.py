@@ -9,6 +9,8 @@
 
 from .gpt2 import GPT2, GPT2Config
 from .llama import Llama, LlamaConfig
+from .toy import FeedforwardNet, SimpleCNN, ToyConfig
 from . import lora
 
-__all__ = ["GPT2", "GPT2Config", "Llama", "LlamaConfig", "lora"]
+__all__ = ["GPT2", "GPT2Config", "Llama", "LlamaConfig",
+           "FeedforwardNet", "SimpleCNN", "ToyConfig", "lora"]

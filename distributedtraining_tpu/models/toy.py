@@ -1,0 +1,69 @@
+"""Toy models: the framework's smoke-test workloads.
+
+The reference uses MNIST + small nets as live smoke tests of all three
+engines — ``FeedforwardNN`` (hivetrain/training_manager.py:440-459),
+``SimpleCNN`` (hivetrain/new_training_manager.py:173-189), and the
+MNIST train/validate/average harnesses (training_manager.py:462-803,
+validation_logic.py:265-318). These are their Flax counterparts, exposing
+the same ``init_params`` surface as models/gpt2.py so every engine, the
+delta algebra, and the transports work on them unchanged.
+
+Paired with data/vision.py (synthetic, dependency-free image classes —
+this image has no MNIST download path) and ops/losses.classification_loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ToyConfig:
+    image_size: int = 28
+    channels: int = 1
+    n_classes: int = 10
+    hidden: int = 128
+
+
+class FeedforwardNet(nn.Module):
+    """flatten -> dense(relu) -> dense logits (FeedforwardNN parity,
+    training_manager.py:440-459)."""
+    cfg: ToyConfig = ToyConfig()
+
+    @nn.compact
+    def __call__(self, images, **_):
+        x = images.reshape(images.shape[0], -1)
+        x = nn.relu(nn.Dense(self.cfg.hidden, name="fc1")(x))
+        return nn.Dense(self.cfg.n_classes, name="out")(x)
+
+    def init_params(self, rng, **_):
+        c = self.cfg
+        dummy = jnp.zeros((1, c.image_size, c.image_size, c.channels),
+                          jnp.float32)
+        return self.init(rng, dummy)["params"]
+
+
+class SimpleCNN(nn.Module):
+    """conv(relu,pool) x2 -> dense (SimpleCNN parity,
+    new_training_manager.py:173-189)."""
+    cfg: ToyConfig = ToyConfig()
+
+    @nn.compact
+    def __call__(self, images, **_):
+        x = nn.relu(nn.Conv(16, (3, 3), name="conv1")(images))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(32, (3, 3), name="conv2")(x))
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(self.cfg.hidden, name="fc")(x))
+        return nn.Dense(self.cfg.n_classes, name="out")(x)
+
+    def init_params(self, rng, **_):
+        c = self.cfg
+        dummy = jnp.zeros((1, c.image_size, c.image_size, c.channels),
+                          jnp.float32)
+        return self.init(rng, dummy)["params"]

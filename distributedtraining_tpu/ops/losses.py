@@ -51,3 +51,18 @@ def causal_lm_loss(logits: jax.Array, input_ids: jax.Array,
 def perplexity(mean_loss: jax.Array) -> jax.Array:
     """The validator's second metric (hivetrain/validation_logic.py:93-97)."""
     return jnp.exp(mean_loss)
+
+
+def classification_loss(logits: jax.Array, labels: jax.Array
+                        ) -> tuple[jax.Array, jax.Array]:
+    """Mean CE for the toy classification harnesses (the reference's MNIST
+    smoke path, hivetrain/training_manager.py:462-644). logits [B, C],
+    labels [B] int. Returns (mean_loss, example_count) with the same
+    aggregation contract as causal_lm_loss."""
+    per_ex = cross_entropy_with_logits(logits, labels)
+    count = jnp.asarray(per_ex.shape[0], jnp.float32)
+    return jnp.sum(per_ex) / count, count
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
