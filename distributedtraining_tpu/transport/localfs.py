@@ -61,6 +61,16 @@ class LocalFSTransport:
         ser.save_file(delta, path)
         return _hash_file(path)
 
+    def publish_raw(self, miner_id: str, data: bytes) -> Revision:
+        """Arbitrary bytes as a 'delta' — hostile-miner simulation for the
+        admission screens (utils/loadgen.py)."""
+        path = self._delta_path(miner_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return _hash_file(path)
+
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
         path = self._delta_path(miner_id)

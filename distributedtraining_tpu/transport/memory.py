@@ -25,6 +25,13 @@ class InMemoryTransport:
         self._deltas[miner_id] = ser.to_msgpack(delta)
         return self.delta_revision(miner_id)
 
+    def publish_raw(self, miner_id: str, data: bytes) -> Revision:
+        """Arbitrary bytes as a 'delta' — hostile-miner simulation for the
+        admission screens (utils/loadgen.py); a real adversary is not
+        obliged to run our serializer."""
+        self._deltas[miner_id] = bytes(data)
+        return self.delta_revision(miner_id)
+
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
         data = self._deltas.get(miner_id)

@@ -1,7 +1,21 @@
-"""Cross-cutting utilities: timeouts, metrics, logging."""
+"""Cross-cutting utilities: timeouts, metrics, identity, lifecycle, load."""
 
 from .timeout import ChainTimeout, run_with_timeout
 from .metrics import MetricsSink, InMemorySink, JSONLSink, multi_sink
+from .auto_update import AutoUpdater, file_version, git_remote_version
 
 __all__ = ["ChainTimeout", "run_with_timeout",
-           "MetricsSink", "InMemorySink", "JSONLSink", "multi_sink"]
+           "MetricsSink", "InMemorySink", "JSONLSink", "multi_sink",
+           "Identity", "generate_wallets", "load_wallets",
+           "AutoUpdater", "file_version", "git_remote_version"]
+
+_IDENTITY_NAMES = {"Identity", "generate_wallets", "load_wallets"}
+
+
+def __getattr__(name):
+    # identity.py needs the third-party `cryptography` package; importing it
+    # lazily keeps the role entry points runnable on boxes without it
+    if name in _IDENTITY_NAMES:
+        from . import identity
+        return getattr(identity, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

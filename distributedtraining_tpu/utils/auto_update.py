@@ -1,0 +1,111 @@
+"""Auto-update: version polling + self-restart.
+
+Reference parity: `hivetrain/utils/auto_update.py:6-60` polls the version
+constant on GitHub (`template/__init__.py:24-27`), and the pm2 watchdogs in
+`run_miner.sh:229-268` re-clone and restart the process when the published
+version moves. Here the same lifecycle is a small, injectable component:
+
+- ``version_source`` is any zero-arg callable returning the *published*
+  version string (git-remote polling and file polling ship below; an HTTP
+  source is a one-liner for deployments that have one).
+- on mismatch, ``update_cmd`` runs (e.g. ``git pull --ff-only``) and the
+  process re-execs itself in place (``os.execv``), which under pm2-style
+  supervision (scripts/run_*.sh) is a clean restart into the new code.
+
+Nothing here touches JAX state: re-exec happens between engine steps, and a
+failed poll/update never interrupts training.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+from typing import Callable, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+def git_remote_version(repo_dir: str, *, ref: str = "origin/main",
+                       version_file: str = "distributedtraining_tpu/__init__.py"
+                       ) -> Optional[str]:
+    """Published version = __version__ in ``version_file`` at ``ref`` after a
+    fetch. Returns None when the remote is unreachable (air-gapped boxes keep
+    running on their local version)."""
+    try:
+        subprocess.run(["git", "fetch", "--quiet"], cwd=repo_dir, check=True,
+                       timeout=60, capture_output=True)
+        blob = subprocess.run(
+            ["git", "show", f"{ref}:{version_file}"], cwd=repo_dir,
+            check=True, timeout=10, capture_output=True, text=True).stdout
+    except (subprocess.SubprocessError, OSError):
+        return None
+    return parse_version(blob)
+
+
+def file_version(path: str) -> Optional[str]:
+    """Published version from a shared file (operator drops a new version
+    string to trigger a fleet restart)."""
+    try:
+        with open(path) as f:
+            blob = f.read()
+    except OSError:
+        return None
+    return parse_version(blob) or blob.strip() or None
+
+
+def parse_version(blob: str) -> Optional[str]:
+    for line in blob.splitlines():
+        line = line.strip()
+        if line.startswith("__version__"):
+            return line.split("=", 1)[1].strip().strip("\"'")
+    blob = blob.strip()
+    # a bare "x.y.z" file is also accepted
+    if blob and all(p.isdigit() for p in blob.split(".")) and "." in blob:
+        return blob
+    return None
+
+
+class AutoUpdater:
+    """Poll ``version_source``; when it differs from ``current_version``, run
+    ``update_cmd`` and re-exec. Designed to be driven by a PeriodicAction in
+    the role loops or by the supervision scripts' restart cycle."""
+
+    def __init__(self, current_version: str,
+                 version_source: Callable[[], Optional[str]], *,
+                 update_cmd: Sequence[str] | None = ("git", "pull",
+                                                     "--ff-only"),
+                 repo_dir: str = ".",
+                 restart: Callable[[], None] | None = None):
+        self.current_version = current_version
+        self.version_source = version_source
+        self.update_cmd = list(update_cmd) if update_cmd else None
+        self.repo_dir = repo_dir
+        self.restart = restart if restart is not None else self._reexec
+
+    def check(self) -> bool:
+        """One poll. Returns True when an update was triggered (the default
+        restart does not return)."""
+        try:
+            published = self.version_source()
+        except Exception:
+            logger.exception("auto-update: version poll failed")
+            return False
+        if published is None or published == self.current_version:
+            return False
+        logger.info("auto-update: %s -> %s", self.current_version, published)
+        if self.update_cmd:
+            try:
+                subprocess.run(self.update_cmd, cwd=self.repo_dir,
+                               check=True, timeout=300, capture_output=True)
+            except (subprocess.SubprocessError, OSError):
+                logger.exception("auto-update: update command failed; "
+                                 "not restarting")
+                return False
+        self.restart()
+        return True
+
+    @staticmethod
+    def _reexec() -> None:  # pragma: no cover — replaces the process image
+        os.execv(sys.executable, [sys.executable] + sys.argv)

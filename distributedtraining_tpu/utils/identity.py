@@ -1,0 +1,117 @@
+"""Participant identities: keypair generation, signing, verification.
+
+The reference leans on Bittensor wallets for identity — mass-generation in
+`hivetrain/utils/generate_wallets.py:9-41`, hotkey-signed metric posts in
+`hivetrain/utils/dummy_miner.py:63-68` (`keypair.sign(message)` verified by
+the receiving validator). This module provides the same capability without
+the bittensor SDK: Ed25519 keypairs (via the `cryptography` package), a
+hotkey string derived from the public key, JSON-file wallet storage, and
+detached sign/verify over arbitrary payload bytes.
+
+When the bittensor chain backend is active, its ss58 wallets take over;
+these identities serve the local/HF deployments and the load-generation
+tooling (utils/loadgen.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Optional
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey, Ed25519PublicKey)
+
+
+def _hotkey_from_public(pub_bytes: bytes) -> str:
+    """Short, stable, human-greppable id: 'hk' + 20 hex chars of SHA-256."""
+    return "hk" + hashlib.sha256(pub_bytes).hexdigest()[:20]
+
+
+@dataclasses.dataclass
+class Identity:
+    hotkey: str
+    public_bytes: bytes
+    _private: Optional[Ed25519PrivateKey] = None
+
+    # -- creation -----------------------------------------------------------
+    @classmethod
+    def generate(cls) -> "Identity":
+        priv = Ed25519PrivateKey.generate()
+        pub = priv.public_key().public_bytes_raw()
+        return cls(hotkey=_hotkey_from_public(pub), public_bytes=pub,
+                   _private=priv)
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "Identity":
+        priv = Ed25519PrivateKey.from_private_bytes(data)
+        pub = priv.public_key().public_bytes_raw()
+        return cls(hotkey=_hotkey_from_public(pub), public_bytes=pub,
+                   _private=priv)
+
+    @classmethod
+    def public_only(cls, pub_bytes: bytes) -> "Identity":
+        return cls(hotkey=_hotkey_from_public(pub_bytes),
+                   public_bytes=pub_bytes)
+
+    # -- signing ------------------------------------------------------------
+    def sign(self, message: bytes) -> bytes:
+        if self._private is None:
+            raise ValueError("public-only identity cannot sign")
+        return self._private.sign(message)
+
+    def verify(self, message: bytes, signature: bytes) -> bool:
+        try:
+            Ed25519PublicKey.from_public_bytes(self.public_bytes).verify(
+                signature, message)
+            return True
+        except InvalidSignature:
+            return False
+
+    # -- storage ------------------------------------------------------------
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        payload = {
+            "hotkey": self.hotkey,
+            "public": self.public_bytes.hex(),
+            "private": self._private.private_bytes_raw().hex()
+            if self._private else None,
+        }
+        tmp = path + ".tmp"
+        # owner-only from birth: the payload holds the private key, so the
+        # tmp file must never exist with umask-default permissions
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Identity":
+        with open(path) as f:
+            payload = json.load(f)
+        if payload.get("private"):
+            ident = cls.from_private_bytes(bytes.fromhex(payload["private"]))
+        else:
+            ident = cls.public_only(bytes.fromhex(payload["public"]))
+        if ident.hotkey != payload["hotkey"]:
+            raise ValueError(f"wallet {path}: hotkey does not match key")
+        return ident
+
+
+def generate_wallets(directory: str, n: int) -> list[Identity]:
+    """Mass-generate n wallets under ``directory`` (generate_wallets.py:9-41
+    parity: the reference loops bt.wallet(...).create)."""
+    idents = []
+    for i in range(n):
+        ident = Identity.generate()
+        ident.save(os.path.join(directory, f"wallet_{i}.json"))
+        idents.append(ident)
+    return idents
+
+
+def load_wallets(directory: str) -> list[Identity]:
+    names = sorted(f for f in os.listdir(directory) if f.endswith(".json"))
+    return [Identity.load(os.path.join(directory, f)) for f in names]

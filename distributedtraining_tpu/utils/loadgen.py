@@ -1,0 +1,104 @@
+"""Load generation: fake miner traffic for stress-testing validators.
+
+Reference parity: `hivetrain/utils/dummy_miner.py:25-82` fakes hotkey-signed
+miner metric posts at validators, and `utils/bootstrap_stress.py:18-48`
+hammers the bootstrap pool. Here the load generator speaks the framework's
+real artifact plane: it mass-publishes plausible (or deliberately hostile)
+weight deltas from many identities, so a validator/averager under test
+exercises its full download -> screen -> score path at scale.
+
+Poison modes map one-to-one onto the admission screens in delta.py /
+serialization.py: "nan" (has_nonfinite), "shape" (shapes_match),
+"huge" (max_abs cap), "garbage" (msgpack structure validation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from .identity import Identity
+
+logger = logging.getLogger(__name__)
+
+POISON_MODES = ("nan", "shape", "huge", "garbage")
+
+
+@dataclasses.dataclass
+class LoadReport:
+    published: int = 0
+    poisoned: int = 0
+    by_mode: dict = dataclasses.field(default_factory=dict)
+
+
+class LoadGenerator:
+    """Publishes synthetic deltas for ``n_miners`` identities."""
+
+    def __init__(self, transport, template_params: Any, *,
+                 n_miners: int = 10, scale: float = 1e-3,
+                 poison_fraction: float = 0.0, seed: int = 0):
+        self.transport = transport
+        self.template = template_params
+        self.identities = [Identity.generate() for _ in range(n_miners)]
+        self.scale = scale
+        self.poison_fraction = poison_fraction
+        self.rng = np.random.default_rng(seed)
+        self.report = LoadReport()
+
+    def _benign_delta(self):
+        return jax.tree_util.tree_map(
+            lambda x: (self.rng.standard_normal(np.shape(x))
+                       * self.scale).astype(np.float32),
+            self.template)
+
+    def _poisoned_delta(self, mode: str):
+        d = self._benign_delta()
+        leaves, treedef = jax.tree_util.tree_flatten(d)
+        if mode == "nan":
+            leaves[0] = leaves[0].copy()
+            leaves[0].flat[0] = np.nan
+        elif mode == "shape":
+            leaves[0] = np.zeros(np.asarray(leaves[0]).shape + (2,),
+                                 np.float32)
+        elif mode == "huge":
+            leaves[0] = leaves[0] + np.float32(1e9)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def publish_round(self) -> LoadReport:
+        """One wave: every identity publishes once; a ``poison_fraction`` of
+        them publish a hostile artifact instead."""
+        n_poison = int(round(self.poison_fraction * len(self.identities)))
+        for i, ident in enumerate(self.identities):
+            if i < n_poison:
+                mode = POISON_MODES[i % len(POISON_MODES)]
+                self.report.poisoned += 1
+                self.report.by_mode[mode] = self.report.by_mode.get(mode, 0) + 1
+                if mode == "garbage":
+                    self._publish_garbage(ident)
+                    continue
+                delta = self._poisoned_delta(mode)
+            else:
+                delta = self._benign_delta()
+            self.transport.publish_delta(ident.hotkey, delta)
+            self.report.published += 1
+        return self.report
+
+    def _publish_garbage(self, ident: Identity) -> None:
+        """Raw malformed bytes, bypassing the serializer (a hostile miner is
+        not obliged to run our code)."""
+        raw = bytes(self.rng.integers(0, 256, 256, dtype=np.uint8))
+        publish_raw = getattr(self.transport, "publish_raw", None)
+        if publish_raw is not None:
+            publish_raw(ident.hotkey, raw)
+            self.report.published += 1
+        else:  # transport without a raw path: wrong-structure tree instead
+            self.transport.publish_delta(ident.hotkey,
+                                         {"junk": np.zeros(7, np.float32)})
+            self.report.published += 1
+
+    def hotkeys(self) -> list[str]:
+        return [i.hotkey for i in self.identities]

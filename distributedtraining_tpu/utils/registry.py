@@ -1,0 +1,127 @@
+"""Peer registry: a liveness-checked rendezvous service.
+
+Reference parity for the bootstrap-server pool
+(`hivetrain/utils/bootstrap_server.py:39-115`): the reference keeps 10 DHT
+addresses behind a Flask app, health-checks them, and hands one to each
+joining peer. The DHT era is dead (hivemind remnants), but the capability —
+"a new node finds live peers without the chain" — is still useful for local
+and HF-transport clusters, so this is the same service rebuilt on the
+stdlib: a threaded HTTP server with TTL-pruned registrations.
+
+Endpoints (JSON):
+  POST /register   {"hotkey": ..., "address": ...} -> {"ok": true}
+  GET  /peers      -> {"peers": [{"hotkey", "address", "age_s"}, ...]}
+  GET  /health     -> {"ok": true, "peers": N}
+
+Client helpers wrap urllib so roles need no HTTP dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+DEFAULT_TTL = 120.0  # seconds a registration stays live without refresh
+
+
+class PeerRegistry:
+    """In-process registry state (also usable directly in tests)."""
+
+    def __init__(self, ttl: float = DEFAULT_TTL):
+        self.ttl = ttl
+        self._peers: dict[str, tuple[str, float]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, hotkey: str, address: str,
+                 now: Optional[float] = None) -> None:
+        with self._lock:
+            self._peers[hotkey] = (address, time.time() if now is None else now)
+
+    def peers(self, now: Optional[float] = None) -> list[dict]:
+        t = time.time() if now is None else now
+        with self._lock:
+            # prune-on-read keeps the server stateless between requests
+            self._peers = {h: (a, ts) for h, (a, ts) in self._peers.items()
+                           if t - ts <= self.ttl}
+            return [{"hotkey": h, "address": a, "age_s": round(t - ts, 1)}
+                    for h, (a, ts) in sorted(self._peers.items())]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: PeerRegistry  # set by serve()
+
+    def _send(self, code: int, obj) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/peers":
+            self._send(200, {"peers": self.registry.peers()})
+        elif self.path == "/health":
+            self._send(200, {"ok": True, "peers": len(self.registry.peers())})
+        else:
+            self._send(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path != "/register":
+            self._send(404, {"error": "not found"})
+            return
+        try:
+            # clamp below 0 too: a hostile Content-Length of -1 would make
+            # read() block until the client hangs up, pinning the thread
+            n = max(0, min(int(self.headers.get("Content-Length", 0)),
+                           1 << 16))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            hotkey, address = str(body["hotkey"]), str(body["address"])
+        except (ValueError, KeyError, TypeError):  # non-dict JSON included
+            self._send(400, {"error": "bad request"})
+            return
+        self.registry.register(hotkey, address)
+        self._send(200, {"ok": True})
+
+    def log_message(self, *args):  # quiet by default
+        pass
+
+
+def serve(host: str = "127.0.0.1", port: int = 0,
+          ttl: float = DEFAULT_TTL) -> tuple[ThreadingHTTPServer, str]:
+    """Start the registry server on a daemon thread; returns (server, url).
+    port=0 picks a free port."""
+    registry = PeerRegistry(ttl=ttl)
+    handler = type("Handler", (_Handler,), {"registry": registry})
+    srv = ThreadingHTTPServer((host, port), handler)
+    srv.registry = registry  # type: ignore[attr-defined]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://{host}:{srv.server_address[1]}"
+
+
+# -- client helpers ----------------------------------------------------------
+
+def register_peer(url: str, hotkey: str, address: str,
+                  timeout: float = 5.0) -> bool:
+    req = urllib.request.Request(
+        url.rstrip("/") + "/register",
+        data=json.dumps({"hotkey": hotkey, "address": address}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.load(resp).get("ok", False)
+    except OSError:
+        return False
+
+
+def get_peers(url: str, timeout: float = 5.0) -> list[dict]:
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/peers",
+                                    timeout=timeout) as resp:
+            return json.load(resp).get("peers", [])
+    except OSError:
+        return []

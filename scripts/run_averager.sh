@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Reference run_averager.sh parity: supervised averager with auto-update.
+exec "$(dirname "$0")/supervise.sh" averager "$@"

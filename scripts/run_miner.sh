@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Reference run_miner.sh parity: supervised miner with auto-update.
+exec "$(dirname "$0")/supervise.sh" miner "$@"

@@ -1,0 +1,3 @@
+#!/usr/bin/env bash
+# Reference run_validator.sh parity: supervised validator with auto-update.
+exec "$(dirname "$0")/supervise.sh" validator "$@"

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Process supervision for the role entry points — pm2-parity without pm2.
+#
+# Reference behavior being reproduced (run_miner.sh:127-268,
+# run_validator.sh:124-266): keep the role process alive with bounded
+# restarts (max_restarts=5 within a window, min_uptime=5m), poll the
+# published version, and restart into updated code when it moves.
+#
+# Usage:  scripts/supervise.sh <miner|validator|averager> [role args...]
+# Env:    MAX_RESTARTS (default 5)   restarts allowed below MIN_UPTIME
+#         MIN_UPTIME_S (default 300) uptime that resets the crash counter
+#         UPDATE_CHECK_S (default 1800) seconds between version polls
+#         NO_AUTO_UPDATE=1           disable the git version poll
+set -u
+
+ROLE="${1:?usage: supervise.sh <miner|validator|averager> [args...]}"
+shift
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+MAX_RESTARTS="${MAX_RESTARTS:-5}"
+MIN_UPTIME_S="${MIN_UPTIME_S:-300}"
+UPDATE_CHECK_S="${UPDATE_CHECK_S:-1800}"
+
+log() { echo "[supervise $(date -u +%FT%TZ)] $*"; }
+
+local_version() {
+  sed -n 's/^__version__ = "\(.*\)"/\1/p' \
+    "$REPO_DIR/distributedtraining_tpu/__init__.py"
+}
+
+remote_version() {
+  git -C "$REPO_DIR" fetch --quiet 2>/dev/null || return 1
+  git -C "$REPO_DIR" show "origin/main:distributedtraining_tpu/__init__.py" \
+    2>/dev/null | sed -n 's/^__version__ = "\(.*\)"/\1/p'
+}
+
+maybe_update() {
+  [ -n "${NO_AUTO_UPDATE:-}" ] && return 1
+  rv="$(remote_version)" || return 1
+  lv="$(local_version)"
+  if [ -n "$rv" ] && [ "$rv" != "$lv" ]; then
+    log "version $lv -> $rv: updating"
+    git -C "$REPO_DIR" pull --ff-only && return 0
+    log "update failed; continuing on $lv"
+  fi
+  return 1
+}
+
+crashes=0
+while :; do
+  start=$(date +%s)
+  log "starting $ROLE (crash count $crashes/$MAX_RESTARTS)"
+  python "$REPO_DIR/neurons/$ROLE.py" "$@" &
+  pid=$!
+
+  # watchdog: poll for updates while the role runs
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep "$UPDATE_CHECK_S" &
+    wait $! 2>/dev/null
+    kill -0 "$pid" 2>/dev/null || break
+    if maybe_update; then
+      log "restarting $ROLE into updated code"
+      kill -TERM "$pid" 2>/dev/null
+      wait "$pid" 2>/dev/null
+      break
+    fi
+  done
+  wait "$pid" 2>/dev/null
+  code=$?
+  uptime=$(( $(date +%s) - start ))
+
+  if [ "$uptime" -ge "$MIN_UPTIME_S" ]; then
+    crashes=0              # pm2 min_uptime semantics: long life resets count
+  else
+    crashes=$((crashes + 1))
+  fi
+  if [ "$crashes" -gt "$MAX_RESTARTS" ]; then
+    log "$ROLE crashed $crashes times under ${MIN_UPTIME_S}s uptime; giving up"
+    exit 1
+  fi
+  log "$ROLE exited code=$code uptime=${uptime}s; restarting in 5s"
+  sleep 5
+done

@@ -1,0 +1,178 @@
+"""Ops/lifecycle subsystems: identity, auto-update, load generation,
+peer registry.
+
+Reference coverage being formalized (SURVEY.md §2.1 "Ops/lifecycle" +
+"Legacy/vestigial" rows): wallet generation (generate_wallets.py), version
+polling + restart (utils/auto_update.py, run_miner.sh:229-268), dummy-miner
+traffic (utils/dummy_miner.py), DHT bootstrap pool (utils/bootstrap_server.py).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.engine import TrainEngine, Validator
+from distributedtraining_tpu.models import FeedforwardNet, ToyConfig
+from distributedtraining_tpu.transport import InMemoryTransport
+from distributedtraining_tpu.utils.auto_update import (
+    AutoUpdater, file_version, parse_version)
+from distributedtraining_tpu.utils.identity import (
+    Identity, generate_wallets, load_wallets)
+from distributedtraining_tpu.utils.loadgen import LoadGenerator
+from distributedtraining_tpu.utils import registry as reg
+
+
+# -- identity ---------------------------------------------------------------
+
+def test_identity_sign_verify_roundtrip():
+    ident = Identity.generate()
+    msg = b"score report: loss improved"
+    sig = ident.sign(msg)
+    assert ident.verify(msg, sig)
+    assert not ident.verify(b"tampered", sig)
+    # a different key must not verify
+    other = Identity.generate()
+    assert not other.verify(msg, sig)
+
+
+def test_wallet_storage_roundtrip(tmp_path):
+    idents = generate_wallets(str(tmp_path), 3)
+    loaded = load_wallets(str(tmp_path))
+    assert [i.hotkey for i in idents] == [i.hotkey for i in loaded]
+    # loaded wallets can still sign
+    sig = loaded[0].sign(b"hello")
+    assert idents[0].verify(b"hello", sig)
+    # hotkeys are unique
+    assert len({i.hotkey for i in idents}) == 3
+
+
+def test_wallet_tamper_detection(tmp_path):
+    ident = Identity.generate()
+    path = str(tmp_path / "w.json")
+    ident.save(path)
+    import json
+    payload = json.load(open(path))
+    payload["hotkey"] = "hkdeadbeefdeadbeefdead"
+    json.dump(payload, open(path, "w"))
+    with pytest.raises(ValueError):
+        Identity.load(path)
+
+
+# -- auto-update ------------------------------------------------------------
+
+def test_parse_version_forms():
+    assert parse_version('__version__ = "1.2.3"\n') == "1.2.3"
+    assert parse_version("2.0.1\n") == "2.0.1"
+    assert parse_version("nothing here") is None
+
+
+def test_file_version(tmp_path):
+    p = tmp_path / "VERSION"
+    p.write_text("0.9.1\n")
+    assert file_version(str(p)) == "0.9.1"
+    assert file_version(str(tmp_path / "missing")) is None
+
+
+def test_autoupdater_triggers_only_on_change():
+    calls = []
+    published = {"v": "1.0.0"}
+    upd = AutoUpdater("1.0.0", lambda: published["v"], update_cmd=None,
+                      restart=lambda: calls.append("restart"))
+    assert upd.check() is False          # same version: no-op
+    published["v"] = None
+    assert upd.check() is False          # unreachable source: no-op
+    published["v"] = "1.1.0"
+    assert upd.check() is True
+    assert calls == ["restart"]
+
+
+def test_autoupdater_failed_update_cmd_blocks_restart(tmp_path):
+    calls = []
+    upd = AutoUpdater("1.0.0", lambda: "2.0.0",
+                      update_cmd=["false"], repo_dir=str(tmp_path),
+                      restart=lambda: calls.append("restart"))
+    assert upd.check() is False
+    assert calls == []  # never restart into un-updated code
+
+
+# -- load generation vs the validator's admission screens -------------------
+
+def test_loadgen_poison_screened_by_validator():
+    cfg = ToyConfig(image_size=8, hidden=8, n_classes=2)
+    model = FeedforwardNet(cfg)
+
+    def loss(model, params, batch):
+        from distributedtraining_tpu.ops.losses import classification_loss
+        logits = model.apply({"params": params}, batch["images"])
+        return classification_loss(logits, batch["labels"])
+
+    engine = TrainEngine(model, loss_fn=loss)
+    transport = InMemoryTransport()
+    import jax
+    base = model.init_params(jax.random.PRNGKey(0))
+    transport.publish_base(base)
+
+    gen = LoadGenerator(transport, base, n_miners=8, poison_fraction=0.5)
+    gen.publish_round()
+    assert gen.report.published == 8
+    assert gen.report.poisoned == 4
+
+    from distributedtraining_tpu.data import image_batches
+
+    def val_batches():
+        return itertools.islice(
+            image_batches(batch_size=16, n_classes=cfg.n_classes,
+                          image_size=cfg.image_size, split="val"), 2)
+
+    class _Chain:
+        my_hotkey = "v"
+
+        def sync(self):
+            import types
+            return types.SimpleNamespace(hotkeys=gen.hotkeys())
+
+        def should_set_weights(self):
+            return False
+
+    validator = Validator(engine, transport, _Chain(),
+                          eval_batches=val_batches, max_delta_abs=1e3)
+    validator.bootstrap(jax.random.PRNGKey(0))
+    scores = validator.validate_and_score()
+    by_key = {s.hotkey: s for s in scores}
+    assert len(by_key) == 8
+    # every poisoned artifact is rejected with a reason, never scored
+    rejected = [s for s in scores if s.reason != "ok"]
+    assert len(rejected) == 4, [(s.hotkey, s.reason) for s in scores]
+    reasons = {s.reason.split("(")[0] for s in rejected}
+    assert reasons <= {"nonfinite", "shape_mismatch", "magnitude_exceeded",
+                       "no_delta"}
+    # benign artifacts all got evaluated
+    assert sum(1 for s in scores if s.reason == "ok") == 4
+
+
+# -- peer registry ----------------------------------------------------------
+
+def test_registry_register_and_prune():
+    r = reg.PeerRegistry(ttl=10.0)
+    r.register("hk1", "host1:1234", now=100.0)
+    r.register("hk2", "host2:1234", now=105.0)
+    live = r.peers(now=108.0)
+    assert {p["hotkey"] for p in live} == {"hk1", "hk2"}
+    live = r.peers(now=112.0)   # hk1 is 12s old > ttl
+    assert {p["hotkey"] for p in live} == {"hk2"}
+
+
+def test_registry_http_roundtrip():
+    srv, url = reg.serve(ttl=60.0)
+    try:
+        assert reg.register_peer(url, "hkA", "10.0.0.1:5000")
+        assert reg.register_peer(url, "hkB", "10.0.0.2:5000")
+        peers = reg.get_peers(url)
+        assert {p["hotkey"] for p in peers} == {"hkA", "hkB"}
+        # stress-lite: the reference's bootstrap_stress hammers the pool
+        for i in range(50):
+            assert reg.register_peer(url, f"hk{i}", f"10.0.1.{i}:5000")
+        assert len(reg.get_peers(url)) == 52
+    finally:
+        srv.shutdown()
