@@ -47,7 +47,11 @@ class GPT2Config:
     dtype: str = "bfloat16"        # activation/compute dtype
     param_dtype: str = "float32"   # storage dtype
     remat: bool = False
-    attention_impl: str = "dense"  # "dense" | "flash"
+    # flash is the TPU default: the Pallas kernel declines off-TPU (and for
+    # short/ragged shapes) and the dense XLA path takes over transparently.
+    # Measured on v5e, GPT-2-124M fwd+bwd: +16% tokens/sec at T=1024,
+    # +45% at 2048, 3.1x at 4096 vs dense (see ops/flash_attention.py).
+    attention_impl: str = "flash"  # "dense" | "flash" | "ring"
     vocab_multiple: int = 128      # pad vocab to a lane-aligned multiple
 
     @property

@@ -1,0 +1,74 @@
+"""Native (C++) host-side components, loaded via ctypes.
+
+The TPU compute path is JAX/XLA/Pallas; what native code buys here is the
+*host* side of the pipeline — the data-packing loop that has to outrun the
+chip. Components are built on first use with the system toolchain (g++ is
+part of this image), cached as shared objects next to their sources, and
+every consumer has a pure-Python fallback, so an environment without a
+compiler still runs everything (slower).
+
+Loader contract:
+- ``load(name)`` returns a ctypes.CDLL or None (never raises for missing
+  toolchain / failed build; the failure is logged once).
+- builds are atomic (tmp + rename) so concurrent first-use races are safe.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_FAILED: set[str] = set()
+
+
+def _so_path(name: str) -> str:
+    return os.path.join(_DIR, f"lib{name}.so")
+
+
+def build(name: str) -> str | None:
+    """Compile native/<name>.cpp -> native/lib<name>.so; returns the path or
+    None on failure. Skips the build when the .so is newer than the source."""
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = _so_path(name)
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)  # atomic: concurrent builders race harmlessly
+        return out
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("native build of %s failed (%s); using the Python "
+                       "fallback", name, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load(name: str) -> ctypes.CDLL | None:
+    """Build-if-needed and dlopen; None (once-logged) on any failure."""
+    if name in _FAILED:
+        return None
+    path = build(name)
+    if path is None:
+        _FAILED.add(name)
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError as e:
+        logger.warning("failed to load %s: %s", path, e)
+        _FAILED.add(name)
+        return None

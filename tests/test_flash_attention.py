@@ -1,0 +1,65 @@
+"""Flash-attention kernel parity vs the dense oracle.
+
+The suite's conftest forces the CPU platform (virtual 8-device mesh), where
+the Pallas kernel declines by design — so these tests skip there and run
+when the suite is pointed at real TPU hardware
+(``JAX_PLATFORMS=tpu pytest tests/test_flash_attention.py -p no:cacheprovider``
+with the conftest override removed, or via bench-side validation). The
+decline-to-dense contract itself IS tested on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.ops.attention import causal_attention
+from distributedtraining_tpu.ops.flash_attention import flash_attention
+
+on_tpu = jax.default_backend() in ("tpu", "axon")
+
+
+def _qkv(B=2, T=512, H=4, D=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.bfloat16)
+                 for _ in range(3))
+
+
+def test_declines_off_tpu_or_short():
+    q, k, v = _qkv(T=128)
+    assert flash_attention(q, k, v) is None  # short seq declines everywhere
+    if not on_tpu:
+        q, k, v = _qkv(T=512)
+        assert flash_attention(q, k, v) is None
+
+
+@pytest.mark.skipif(not on_tpu, reason="pallas kernel needs TPU")
+def test_matches_dense_unmasked():
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v)
+    assert out is not None
+    ref = causal_attention(q, k, v, impl="dense")
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+
+@pytest.mark.skipif(not on_tpu, reason="pallas kernel needs TPU")
+def test_matches_dense_with_segments_and_grads():
+    q, k, v = _qkv()
+    B, T = q.shape[:2]
+    rng = np.random.default_rng(1)
+    seg = jnp.asarray(np.repeat(rng.integers(0, 3, (B, T // 128)), 128,
+                                axis=1), jnp.int32)
+    out = flash_attention(q, k, v, segment_ids=seg)
+    assert out is not None
+    ref = causal_attention(q, k, v, segment_ids=seg, impl="dense")
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+    gf = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, segment_ids=seg).astype(jnp.float32)))(q)
+    gd = jax.grad(lambda q: jnp.sum(
+        causal_attention(q, k, v, segment_ids=seg,
+                         impl="dense").astype(jnp.float32)))(q)
+    np.testing.assert_allclose(np.asarray(gf, np.float32),
+                               np.asarray(gd, np.float32), atol=1e-1)

@@ -1,0 +1,96 @@
+"""Native C++ packer vs the pure-Python oracle: exact output parity.
+
+The C++ path (native/packing.cpp) must be bit-identical to pack_documents'
+Python loop for every field, including the chunked-streaming wrapper that
+feeds it bounded buffers.
+"""
+
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import native
+from distributedtraining_tpu.data import packing
+
+
+def _collect(it):
+    rows = list(it)
+    if not rows:
+        return None
+    return {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+
+
+def _random_docs(rng, n_docs, max_len):
+    return [list(rng.integers(1, 1000, rng.integers(0, max_len + 1)))
+            for _ in range(n_docs)]
+
+
+requires_native = pytest.mark.skipif(native.load("packing") is None,
+                                     reason="native toolchain unavailable")
+
+
+@requires_native
+@pytest.mark.parametrize("seq_len,drop", [(16, True), (16, False),
+                                          (64, True), (64, False)])
+def test_native_matches_oracle(seq_len, drop):
+    rng = np.random.default_rng(0)
+    docs = _random_docs(rng, 200, 3 * seq_len)  # includes empty + long docs
+    want = _collect(packing.pack_documents(docs, seq_len,
+                                           drop_remainder=drop,
+                                           native=False))
+    got = _collect(packing.pack_documents(docs, seq_len,
+                                          drop_remainder=drop, native=True))
+    assert want.keys() == got.keys()
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+
+
+@requires_native
+def test_native_chunked_streaming_matches_oracle():
+    """Tiny chunk budget forces many native calls with carry-over tails."""
+    rng = np.random.default_rng(1)
+    seq_len = 32
+    docs = _random_docs(rng, 300, 2 * seq_len)
+    want = _collect(packing.pack_documents(docs, seq_len,
+                                           drop_remainder=False,
+                                           native=False))
+    got = _collect(packing._pack_documents_native(
+        iter(docs), seq_len, drop_remainder=False, chunk_tokens=64))
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+
+
+@requires_native
+def test_native_empty_and_degenerate():
+    assert _collect(packing.pack_documents([], 16, native=True)) is None
+    # single doc exactly one row
+    doc = list(range(1, 17))
+    got = _collect(packing.pack_documents([doc], 16, native=True))
+    want = _collect(packing.pack_documents([doc], 16, native=False))
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+
+
+@requires_native
+def test_native_packer_is_faster():
+    """Not a benchmark assertion in CI spirit — a sanity floor that the
+    native path actually beats the Python loop on a realistic workload."""
+    import time
+    rng = np.random.default_rng(2)
+    # array docs: the zero-conversion fast path (HF tokenizers hand back
+    # arrays; list docs spend ~95% of wall time in np.asarray either way)
+    docs = [rng.integers(1, 50000, 700).astype(np.int32)
+            for _ in range(400)]
+
+    def best_of(native, runs=3):
+        times, n = [], None
+        for _ in range(runs):  # best-of: a loaded test machine spikes singles
+            t0 = time.perf_counter()
+            n = sum(1 for _ in packing.pack_documents(docs, 1024,
+                                                      native=native))
+            times.append(time.perf_counter() - t0)
+        return n, min(times)
+
+    n_py, t_py = best_of(False)
+    n_nat, t_nat = best_of(True)
+    assert n_py == n_nat
+    assert t_nat < t_py, (t_nat, t_py)
