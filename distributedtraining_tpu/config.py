@@ -63,6 +63,8 @@ class RunConfig:
     eval_batches: int = 12                   # ~100 texts / batch 8 (ref :49,98)
     learning_rate: float = 5e-4              # neurons/miner.py:121-128
     grad_clip: Optional[float] = None
+    lora_rank: int = 0                       # >0: LoRA-delta mode (config 4)
+    lora_alpha: float = 16.0
     dataset: str = "auto"                    # auto | wikitext | synthetic
     tokenizer: str = "auto"                  # auto | byte | <hf name>
 
@@ -145,6 +147,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--learning-rate", dest="learning_rate", type=float,
                    default=d.learning_rate)
     g.add_argument("--grad-clip", dest="grad_clip", type=float, default=None)
+    g.add_argument("--lora-rank", dest="lora_rank", type=int,
+                   default=d.lora_rank,
+                   help=">0 switches the miner to LoRA-delta training; "
+                        "validator/averager accept adapter submissions")
+    g.add_argument("--lora-alpha", dest="lora_alpha", type=float,
+                   default=d.lora_alpha)
     g.add_argument("--dataset", choices=("auto", "wikitext", "synthetic"),
                    default=d.dataset)
     g.add_argument("--tokenizer", default=d.tokenizer)

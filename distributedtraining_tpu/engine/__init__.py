@@ -9,6 +9,7 @@ pattern made first-class (SURVEY.md §4).
 
 from .scheduler import Clock, RealClock, FakeClock, PeriodicAction
 from .train import TrainEngine, MinerLoop, TrainState, default_optimizer
+from .lora_train import LoRAEngine, LoRAMinerLoop, fetch_delta_any
 from .validate import Validator
 from .average import (
     AveragerLoop,
@@ -20,6 +21,7 @@ from .average import (
 __all__ = [
     "Clock", "RealClock", "FakeClock", "PeriodicAction",
     "TrainEngine", "MinerLoop", "TrainState", "default_optimizer",
+    "LoRAEngine", "LoRAMinerLoop", "fetch_delta_any",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
 ]

@@ -220,7 +220,8 @@ class AveragerLoop:
                  address_store=None,
                  clock: Clock | None = None,
                  max_delta_abs: float | None = 1e3,
-                 metrics=None):
+                 metrics=None,
+                 lora_cfg=None):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -230,6 +231,8 @@ class AveragerLoop:
         self.clock = clock or RealClock()
         self.max_delta_abs = max_delta_abs
         self.metrics = metrics
+        # accept adapter-tree submissions alongside full-param deltas
+        self.lora_cfg = lora_cfg
         self.report = AveragerReport()
         self.base_params: Params | None = None
         self._base_revision = None
@@ -255,7 +258,9 @@ class AveragerLoop:
         for hotkey in meta.hotkeys:
             if hotkey == getattr(self.chain, "my_hotkey", None):
                 continue
-            d = self.transport.fetch_delta(hotkey, self.base_params)
+            from .lora_train import fetch_delta_any
+            d = fetch_delta_any(self.transport, hotkey, self.base_params,
+                                self.lora_cfg)
             if d is None:
                 continue
             ok, reason = delta_lib.screen_delta(d, self.base_params,

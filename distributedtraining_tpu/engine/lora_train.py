@@ -1,0 +1,203 @@
+"""LoRA train engine + miner loop (BASELINE.json config 4).
+
+A LoRA miner trains only low-rank adapter factors against a frozen base and
+ships the *adapter pytree* over the wire — for a 7B model that is ~20 MB
+instead of a ~14 GB dense delta, which is the entire reason config 4 exists.
+Validators/averagers reconstruct the dense delta on their side
+(models/lora.py lora_to_full_delta) and then score/merge it exactly like any
+full-parameter submission; see ``fetch_delta_any``.
+
+Protocol semantics mirror the full-param miner (engine/train.py MinerLoop):
+same push/pull cadences, NaN screening before publish, and on a base-model
+update the optimizer state AND the adapters reset — a fresh adapter
+(b=0 -> zero effective delta) is the LoRA equivalent of the full miner
+re-snapshotting its base (training_manager.py:371-377).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .. import delta as delta_lib
+from ..models import lora as lora_lib
+from .train import (MinerLoop, TrainState, _default_lm_loss, _snapshot,
+                    default_optimizer)
+
+logger = logging.getLogger(__name__)
+
+
+class LoRAEngine:
+    """Jitted adapter-only train/eval steps.
+
+    The base is an explicit argument of the step (not a closure) so a base
+    pull never recompiles, and donation applies only to the adapter state.
+    """
+
+    def __init__(self, model, lora_cfg: lora_lib.LoRAConfig, *,
+                 optimizer: optax.GradientTransformation | None = None,
+                 loss_fn=None):
+        self.model = model
+        self.lora_cfg = lora_cfg
+        self.tx = optimizer or default_optimizer()
+        self.mesh = None  # adapter training is single-chip in this round
+        task_loss = loss_fn or _default_lm_loss
+
+        def loss(lora_params, base, batch):
+            eff = lora_lib.apply_lora(base, lora_params, lora_cfg)
+            return task_loss(model, eff, batch)
+
+        def train_step(state: TrainState, base, batch):
+            (l, count), grads = jax.value_and_grad(
+                lambda p: loss(p, base, batch), has_aux=True)(state.params)
+            updates, opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+            params = optax.apply_updates(state.params, updates)
+            return (TrainState(step=state.step + 1, params=params,
+                               opt_state=opt_state),
+                    {"loss": l, "tokens": count})
+
+        def eval_step(lora_params, base, batch):
+            l, count = loss(lora_params, base, batch)
+            return l * count, count
+
+        self.train_step = jax.jit(train_step, donate_argnums=(0,))
+        self.eval_step = jax.jit(eval_step)
+
+    def init_state(self, rng: jax.Array, base) -> TrainState:
+        lp = lora_lib.init_lora(rng, base, self.lora_cfg)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=lp,
+                          opt_state=jax.jit(self.tx.init)(lp))
+
+    def place_batch(self, batch: dict) -> dict:
+        return batch
+
+
+class LoRAMinerLoop(MinerLoop):
+    """MinerLoop whose artifact is the adapter pytree.
+
+    Reuses the full-param loop's cadences, NaN guard, metrics, and
+    checkpointing; overrides what "train step", "delta", and "base reset"
+    mean. ``base_params`` holds the frozen base; ``state.params`` holds the
+    adapters."""
+
+    def __init__(self, engine: LoRAEngine, transport, miner_id: str, **kw):
+        if kw.get("checkpoint_store") is not None:
+            raise NotImplementedError(
+                "local checkpointing for LoRA miners is not wired yet; "
+                "adapters are small enough that restart-from-base loses "
+                "minutes, not hours")
+        super().__init__(engine, transport, miner_id, **kw)
+        self._rng = jax.random.PRNGKey(0)
+
+    # -- base lifecycle -----------------------------------------------------
+    def bootstrap(self, rng: jax.Array | None = None) -> None:
+        if rng is not None:
+            self._rng = rng
+        if self._restore_checkpoint(self._rng):
+            return
+        template = self.engine.model.init_params(self._rng)
+        fetched = self.transport.fetch_base(template) \
+            if self.transport.base_revision() is not None else None
+        if fetched is not None:
+            base, rev = fetched
+            self._base_revision = rev
+        else:
+            base = template
+        self.base_params = _snapshot(base)
+        self.state = self.engine.init_state(self._rng, self.base_params)
+
+    def _check_pull(self) -> None:
+        rev = self.transport.base_revision()
+        if rev is None or rev == self._base_revision:
+            return
+        fetched = self.transport.fetch_base(self.base_params)
+        if fetched is None:
+            return
+        base, rev = fetched
+        logger.info("lora miner %s: new base %s — resetting adapters + "
+                    "optimizer", self.miner_id, rev and rev[:8])
+        self.base_params = _snapshot(base)
+        self.state = self.engine.init_state(self._rng, self.base_params)
+        self._base_revision = rev
+        self._last_base_time = self.clock.now()
+        self.report.base_pulls += 1
+
+    # -- the artifact -------------------------------------------------------
+    def _push_delta(self) -> None:
+        if self.state is None:
+            return
+        adapters = self.state.params
+        if self.nan_guard and delta_lib.has_nonfinite(adapters):
+            logger.warning("lora miner %s: non-finite adapters, not pushing",
+                           self.miner_id)
+            return
+        try:
+            self.transport.publish_delta(self.miner_id, adapters)
+            self.report.pushes += 1
+        except Exception:
+            logger.exception("lora miner %s: push failed", self.miner_id)
+
+    # -- the loop (base is a step argument here) ----------------------------
+    def _train_one(self, batch) -> dict:
+        self.state, m = self.engine.train_step(
+            self.state, self.base_params, self.engine.place_batch(batch))
+        return m
+
+
+def adapter_template(base, lora_cfg: lora_lib.LoRAConfig):
+    """Host-side zeros adapter tree for payload validation — shapes come
+    from ``jax.eval_shape`` so no device compute or gaussian init runs."""
+    import numpy as np
+    abstract = jax.eval_shape(
+        lambda: lora_lib.init_lora(jax.random.PRNGKey(0), base, lora_cfg))
+    return jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, s.dtype), abstract)
+
+
+def fetch_delta_any(transport, hotkey: str, base,
+                    lora_cfg: Optional[lora_lib.LoRAConfig] = None,
+                    *, lora_template=None):
+    """Fetch a miner's submission as a dense delta, whatever its wire form.
+
+    Validates against the full-param template first, then the adapter
+    template (reconstructing the dense delta). Returns None when neither
+    matches — the caller scores 0 (validation_logic.py:152-166 semantics).
+    With ``lora_cfg`` unset this degrades to a plain ``fetch_delta``.
+
+    When the transport exposes ``fetch_delta_bytes`` the artifact is pulled
+    from the network ONCE and both validations run on the same bytes —
+    the HF transport deletes its download after each fetch, so two
+    ``fetch_delta`` calls would mean two full downloads per miner per round.
+    """
+    if lora_cfg is None:
+        return transport.fetch_delta(hotkey, base)
+    template = lora_template if lora_template is not None \
+        else adapter_template(base, lora_cfg)
+
+    fetch_bytes = getattr(transport, "fetch_delta_bytes", None)
+    if fetch_bytes is not None:
+        from .. import serialization as ser
+        data = fetch_bytes(hotkey)
+        if data is None:
+            return None
+        for tmpl, is_lora in ((base, False), (template, True)):
+            try:
+                tree = ser.validated_load(data, tmpl)
+            except ser.PayloadError:
+                continue
+            return lora_lib.lora_to_full_delta(base, tree, lora_cfg) \
+                if is_lora else tree
+        return None
+
+    d = transport.fetch_delta(hotkey, base)
+    if d is not None:
+        return d
+    adapters = transport.fetch_delta(hotkey, template)
+    if adapters is None:
+        return None
+    return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)

@@ -377,6 +377,13 @@ class MinerLoop:
             logger.exception("miner %s: delta push failed", self.miner_id)
 
     # -- the loop -----------------------------------------------------------
+    def _train_one(self, batch) -> dict:
+        """One engine step. The LoRA loop overrides this (its step also
+        takes the frozen base); everything else in run() is shared."""
+        self.state, m = self.engine.train_step(
+            self.state, self.engine.place_batch(batch))
+        return m
+
     def run(self, batches: Iterable[dict], *, max_steps: int | None = None
             ) -> MinerReport:
         if self.state is None:
@@ -386,8 +393,7 @@ class MinerLoop:
             if max_steps is not None and self.report.steps - start_steps >= max_steps:
                 break
             self._pull_action.poll()
-            self.state, m = self.engine.train_step(
-                self.state, self.engine.place_batch(batch))
+            m = self._train_one(batch)
             self.report.steps += 1
             self.report.last_loss = float(m["loss"])
             if self.metrics and self.report.steps % self.log_every == 0:

@@ -47,7 +47,8 @@ class Validator:
                  metric: str = "loss",          # "loss" | "perplexity"
                  max_delta_abs: float | None = 1e3,
                  clock: Clock | None = None,
-                 metrics=None):
+                 metrics=None,
+                 lora_cfg=None):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -56,6 +57,9 @@ class Validator:
         self.max_delta_abs = max_delta_abs
         self.clock = clock or RealClock()
         self.metrics = metrics
+        # accept adapter-tree submissions alongside full-param deltas
+        # (engine/lora_train.py fetch_delta_any)
+        self.lora_cfg = lora_cfg
 
         self.base_params: Params | None = None
         self._base_revision = None
@@ -94,7 +98,9 @@ class Validator:
 
     # -- scoring ------------------------------------------------------------
     def score_miner(self, hotkey: str) -> MinerScore:
-        d = self.transport.fetch_delta(hotkey, self.base_params)
+        from .lora_train import fetch_delta_any
+        d = fetch_delta_any(self.transport, hotkey, self.base_params,
+                            self.lora_cfg)
         if d is None:
             return MinerScore(hotkey, 0.0, reason="no_delta")
         ok, reason = delta_lib.screen_delta(d, self.base_params,

@@ -62,9 +62,13 @@ def _check_leaf_shapes(tree: Params, template: Params) -> None:
 # ---------------------------------------------------------------------------
 
 def to_msgpack(tree: Params) -> bytes:
-    """Serialize a pytree of arrays to msgpack bytes (host transfer included)."""
+    """Serialize a pytree of arrays to msgpack bytes (host transfer included).
+
+    ``to_state_dict`` first: custom pytree nodes (flax struct dataclasses
+    like models.lora.LoRAPair) become plain dicts msgpack can encode; the
+    template-restoring loader reverses this via ``from_state_dict``."""
     host = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
-    return flax_ser.msgpack_serialize(host)
+    return flax_ser.msgpack_serialize(flax_ser.to_state_dict(host))
 
 
 def from_msgpack(data: bytes, template: Params | None = None,

@@ -101,6 +101,29 @@ class HFHubTransport:
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
         return self._download(miner_id, DELTA_FILE, template)
 
+    def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
+        """One network download, raw bytes — multi-template validation
+        (full vs LoRA wire formats) must not pay two LFS pulls per miner."""
+        from huggingface_hub import hf_hub_download
+        from huggingface_hub.utils import EntryNotFoundError, RepositoryNotFoundError
+        try:
+            path = hf_hub_download(repo_id=miner_id, filename=DELTA_FILE,
+                                   token=self.api.token)
+        except (EntryNotFoundError, RepositoryNotFoundError):
+            return None
+        try:
+            if os.path.getsize(path) > self.max_bytes:
+                return None
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+        finally:
+            try:
+                os.unlink(os.path.realpath(path))
+            except OSError:
+                pass
+
     def delta_revision(self, miner_id: str) -> Revision:
         return self._revision(miner_id)
 

@@ -81,6 +81,18 @@ class LocalFSTransport:
         except ser.PayloadError:
             return None
 
+    def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
+        """Raw artifact bytes (size-capped), one read — for multi-template
+        validation (full-param vs LoRA adapter submissions)."""
+        path = self._delta_path(miner_id)
+        try:
+            if os.path.getsize(path) > self.max_bytes:
+                return None
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
     def delta_revision(self, miner_id: str) -> Revision:
         return _hash_file(self._delta_path(miner_id))
 

@@ -42,6 +42,12 @@ class InMemoryTransport:
         except ser.PayloadError:
             return None
 
+    def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
+        """Raw artifact bytes, one fetch — callers that must validate
+        against several templates (full-param vs LoRA adapter) run all
+        attempts on the same payload."""
+        return self._deltas.get(miner_id)
+
     def delta_revision(self, miner_id: str) -> Revision:
         data = self._deltas.get(miner_id)
         return None if data is None else hashlib.sha256(data).hexdigest()

@@ -41,7 +41,7 @@ def main(argv=None) -> int:
                         make_strategy(cfg, c.model),
                         val_batches=c.eval_batches(),
                         address_store=c.address_store,
-                        metrics=c.metrics)
+                        metrics=c.metrics, lora_cfg=c.lora_cfg)
     loop.bootstrap()
     try:
         merged = loop.run_periodic(interval=cfg.averaging_interval,

@@ -39,6 +39,7 @@ class Components:
     address_store: Any
     tokenizer: Any
     metrics: Any
+    lora_cfg: Any = None  # set when --lora-rank > 0 (config 4 mode)
 
     def train_batches(self, *, repeat: bool = True) -> Iterable[dict]:
         docs = text_corpus(split="train", source=self.cfg.dataset)
@@ -146,7 +147,12 @@ def build(cfg: RunConfig) -> Components:
                                 run_name=f"{cfg.role}-{cfg.hotkey}"))
     metrics = multi_sink(*sinks) if sinks else None
 
+    lora_cfg = None
+    if cfg.lora_rank > 0:
+        from distributedtraining_tpu.models.lora import LoRAConfig
+        lora_cfg = LoRAConfig(rank=cfg.lora_rank, alpha=cfg.lora_alpha)
+
     return Components(cfg=cfg, model=model, model_cfg=model_cfg,
                       engine=engine, transport=transport, chain=chain,
                       address_store=address_store, tokenizer=tokenizer,
-                      metrics=metrics)
+                      metrics=metrics, lora_cfg=lora_cfg)

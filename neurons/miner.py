@@ -29,18 +29,33 @@ def main(argv=None) -> int:
     c = build(cfg)
 
     store = None
-    if cfg.checkpoint_interval > 0:
-        from distributedtraining_tpu.checkpoint import CheckpointStore
-        ckpt_dir = cfg.checkpoint_dir or os.path.join(
-            cfg.work_dir, "checkpoints", cfg.hotkey)
-        store = CheckpointStore(ckpt_dir)
-
-    loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
-                     send_interval=cfg.send_interval,
-                     check_update_interval=cfg.check_update_interval,
-                     metrics=c.metrics,
-                     checkpoint_store=store,
-                     checkpoint_interval=cfg.checkpoint_interval)
+    if c.lora_cfg is not None:
+        # config-4 mode: adapter-only training, adapter-tree artifacts.
+        # Reuse the composed engine's optimizer so --learning-rate and
+        # --grad-clip apply to adapters too.
+        from distributedtraining_tpu.engine import LoRAEngine, LoRAMinerLoop
+        if cfg.checkpoint_interval > 0:
+            logging.warning(
+                "LoRA miners do not support local checkpointing yet; "
+                "running WITHOUT preemption recovery (adapters retrain "
+                "from the published base on restart)")
+        engine = LoRAEngine(c.model, c.lora_cfg, optimizer=c.engine.tx)
+        loop = LoRAMinerLoop(engine, c.transport, cfg.hotkey,
+                             send_interval=cfg.send_interval,
+                             check_update_interval=cfg.check_update_interval,
+                             metrics=c.metrics)
+    else:
+        if cfg.checkpoint_interval > 0:
+            from distributedtraining_tpu.checkpoint import CheckpointStore
+            ckpt_dir = cfg.checkpoint_dir or os.path.join(
+                cfg.work_dir, "checkpoints", cfg.hotkey)
+            store = CheckpointStore(ckpt_dir)
+        loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
+                         send_interval=cfg.send_interval,
+                         check_update_interval=cfg.check_update_interval,
+                         metrics=c.metrics,
+                         checkpoint_store=store,
+                         checkpoint_interval=cfg.checkpoint_interval)
     try:
         loop.bootstrap()
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)

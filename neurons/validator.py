@@ -29,7 +29,7 @@ def main(argv=None) -> int:
     c = build(cfg)
     validator = Validator(c.engine, c.transport, c.chain,
                           eval_batches=c.eval_batches(),
-                          metrics=c.metrics)
+                          metrics=c.metrics, lora_cfg=c.lora_cfg)
     validator.bootstrap()
     try:
         ok = validator.run_periodic(interval=cfg.validation_interval,
