@@ -231,8 +231,10 @@ class AveragerLoop:
         self.clock = clock or RealClock()
         self.max_delta_abs = max_delta_abs
         self.metrics = metrics
-        # accept adapter-tree submissions alongside full-param deltas
+        # accept adapter-tree submissions alongside full-param deltas;
+        # template cached once (depends only on base shapes)
         self.lora_cfg = lora_cfg
+        self._lora_template = None
         self.report = AveragerReport()
         self.base_params: Params | None = None
         self._base_revision = None
@@ -258,9 +260,13 @@ class AveragerLoop:
         for hotkey in meta.hotkeys:
             if hotkey == getattr(self.chain, "my_hotkey", None):
                 continue
-            from .lora_train import fetch_delta_any
+            from .lora_train import adapter_template, fetch_delta_any
+            if self.lora_cfg is not None and self._lora_template is None:
+                self._lora_template = adapter_template(self.base_params,
+                                                       self.lora_cfg)
             d = fetch_delta_any(self.transport, hotkey, self.base_params,
-                                self.lora_cfg)
+                                self.lora_cfg,
+                                lora_template=self._lora_template)
             if d is None:
                 continue
             ok, reason = delta_lib.screen_delta(d, self.base_params,

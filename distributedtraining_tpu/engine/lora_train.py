@@ -25,10 +25,17 @@ import optax
 
 from .. import delta as delta_lib
 from ..models import lora as lora_lib
-from .train import (MinerLoop, TrainState, _default_lm_loss, _snapshot,
+from .train import (MinerLoop, TrainState, _default_lm_loss,
                     default_optimizer)
 
 logger = logging.getLogger(__name__)
+
+
+def _place(base):
+    """Device placement for the frozen base. Transport fetches restore numpy
+    leaves; feeding those to the jitted step would re-transfer the entire
+    base host-to-device EVERY step (GBs/step at the 7B config-4 scale)."""
+    return jax.tree_util.tree_map(jnp.asarray, base)
 
 
 class LoRAEngine:
@@ -108,7 +115,7 @@ class LoRAMinerLoop(MinerLoop):
             self._base_revision = rev
         else:
             base = template
-        self.base_params = _snapshot(base)
+        self.base_params = _place(base)
         self.state = self.engine.init_state(self._rng, self.base_params)
 
     def _check_pull(self) -> None:
@@ -121,7 +128,7 @@ class LoRAMinerLoop(MinerLoop):
         base, rev = fetched
         logger.info("lora miner %s: new base %s — resetting adapters + "
                     "optimizer", self.miner_id, rev and rev[:8])
-        self.base_params = _snapshot(base)
+        self.base_params = _place(base)
         self.state = self.engine.init_state(self._rng, self.base_params)
         self._base_revision = rev
         self._last_base_time = self.clock.now()
@@ -176,8 +183,17 @@ def fetch_delta_any(transport, hotkey: str, base,
     """
     if lora_cfg is None:
         return transport.fetch_delta(hotkey, base)
-    template = lora_template if lora_template is not None \
-        else adapter_template(base, lora_cfg)
+
+    # template construction is deferred: most submissions in a mixed fleet
+    # validate as full-param on the first attempt, and rebuilding the
+    # adapter template per miner per round is redundant trace/alloc work —
+    # callers scoring many miners should pass a per-base-revision cached
+    # ``lora_template``
+    def template():
+        nonlocal lora_template
+        if lora_template is None:
+            lora_template = adapter_template(base, lora_cfg)
+        return lora_template
 
     fetch_bytes = getattr(transport, "fetch_delta_bytes", None)
     if fetch_bytes is not None:
@@ -185,19 +201,20 @@ def fetch_delta_any(transport, hotkey: str, base,
         data = fetch_bytes(hotkey)
         if data is None:
             return None
-        for tmpl, is_lora in ((base, False), (template, True)):
-            try:
-                tree = ser.validated_load(data, tmpl)
-            except ser.PayloadError:
-                continue
-            return lora_lib.lora_to_full_delta(base, tree, lora_cfg) \
-                if is_lora else tree
-        return None
+        try:
+            return ser.validated_load(data, base)
+        except ser.PayloadError:
+            pass
+        try:
+            adapters = ser.validated_load(data, template())
+        except ser.PayloadError:
+            return None
+        return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)
 
     d = transport.fetch_delta(hotkey, base)
     if d is not None:
         return d
-    adapters = transport.fetch_delta(hotkey, template)
+    adapters = transport.fetch_delta(hotkey, template())
     if adapters is None:
         return None
     return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)

@@ -60,6 +60,9 @@ class Validator:
         # accept adapter-tree submissions alongside full-param deltas
         # (engine/lora_train.py fetch_delta_any)
         self.lora_cfg = lora_cfg
+        # cached once: the template depends only on base SHAPES, which are
+        # fixed by the model config across base revisions
+        self._lora_template = None
 
         self.base_params: Params | None = None
         self._base_revision = None
@@ -97,10 +100,20 @@ class Validator:
         self._eval_base()
 
     # -- scoring ------------------------------------------------------------
+    def _adapter_template(self):
+        if self.lora_cfg is None:
+            return None
+        if self._lora_template is None:
+            from .lora_train import adapter_template
+            self._lora_template = adapter_template(self.base_params,
+                                                   self.lora_cfg)
+        return self._lora_template
+
     def score_miner(self, hotkey: str) -> MinerScore:
         from .lora_train import fetch_delta_any
         d = fetch_delta_any(self.transport, hotkey, self.base_params,
-                            self.lora_cfg)
+                            self.lora_cfg,
+                            lora_template=self._adapter_template())
         if d is None:
             return MinerScore(hotkey, 0.0, reason="no_delta")
         ok, reason = delta_lib.screen_delta(d, self.base_params,

@@ -66,8 +66,9 @@ class HFHubTransport:
             os.unlink(tmp)
         return getattr(info, "oid", None) or self._revision(repo_id)
 
-    def _download(self, repo_id: str, filename: str,
-                  template: Params) -> Params | None:
+    def _download_bytes(self, repo_id: str, filename: str) -> bytes | None:
+        """One network download -> capped raw bytes; the cached blob is
+        deleted after reading to bound disk (hf_manager.py:195)."""
         from huggingface_hub import hf_hub_download
         from huggingface_hub.utils import EntryNotFoundError, RepositoryNotFoundError
         try:
@@ -76,15 +77,27 @@ class HFHubTransport:
         except (EntryNotFoundError, RepositoryNotFoundError):
             return None
         try:
-            return ser.load_file(path, template, max_bytes=self.max_bytes)
-        except ser.PayloadError:
+            if os.path.getsize(path) > self.max_bytes:
+                return None
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
             return None
         finally:
-            # the reference deletes after load to bound disk (hf_manager.py:195)
             try:
                 os.unlink(os.path.realpath(path))
             except OSError:
                 pass
+
+    def _download(self, repo_id: str, filename: str,
+                  template: Params) -> Params | None:
+        data = self._download_bytes(repo_id, filename)
+        if data is None:
+            return None
+        try:
+            return ser.from_msgpack(data, template, max_bytes=self.max_bytes)
+        except ser.PayloadError:
+            return None
 
     def _revision(self, repo_id: str) -> Revision:
         try:
@@ -102,27 +115,9 @@ class HFHubTransport:
         return self._download(miner_id, DELTA_FILE, template)
 
     def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
-        """One network download, raw bytes — multi-template validation
-        (full vs LoRA wire formats) must not pay two LFS pulls per miner."""
-        from huggingface_hub import hf_hub_download
-        from huggingface_hub.utils import EntryNotFoundError, RepositoryNotFoundError
-        try:
-            path = hf_hub_download(repo_id=miner_id, filename=DELTA_FILE,
-                                   token=self.api.token)
-        except (EntryNotFoundError, RepositoryNotFoundError):
-            return None
-        try:
-            if os.path.getsize(path) > self.max_bytes:
-                return None
-            with open(path, "rb") as f:
-                return f.read()
-        except OSError:
-            return None
-        finally:
-            try:
-                os.unlink(os.path.realpath(path))
-            except OSError:
-                pass
+        """Raw bytes — multi-template validation (full vs LoRA wire formats)
+        must not pay two LFS pulls per miner."""
+        return self._download_bytes(miner_id, DELTA_FILE)
 
     def delta_revision(self, miner_id: str) -> Revision:
         return self._revision(miner_id)

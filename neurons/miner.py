@@ -39,6 +39,11 @@ def main(argv=None) -> int:
                 "LoRA miners do not support local checkpointing yet; "
                 "running WITHOUT preemption recovery (adapters retrain "
                 "from the published base on restart)")
+        if c.engine.mesh is not None:
+            logging.warning(
+                "LoRA adapter training is single-device this release; "
+                "ignoring the configured %s mesh (dp/fsdp/sp/tp flags are "
+                "inert with --lora-rank)", dict(c.engine.mesh.shape))
         engine = LoRAEngine(c.model, c.lora_cfg, optimizer=c.engine.tx)
         loop = LoRAMinerLoop(engine, c.transport, cfg.hotkey,
                              send_interval=cfg.send_interval,
