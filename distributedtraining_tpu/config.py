@@ -83,6 +83,8 @@ class RunConfig:
     strategy: str = "parameterized"          # weighted | parameterized | genetic
     meta_epochs: int = 7                     # averager.py:106
     meta_lr: float = 0.01
+    outer_momentum: float = 0.0              # >0 wraps strategy in OuterOptMerge
+    outer_lr: float = 0.7                    # DiLoCo-style outer Nesterov step
 
     # -- bounded runs (tests / smoke) --------------------------------------
     max_steps: Optional[int] = None
@@ -189,6 +191,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        default=d.strategy)
         g.add_argument("--meta-epochs", dest="meta_epochs", type=int,
                        default=d.meta_epochs)
+        g.add_argument("--outer-momentum", dest="outer_momentum", type=float,
+                       default=d.outer_momentum,
+                       help=">0 applies a DiLoCo-style outer Nesterov "
+                            "momentum step over the merged delta")
+        g.add_argument("--outer-lr", dest="outer_lr", type=float,
+                       default=d.outer_lr)
         g.add_argument("--meta-lr", dest="meta_lr", type=float,
                        default=d.meta_lr)
 

@@ -14,6 +14,7 @@ from .validate import Validator
 from .average import (
     AveragerLoop,
     GeneticMerge,
+    OuterOptMerge,
     ParameterizedMerge,
     WeightedAverage,
 )
@@ -24,4 +25,5 @@ __all__ = [
     "LoRAEngine", "LoRAMinerLoop", "fetch_delta_any",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
+    "OuterOptMerge",
 ]

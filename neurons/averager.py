@@ -17,19 +17,34 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+# Platform override BEFORE any project/jax import: some environments
+# force-select a platform from sitecustomize (ignoring JAX_PLATFORMS), so
+# tests and multi-process harnesses route role subprocesses via this env
+# var + jax.config, exactly like tests/conftest.py does.
+if os.environ.get("DT_FORCE_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", os.environ["DT_FORCE_PLATFORM"])
+
 from distributedtraining_tpu.config import RunConfig           # noqa: E402
 from distributedtraining_tpu.engine import (                   # noqa: E402
-    AveragerLoop, GeneticMerge, ParameterizedMerge, WeightedAverage)
+    AveragerLoop, GeneticMerge, OuterOptMerge, ParameterizedMerge,
+    WeightedAverage)
 from neurons.common import build                               # noqa: E402
 
 
 def make_strategy(cfg: RunConfig, model):
     if cfg.strategy == "weighted":
-        return WeightedAverage()
-    if cfg.strategy == "genetic":
-        return GeneticMerge()
-    return ParameterizedMerge(model, meta_epochs=cfg.meta_epochs,
-                              meta_lr=cfg.meta_lr)
+        strategy = WeightedAverage()
+    elif cfg.strategy == "genetic":
+        strategy = GeneticMerge()
+    else:
+        strategy = ParameterizedMerge(model, meta_epochs=cfg.meta_epochs,
+                                      meta_lr=cfg.meta_lr)
+    if cfg.outer_momentum > 0:
+        strategy = OuterOptMerge(strategy, outer_lr=cfg.outer_lr,
+                                 momentum=cfg.outer_momentum)
+    return strategy
 
 
 def main(argv=None) -> int:

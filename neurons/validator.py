@@ -17,6 +17,15 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
+# Platform override BEFORE any project/jax import: some environments
+# force-select a platform from sitecustomize (ignoring JAX_PLATFORMS), so
+# tests and multi-process harnesses route role subprocesses via this env
+# var + jax.config, exactly like tests/conftest.py does.
+if os.environ.get("DT_FORCE_PLATFORM"):
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", os.environ["DT_FORCE_PLATFORM"])
+
 from distributedtraining_tpu.config import RunConfig   # noqa: E402
 from distributedtraining_tpu.engine import Validator   # noqa: E402
 from neurons.common import build                       # noqa: E402
