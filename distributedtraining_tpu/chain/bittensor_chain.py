@@ -23,7 +23,8 @@ from typing import Optional
 
 from .. import spec_version
 from ..utils.timeout import ChainTimeout, run_with_timeout
-from .base import EMA_ALPHA, Metagraph, ema_update, normalize_scores, quantize_u16
+from .base import (EMA_ALPHA, Metagraph, ema_update, mad_anomaly_mask,
+                   normalize_scores, quantize_u16)
 
 CHAIN_OP_TIMEOUT = 60.0  # chain_manager.py:68,86,105
 
@@ -104,8 +105,15 @@ class BittensorChain:
         return [i for i, s in enumerate(m.S) if float(s) >= stake_limit]
 
     def set_weights(self, scores: dict[str, float]) -> bool:
+        """EMA -> MAD anomaly screen -> normalize -> u16 -> chain extrinsic
+        (same pipeline as LocalChain.set_weights; anomalously high scores
+        are zeroed, btt_connector.py:388-426)."""
         self._ema = ema_update(self._ema, scores, EMA_ALPHA)
-        norm = normalize_scores(self._ema)
+        pos = [k for k in self._ema if self._ema[k] > 0]
+        flags = dict(zip(pos, mad_anomaly_mask([self._ema[k] for k in pos])))
+        screened = {k: (0.0 if flags.get(k, False) else v)
+                    for k, v in self._ema.items()}
+        norm = normalize_scores(screened)
         hotkeys = list(self.metagraph.hotkeys)
         uids = [i for i, h in enumerate(hotkeys) if h in norm]
         weights = quantize_u16([norm[hotkeys[u]] for u in uids])

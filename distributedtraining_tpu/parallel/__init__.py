@@ -7,6 +7,7 @@ shardings come from logical-axis rules resolved against a
 ``jax.sharding.Mesh`` with axes (dp, fsdp, sp, tp).
 """
 
+from . import multihost
 from .mesh import MeshConfig, make_mesh, best_mesh_shape
 from .sharding import (
     DEFAULT_RULES,
@@ -17,6 +18,7 @@ from .sharding import (
 )
 
 __all__ = [
+    "multihost",
     "MeshConfig", "make_mesh", "best_mesh_shape",
     "DEFAULT_RULES", "logical_param_specs", "mesh_shardings",
     "shard_batch_spec", "shard_params",

@@ -1,0 +1,101 @@
+"""Multi-host SPMD bring-up (BASELINE.json config 5: v5e-64 pods).
+
+The reference has no multi-node compute plane at all — its "distribution" is
+the asynchronous miner/validator/averager outer loop over HF repos
+(SURVEY.md §2.2). This module supplies the missing intra-role plane: one
+role (say, a miner) spanning a multi-host TPU pod slice as a single SPMD
+program, while the outer federated loop stays exactly as it is.
+
+Usage (identical binary on every host of the slice):
+
+    from distributedtraining_tpu.parallel import multihost
+    multihost.initialize()               # no-op on single host
+    mesh = multihost.pod_mesh(fsdp=8)    # global mesh over all pod chips
+    engine = TrainEngine(model, mesh=mesh, ...)
+
+Design notes:
+- ``jax.distributed.initialize()`` auto-discovers coordinator/rank on TPU
+  pods from the environment; explicit args exist for manual setups.
+- Only process 0 should talk to the transports/chain (publish deltas, set
+  weights); ``is_coordinator()`` gates that. Data loading uses
+  ``process_index`` to shard the document stream.
+- Everything degrades to single-host: initialize() is a no-op when JAX sees
+  one process, and pod_mesh == make_mesh over local devices.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+from .mesh import MeshConfig, make_mesh
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up the JAX distributed runtime (idempotent, single-host no-op).
+
+    On TPU pods all three arguments auto-discover from the environment; pass
+    them explicitly only for manual (e.g. DCN cluster) topologies."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes is None:
+        try:
+            n = jax.process_count()
+        except Exception:
+            n = 1
+        if n <= 1:
+            # single-process already; nothing to initialize
+            _initialized = True
+            return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    logger.info("multihost: process %d/%d, %d global devices",
+                jax.process_index(), jax.process_count(),
+                len(jax.devices()))
+
+
+def is_coordinator() -> bool:
+    """True on the one process that owns transport/chain IO."""
+    return jax.process_index() == 0
+
+
+def pod_mesh(*, dp: int = 0, fsdp: int = 1, sp: int = 1, tp: int = 1):
+    """Global mesh over every chip in the pod slice (all processes).
+
+    dp=0 means "whatever is left": dp = n_global_devices / (fsdp*sp*tp).
+    The mesh uses jax.devices() (global), so the same jitted step on every
+    host forms one SPMD program with XLA collectives riding ICI.
+    """
+    n = len(jax.devices())
+    rest = fsdp * sp * tp
+    if dp == 0:
+        if n % rest:
+            raise ValueError(f"{n} devices not divisible by fsdp*sp*tp={rest}")
+        dp = n // rest
+    cfg = MeshConfig(dp=dp, fsdp=fsdp, sp=sp, tp=tp)
+    if cfg.n_devices != n:
+        raise ValueError(f"mesh {cfg} wants {cfg.n_devices} devices, "
+                         f"pod has {n}")
+    return make_mesh(cfg, devices=jax.devices())
+
+
+def shard_documents(docs, *, process_index: Optional[int] = None,
+                    process_count: Optional[int] = None):
+    """Round-robin split of a document stream across processes so each host
+    feeds its local batch shard distinct data."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    for i, doc in enumerate(docs):
+        if i % pc == pi:
+            yield doc

@@ -95,3 +95,30 @@ def test_psum_merge_matches_reference(devices):
                     jax.tree_util.tree_leaves(expect)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_multihost_single_host_degradation(devices):
+    """initialize() is a no-op on one host; pod_mesh spans all devices;
+    shard_documents with one process yields everything."""
+    from distributedtraining_tpu.parallel import multihost
+
+    multihost.initialize()  # must not raise or start a coordinator
+    assert multihost.is_coordinator()
+
+    mesh = multihost.pod_mesh(fsdp=2, tp=2)
+    assert mesh.shape["dp"] * mesh.shape["fsdp"] * mesh.shape["sp"] \
+        * mesh.shape["tp"] == len(jax.devices())
+    assert mesh.shape["fsdp"] == 2 and mesh.shape["tp"] == 2
+
+    docs = list(multihost.shard_documents(["a", "b", "c"]))
+    assert docs == ["a", "b", "c"]
+    # explicit 2-process split: disjoint and covering
+    p0 = list(multihost.shard_documents("abcdef", process_index=0,
+                                        process_count=2))
+    p1 = list(multihost.shard_documents("abcdef", process_index=1,
+                                        process_count=2))
+    assert p0 == list("ace") and p1 == list("bdf")
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        multihost.pod_mesh(fsdp=3)  # 8 % 3 != 0

@@ -190,3 +190,42 @@ def test_run_with_timeout():
         def boom():
             raise ValueError("x")
         run_with_timeout(boom, 5.0)
+
+
+def test_bittensor_chain_weight_pipeline_screens_anomalies():
+    """BittensorChain.set_weights runs the same EMA->MAD->normalize->u16
+    pipeline as LocalChain, without needing the SDK (faked subtensor)."""
+    from distributedtraining_tpu.chain.bittensor_chain import BittensorChain
+
+    captured = {}
+
+    class _FakeSub:
+        block = 1000
+
+        def set_weights(self, *, wallet, netuid, uids, weights, version_key,
+                        wait_for_inclusion):
+            captured["uids"] = uids
+            captured["weights"] = weights
+            return True
+
+    class _FakeMeta:
+        hotkeys = [f"hk{i}" for i in range(6)]
+
+    chain = BittensorChain.__new__(BittensorChain)
+    chain.netuid = 1
+    chain.epoch_length = 100
+    chain.wallet = object()
+    chain.subtensor = _FakeSub()
+    chain.metagraph = _FakeMeta()
+    chain._ema = {}
+    chain._last_weight_block = -10**9
+
+    # hk5 is a cheater: absurdly high score vs the peer cluster
+    scores = {"hk0": 1.0, "hk1": 1.1, "hk2": 0.9, "hk3": 1.05, "hk5": 500.0}
+    assert chain.set_weights(scores)
+    w = dict(zip(captured["uids"], captured["weights"]))
+    assert w.get(5, 0) == 0                      # anomaly zeroed
+    assert all(w[u] > 0 for u in (0, 1, 2, 3))   # peers kept
+    assert max(captured["weights"]) == 65535     # u16 normalization
+    assert chain._last_weight_block == 1000      # epoch gate advanced
+    assert not chain.should_set_weights()
