@@ -335,21 +335,35 @@ class MinerLoop:
         abstract = self.engine.abstract_state()
         template = Snapshot(state=abstract, base_params=abstract.params,
                             base_revision=None)
-        snap = self.checkpoint_store.restore(template)
-        if snap is None:
+        # A corrupt/partial/incompatible checkpoint (disk fault, model-config
+        # change between runs) must not wedge the miner: under supervise.sh an
+        # unhandled raise here crash-loops forever, defeating the
+        # restart-recovers-from-base escape hatch the save path protects.
+        try:
+            snap = self.checkpoint_store.restore(template)
+            if snap is None:
+                return False
+            self.state = TrainState(
+                step=jnp.asarray(snap.state.step, jnp.int32),
+                params=self.engine.place_params(snap.state.params),
+                opt_state=self.engine.place_opt_state(snap.state.opt_state))
+            self.base_params = _snapshot(
+                self.engine.place_params(snap.base_params))
+            self._base_revision = snap.base_revision
+            # lifetime counter drives metrics step numbering; falling back to
+            # the in-base step would replay step numbers after a resume
+            self.report.steps = (snap.lifetime_steps
+                                 if snap.lifetime_steps is not None
+                                 else int(self.state.step))
+            self._last_ckpt_key = (int(self.state.step), self._base_revision)
+        except Exception:
+            logger.exception(
+                "miner %s: checkpoint restore failed; falling back to "
+                "base pull / self-init", self.miner_id)
+            self.state = None
+            self.base_params = None
+            self._base_revision = None
             return False
-        self.state = TrainState(
-            step=jnp.asarray(snap.state.step, jnp.int32),
-            params=self.engine.place_params(snap.state.params),
-            opt_state=self.engine.place_opt_state(snap.state.opt_state))
-        self.base_params = _snapshot(self.engine.place_params(snap.base_params))
-        self._base_revision = snap.base_revision
-        # lifetime counter drives metrics step numbering; falling back to the
-        # in-base step would replay step numbers into the sink after a resume
-        self.report.steps = (snap.lifetime_steps
-                             if snap.lifetime_steps is not None
-                             else int(self.state.step))
-        self._last_ckpt_key = (int(self.state.step), self._base_revision)
         logger.info("miner %s: resumed from checkpoint at step %d "
                     "(lifetime %d)", self.miner_id, int(self.state.step),
                     self.report.steps)

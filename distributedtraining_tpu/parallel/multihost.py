@@ -26,6 +26,7 @@ Design notes:
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional
 
 import jax
@@ -36,6 +37,57 @@ logger = logging.getLogger(__name__)
 
 _initialized = False
 
+# Environment markers that mean "this process is one of several in a pod/
+# cluster job". jax.distributed.initialize() auto-discovers its arguments
+# from exactly these launchers; anything else is single-host.
+_MULTIPROCESS_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",    # generic jax launcher
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",  # multislice TPU
+)
+
+
+def _gce_tpu_worker_count() -> int:
+    """Worker count from the GCE metadata server — plain Cloud TPU pod
+    slices launched via gcloud export no env vars; JAX's own cluster
+    auto-detect queries this same endpoint. Returns 1 on any failure."""
+    if os.environ.get("TPU_SKIP_MDS_QUERY"):
+        return 1
+    import urllib.request
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "attributes/worker-network-endpoints",
+        headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(req, timeout=1.0) as r:
+            return len([e for e in r.read().decode().split(",") if e])
+    except Exception:  # malformed responses included — never crash startup
+        return 1
+
+
+def _multiprocess_env() -> bool:
+    env = os.environ
+    if any(env.get(k) for k in _MULTIPROCESS_ENV_VARS):
+        return True
+    # TPU pod metadata: single-host TPU VMs also set this (one hostname), so
+    # it only signals multi-process when several workers are listed
+    if len([h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]) > 1:
+        return True
+    for k in ("SLURM_NTASKS", "SLURM_NPROCS", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(env.get(k, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    # last resort, only when this looks like a TPU VM (/dev/accel* is
+    # TPU-specific; /dev/vfio also exists on non-GCE GPU-passthrough hosts
+    # where a metadata.google.internal lookup would stall in DNS): ask the
+    # metadata server like jax's cloud_tpu_cluster does
+    import glob
+    if glob.glob("/dev/accel*"):
+        return _gce_tpu_worker_count() > 1
+    return False
+
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
@@ -43,19 +95,21 @@ def initialize(coordinator_address: Optional[str] = None,
     """Bring up the JAX distributed runtime (idempotent, single-host no-op).
 
     On TPU pods all three arguments auto-discover from the environment; pass
-    them explicitly only for manual (e.g. DCN cluster) topologies."""
+    them explicitly only for manual (e.g. DCN cluster) topologies.
+
+    The multi-process decision is made from environment signals alone —
+    NEVER by probing jax (``jax.process_count()`` would initialize the XLA
+    backend, after which ``jax.distributed.initialize`` unconditionally
+    raises "must be called before any JAX calls")."""
     global _initialized
     if _initialized:
         return
-    if coordinator_address is None and num_processes is None:
-        try:
-            n = jax.process_count()
-        except Exception:
-            n = 1
-        if n <= 1:
-            # single-process already; nothing to initialize
-            _initialized = True
-            return
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
+    if not explicit and not _multiprocess_env():
+        # single-process launch; nothing to initialize
+        _initialized = True
+        return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -82,8 +82,14 @@ class Identity:
         }
         tmp = path + ".tmp"
         # owner-only from birth: the payload holds the private key, so the
-        # tmp file must never exist with umask-default permissions
-        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        # tmp file must never exist with umask-default permissions. POSIX
+        # applies the mode only at creation, so a stale tmp left by a crash
+        # would keep its old permissions — unlink it and create exclusively.
+        try:
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
         with os.fdopen(fd, "w") as f:
             json.dump(payload, f, indent=2)
         os.replace(tmp, path)

@@ -26,20 +26,34 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 DEFAULT_TTL = 120.0  # seconds a registration stays live without refresh
+DEFAULT_MAX_PEERS = 4096   # bound on distinct hotkeys a client can grow
+MAX_FIELD_LEN = 512        # bound on hotkey/address string lengths
 
 
 class PeerRegistry:
     """In-process registry state (also usable directly in tests)."""
 
-    def __init__(self, ttl: float = DEFAULT_TTL):
+    def __init__(self, ttl: float = DEFAULT_TTL,
+                 max_peers: int = DEFAULT_MAX_PEERS):
         self.ttl = ttl
+        self.max_peers = max_peers
         self._peers: dict[str, tuple[str, float]] = {}
         self._lock = threading.Lock()
 
     def register(self, hotkey: str, address: str,
                  now: Optional[float] = None) -> None:
+        t = time.time() if now is None else now
         with self._lock:
-            self._peers[hotkey] = (address, time.time() if now is None else now)
+            # bounded memory: a hostile client POSTing unlimited distinct
+            # hotkeys must not grow the server without limit (the reference
+            # bootstrap pool this replaces was a fixed-size list)
+            if hotkey not in self._peers and len(self._peers) >= self.max_peers:
+                self._peers = {h: (a, ts) for h, (a, ts) in self._peers.items()
+                               if t - ts <= self.ttl}
+                while len(self._peers) >= self.max_peers:
+                    oldest = min(self._peers, key=lambda h: self._peers[h][1])
+                    del self._peers[oldest]
+            self._peers[hotkey] = (address, t)
 
     def peers(self, now: Optional[float] = None) -> list[dict]:
         t = time.time() if now is None else now
@@ -81,6 +95,8 @@ class _Handler(BaseHTTPRequestHandler):
                            1 << 16))
             body = json.loads(self.rfile.read(n) or b"{}")
             hotkey, address = str(body["hotkey"]), str(body["address"])
+            if len(hotkey) > MAX_FIELD_LEN or len(address) > MAX_FIELD_LEN:
+                raise ValueError("field too long")
         except (ValueError, KeyError, TypeError):  # non-dict JSON included
             self._send(400, {"error": "bad request"})
             return

@@ -52,21 +52,36 @@ while :; do
   python "$REPO_DIR/neurons/$ROLE.py" "$@" &
   pid=$!
 
-  # watchdog: poll for updates while the role runs
-  while kill -0 "$pid" 2>/dev/null; do
-    sleep "$UPDATE_CHECK_S" &
-    wait $! 2>/dev/null
-    kill -0 "$pid" 2>/dev/null || break
-    if maybe_update; then
-      log "restarting $ROLE into updated code"
-      kill -TERM "$pid" 2>/dev/null
+  # Watchdog: check the role every 5s so a crash restarts promptly (not
+  # after the 30-min update-poll sleep) and uptime reflects the role's real
+  # lifetime — otherwise the MIN_UPTIME crash counter can never trip for a
+  # crash-looping role. Plain sleep/kill -0 only: `wait -n` with pid
+  # arguments needs bash >= 5.1 and silently busy-loops on older bashes.
+  code=""
+  died=""
+  next_poll=$(( start + UPDATE_CHECK_S ))
+  while :; do
+    if ! kill -0 "$pid" 2>/dev/null; then
       wait "$pid" 2>/dev/null
+      code=$?
+      died=$(date +%s)
       break
     fi
+    now=$(date +%s)
+    if [ "$now" -ge "$next_poll" ]; then
+      next_poll=$(( now + UPDATE_CHECK_S ))
+      if maybe_update; then
+        log "restarting $ROLE into updated code"
+        kill -TERM "$pid" 2>/dev/null
+        wait "$pid" 2>/dev/null
+        code=$?
+        died=$(date +%s)
+        break
+      fi
+    fi
+    sleep 5
   done
-  wait "$pid" 2>/dev/null
-  code=$?
-  uptime=$(( $(date +%s) - start ))
+  uptime=$(( died - start ))
 
   if [ "$uptime" -ge "$MIN_UPTIME_S" ]; then
     crashes=0              # pm2 min_uptime semantics: long life resets count

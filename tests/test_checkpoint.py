@@ -111,6 +111,33 @@ def test_miner_resume_after_preemption(tmp_path, setup):
                    for x, y in zip(d, b))
 
 
+def test_corrupt_checkpoint_falls_back_to_base(tmp_path, setup):
+    """An unreadable/corrupt checkpoint must not wedge the miner: bootstrap
+    logs and falls through to the base-pull/self-init path instead of
+    raising (a raise would crash-loop the role under supervise.sh)."""
+    model, cfg, engine, batches = setup
+
+    class BrokenStore:
+        def latest_step(self):
+            return 3
+
+        def restore(self, template, step=None):
+            raise OSError("disk fault: truncated checkpoint")
+
+        def next_step(self):
+            return 4
+
+    transport = InMemoryTransport()
+    miner = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                      send_interval=1e9, check_update_interval=1e9,
+                      checkpoint_store=BrokenStore(), checkpoint_interval=1e9)
+    miner.bootstrap(jax.random.PRNGKey(0))  # must not raise
+    assert miner.state is not None
+    assert int(miner.state.step) == 0       # self-initialized, not restored
+    miner.run(batches(), max_steps=2)
+    assert miner.report.steps == 2
+
+
 def test_resume_after_base_pull_step_reset(tmp_path, setup):
     """Checkpoint keys must stay monotonic across base pulls: the training
     step resets to 0 on every base update, so a step-keyed store would

@@ -163,6 +163,47 @@ def test_registry_register_and_prune():
     assert {p["hotkey"] for p in live} == {"hk2"}
 
 
+def test_registry_bounded_memory():
+    """A hostile client POSTing unlimited distinct hotkeys cannot grow the
+    server without limit: past max_peers the oldest entries are evicted."""
+    r = reg.PeerRegistry(ttl=1000.0, max_peers=8)
+    for i in range(20):
+        r.register(f"hk{i}", "a:1", now=100.0 + i)
+    live = r.peers(now=120.0)
+    assert len(live) <= 8
+    # the newest registrations survive, the oldest were evicted
+    assert {p["hotkey"] for p in live} == {f"hk{i}" for i in range(12, 20)}
+    # refreshing an existing hotkey never evicts
+    r.register("hk19", "a:2", now=121.0)
+    assert len(r.peers(now=121.0)) <= 8
+
+
+def test_registry_rejects_oversized_fields():
+    srv, url = reg.serve(ttl=60.0)
+    try:
+        assert not reg.register_peer(url, "x" * 600, "10.0.0.1:5000")
+        assert not reg.register_peer(url, "hkA", "y" * 600)
+        assert reg.get_peers(url) == []
+    finally:
+        srv.shutdown()
+
+
+def test_identity_save_resets_stale_tmp_permissions(tmp_path):
+    """A stale world-readable tmp file must not leak the private key: save
+    unlinks it and recreates 0600-from-birth (POSIX mode applies only at
+    creation)."""
+    import os
+    path = str(tmp_path / "w.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("stale")
+    os.chmod(tmp, 0o644)
+    ident = Identity.generate()
+    ident.save(path)
+    assert (os.stat(path).st_mode & 0o777) == 0o600
+    assert Identity.load(path).hotkey == ident.hotkey
+
+
 def test_registry_http_roundtrip():
     srv, url = reg.serve(ttl=60.0)
     try:

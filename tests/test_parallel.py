@@ -122,3 +122,34 @@ def test_multihost_single_host_degradation(devices):
     import pytest as _pytest
     with _pytest.raises(ValueError):
         multihost.pod_mesh(fsdp=3)  # 8 % 3 != 0
+
+
+def test_multihost_env_detection(monkeypatch):
+    """The multi-process decision comes from environment signals only —
+    probing jax.process_count() would initialize the XLA backend and make a
+    later jax.distributed.initialize() raise unconditionally."""
+    from distributedtraining_tpu.parallel import multihost
+
+    for var in multihost._MULTIPROCESS_ENV_VARS + (
+            "SLURM_NTASKS", "SLURM_NPROCS", "OMPI_COMM_WORLD_SIZE",
+            "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    # isolate from the /dev/accel* metadata-server fallback: on a real pod
+    # slice it would answer >1 and on non-GCE hosts it would hit the network
+    monkeypatch.setenv("TPU_SKIP_MDS_QUERY", "1")
+    assert not multihost._multiprocess_env()
+
+    # single-host TPU VMs set one hostname; only several workers signal a pod
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert not multihost._multiprocess_env()
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1,w2,w3")
+    assert multihost._multiprocess_env()
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES")
+
+    monkeypatch.setenv("SLURM_NTASKS", "1")
+    assert not multihost._multiprocess_env()
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    assert multihost._multiprocess_env()
+    monkeypatch.delenv("SLURM_NTASKS")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    assert multihost._multiprocess_env()
