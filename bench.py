@@ -1,18 +1,28 @@
-"""Headline benchmark: miner train-step throughput, GPT-2-124M, one chip.
+"""Headline benchmark: the BASELINE.json north-star pair on one chip.
 
-North-star metric per BASELINE.json: miner tokens/sec/chip for GPT-2-124M.
-The reference publishes no numbers (BASELINE.md) — `vs_baseline` is reported
-against the framework's own first recorded measurement (BENCH_r1), i.e. 1.0
-establishes the baseline in round 1.
+Emits exactly ONE JSON line whose primary metric is miner train throughput
+(GPT-2-124M tokens/sec/chip, flash attention, bf16 activations), pinned
+against the round-1 measurement. The same object carries the rest of the
+north star (BASELINE.json: "miner tokens/sec/chip + averager merge
+wall-clock"):
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+  value / vs_baseline     tokens/sec/chip vs the pinned r01 figure
+  mfu                     model-FLOP utilization vs the chip's peak bf16
+  dense_tokens_per_sec    same step with attention_impl="dense"
+  flash_speedup           flash/dense throughput ratio at T=1024
+  merge_wallclock_s       averager weighted-merge of M=8 full GPT-2-124M
+                          deltas (jitted, device-resident), mean seconds
+  merge_gbps              delta bytes touched / merge wall-clock
+
+The reference publishes no numbers (BASELINE.md); round 1 established
+92,843 tok/s/chip on this rig, so vs_baseline > 1.0 means the framework got
+faster than its own first measurement.
 """
 
 from __future__ import annotations
 
 import json
-import sys
+import os
 import time
 
 import jax
@@ -23,43 +33,147 @@ BATCH = 8
 SEQ = 1024
 WARMUP = 3
 ITERS = 20
-BASELINE_TOKENS_PER_SEC = None  # set from BENCH_r1 once recorded
+MERGE_M = 8           # miners in the merge bench (BASELINE config 3 scale)
+MERGE_ITERS = 5
+BASELINE_TOKENS_PER_SEC = 92843.0   # BENCH_r01.json, this rig, r01 code
+
+# peak dense bf16 FLOP/s per chip by TPU generation (public spec sheets);
+# MFU is reported against the best matching entry, else omitted. JAX reports
+# the e-generations as "TPU v5 lite"/"TPU v6 lite", hence the ladder.
+def _peak_flops() -> float | None:
+    kind = ""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        pass
+    text = f"{kind} {os.environ.get('PALLAS_AXON_TPU_GEN', '').lower()}"
+    if "v6e" in text or "v6 lite" in text:
+        return 918e12
+    if "v5p" in text:
+        return 459e12
+    if "v5e" in text or "v5 lite" in text:
+        return 197e12
+    if "v4" in text:
+        return 275e12
+    return None
 
 
-def main() -> None:
+def _time_train(model, cfg, *, iters: int = ITERS) -> float:
+    """tokens/sec of the jitted train step (fwd+bwd+adamw) on one chip."""
     from distributedtraining_tpu.engine import TrainEngine
-    from distributedtraining_tpu.models import gpt2
 
-    model, cfg = gpt2.make_model("gpt2-124m")
     engine = TrainEngine(model, seq_len=SEQ)
     state = engine.init_state(jax.random.PRNGKey(0))
-
     rng = np.random.default_rng(0)
     batch = {
         "input_ids": jnp.asarray(
             rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32),
     }
-
     for _ in range(WARMUP):
         state, m = engine.train_step(state, batch)
     float(m["loss"])  # full host sync — the axon backend's block_until_ready
     # does not actually block, so timing must end on a value fetch
 
     t0 = time.perf_counter()
-    for _ in range(ITERS):
+    for _ in range(iters):
         state, m = engine.train_step(state, batch)
     final_loss = float(m["loss"])  # forces the whole dependency chain
     dt = time.perf_counter() - t0
     assert final_loss == final_loss, "loss is NaN"
+    return BATCH * SEQ * iters / dt
 
-    tokens_per_sec = BATCH * SEQ * ITERS / dt
-    vs = (tokens_per_sec / BASELINE_TOKENS_PER_SEC
-          if BASELINE_TOKENS_PER_SEC else 1.0)
+
+def _param_count(model) -> int:
+    abstract = jax.eval_shape(
+        lambda: model.init_params(jax.random.PRNGKey(0)))
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(abstract))
+
+
+def _time_merge(model) -> tuple[float, float]:
+    """(mean seconds, GB/s of delta bytes) for the averager's jitted
+    weighted merge of MERGE_M full-parameter GPT-2-124M deltas — the second
+    half of the north-star metric. Single-chip here; the mesh path
+    (ingest-sharded stack + psum all-reduce, parallel/collectives.py) is
+    exercised by dryrun_multichip and tests/test_parallel.py."""
+    from distributedtraining_tpu import delta as delta_lib
+
+    params = model.init_params(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    deltas = []
+    for i in range(MERGE_M):
+        key, k = jax.random.split(key)
+        ks = jax.random.split(k, len(leaves))
+        deltas.append(jax.tree_util.tree_unflatten(
+            treedef, [0.01 * jax.random.normal(kk, l.shape, l.dtype)
+                      for kk, l in zip(ks, leaves)]))
+    stacked = delta_lib.stack_deltas(deltas)
+    w = jnp.full((MERGE_M,), 1.0 / MERGE_M)
+
+    @jax.jit
+    def merge(params, stacked, w):
+        merged = delta_lib.weighted_merge(params, stacked, w)
+        # scalar probe depending on EVERY leaf: fetching one leaf would end
+        # timing with the other ~150 tensor merges still in flight (the
+        # axon backend's block_until_ready does not actually block)
+        probe = sum(l.reshape(-1)[0]
+                    for l in jax.tree_util.tree_leaves(merged))
+        return merged, probe
+
+    merged, probe = merge(params, stacked, w)
+    float(probe)  # warm + full sync
+
+    t0 = time.perf_counter()
+    for _ in range(MERGE_ITERS):
+        out, probe = merge(params, stacked, w)
+    float(probe)
+    dt = (time.perf_counter() - t0) / MERGE_ITERS
+
+    n_bytes = sum(l.size * l.dtype.itemsize
+                  for l in jax.tree_util.tree_leaves(stacked))
+    return dt, n_bytes / dt / 1e9
+
+
+def main() -> None:
+    from distributedtraining_tpu.models import gpt2
+
+    model, cfg = gpt2.make_model("gpt2-124m")
+    tokens_per_sec = _time_train(model, cfg)
+
+    extras = {}
+    try:
+        dense_model, dense_cfg = gpt2.make_model(
+            gpt2.GPT2Config(attention_impl="dense"))
+        dense_tps = _time_train(dense_model, dense_cfg)
+        extras["dense_tokens_per_sec"] = round(dense_tps, 1)
+        extras["flash_speedup"] = round(tokens_per_sec / dense_tps, 3)
+    except Exception as e:  # a failed sub-bench must not sink the headline
+        extras["dense_error"] = repr(e)
+
+    peak = _peak_flops()
+    if peak:
+        n_params = _param_count(model)
+        # per-token model FLOPs: 6N for the matmuls (fwd+bwd) plus the
+        # attention term 12 * L * E * T
+        flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * SEQ
+        extras["mfu"] = round(tokens_per_sec * flops_per_token / peak, 4)
+        extras["peak_flops"] = peak
+
+    try:
+        merge_s, merge_gbps = _time_merge(model)
+        extras["merge_wallclock_s"] = round(merge_s, 4)
+        extras["merge_gbps"] = round(merge_gbps, 1)
+        extras["merge_m"] = MERGE_M
+    except Exception as e:
+        extras["merge_error"] = repr(e)
+
     print(json.dumps({
         "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+        **extras,
     }))
 
 

@@ -56,7 +56,9 @@ class RunConfig:
     averaged_model_repo_id: Optional[str] = None
 
     # -- model / optimization ----------------------------------------------
-    model: str = "gpt2-124m"                 # gpt2 preset name
+    model: str = "gpt2-124m"                 # gpt2/llama preset name
+    init_from: Optional[str] = None          # pretrained weights (hf:<repo>,
+                                             # dir, or .safetensors/.bin path)
     seq_len: int = 64                        # miner train len (miner.py:70)
     eval_seq_len: int = 512                  # validator len (validator.py:63)
     batch_size: int = 8
@@ -139,6 +141,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
 
     g = p.add_argument_group("model")
     g.add_argument("--model", default=d.model)
+    g.add_argument("--init-from", dest="init_from", default=None,
+                   help="pretrained checkpoint to start from when no base "
+                        "is published yet: hf:<repo_id> (local HF cache), a "
+                        "checkpoint directory, or a .safetensors/.bin file "
+                        "(the reference fine-tunes pretrained GPT-2, "
+                        "neurons/miner.py:60)")
     g.add_argument("--seq-len", dest="seq_len", type=int, default=d.seq_len)
     g.add_argument("--eval-seq-len", dest="eval_seq_len", type=int,
                    default=d.eval_seq_len)

@@ -143,6 +143,26 @@ def stack_deltas(deltas: Sequence[Params]) -> Params:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *deltas)
 
 
+def miner_axis_size(stacked: Params) -> int:
+    """Leading-axis length of a stacked-delta tree (may exceed the real miner
+    count when the stack was zero-padded for even sharding)."""
+    return jax.tree_util.tree_leaves(stacked)[0].shape[0]
+
+
+def pad_merge_weights(weights: jax.Array, m_padded: int) -> jax.Array:
+    """Zero-pad a (M,) mixing vector to a zero-padded stack's leading size:
+    padding slots weigh nothing, so the merge is unchanged. Normalize
+    (softmax etc.) over the REAL M before padding — normalizing after would
+    leak probability mass onto the zero deltas and shrink the update."""
+    m = weights.shape[0]
+    if m == m_padded:
+        return weights
+    if m > m_padded:
+        raise ValueError(f"{m} weights for a {m_padded}-entry stack")
+    return jnp.concatenate(
+        [weights, jnp.zeros((m_padded - m,), weights.dtype)])
+
+
 def unstack_deltas(stacked: Params) -> list[Params]:
     n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
     return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]

@@ -63,7 +63,14 @@ class WeightedAverage:
                                for h in miner_ids])
             total = raw.sum()
             w = jnp.full((m,), 1.0 / m) if total <= 0 else raw / total
-        merged = jax.jit(delta_lib.weighted_merge)(base, stacked, w)
+        if getattr(engine, "mesh", None) is not None:
+            # BASELINE config 3: local partial sums over the sharded miner
+            # axis + one ICI all-reduce (parallel/collectives.py)
+            from ..parallel.collectives import merge_axis, psum_weighted_merge
+            merged = psum_weighted_merge(base, stacked, w, engine.mesh,
+                                         axis=merge_axis(engine.mesh))
+        else:
+            merged = jax.jit(delta_lib.weighted_merge)(base, stacked, w)
         return merged, w
 
 
@@ -152,6 +159,13 @@ class ParameterizedMerge:
 
     def _build_step(self, base, stacked):
         model = self.model
+        # the stack may be zero-padded for even mesh sharding; weights are
+        # normalized over the REAL miner count, then zero-padded to match
+        # (padding a softmax input instead would leak mass onto zero deltas).
+        # With an ingest-sharded stack, GSPMD compiles the sum over the
+        # sharded miner axis into local partial sums + an ICI all-reduce —
+        # the same collective psum_weighted_merge spells out explicitly.
+        m_pad = delta_lib.miner_axis_size(stacked)
 
         def mixture(w):
             if self.softmax_weights:
@@ -161,8 +175,11 @@ class ParameterizedMerge:
             else:
                 norm = w
             if self.per_tensor:
+                norm = jax.tree_util.tree_map(
+                    lambda x: delta_lib.pad_merge_weights(x, m_pad), norm)
                 return delta_lib.per_tensor_weighted_merge(base, stacked, norm)
-            return delta_lib.weighted_merge(base, stacked, norm)
+            return delta_lib.weighted_merge(
+                base, stacked, delta_lib.pad_merge_weights(norm, m_pad))
 
         def loss_fn(w, batch):
             params = mixture(w)
@@ -227,8 +244,15 @@ class GeneticMerge:
               *, val_batches: Callable[[], Iterable[dict]],
               consensus=None) -> tuple[Params, jax.Array]:
         m = len(miner_ids)
+        m_pad = delta_lib.miner_axis_size(stacked)
         rng = jax.random.PRNGKey(self.seed)
-        merge_fn = jax.jit(delta_lib.weighted_merge)
+
+        @jax.jit
+        def merge_fn(base, stacked, w):
+            # w is normalized over the real M; zero-pad to a padded stack
+            return delta_lib.weighted_merge(
+                base, stacked, delta_lib.pad_merge_weights(w, m_pad))
+
         cache: dict[bytes, float] = {}
 
         def fitness(w) -> float:
@@ -301,14 +325,20 @@ class AveragerLoop:
         self.base_params: Params | None = None
         self._base_revision = None
 
-    def bootstrap(self, rng=None, params: Params | None = None) -> None:
-        template = params if params is not None else \
+    def bootstrap(self, rng=None, params=None) -> None:
+        """``params`` (value or zero-arg callable, e.g. a pretrained loader)
+        seeds the genesis base; an already-published base always wins."""
+        given = None if callable(params) else params
+        template = given if given is not None else \
             self.engine.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
         fetched = self.transport.fetch_base(template) \
             if self.transport.base_revision() is not None else None
         if fetched is not None:
             self.base_params, self._base_revision = fetched
         else:
+            if given is None and callable(params):
+                loaded = params()
+                template = loaded if loaded is not None else template
             self.base_params = template
             # genesis: the averager owns the shared repo and publishes the
             # first base (averaging_logic.py:549-568)
@@ -351,7 +381,15 @@ class AveragerLoop:
         if not ids:
             logger.info("averager: no valid deltas this round")
             return False
-        stacked = delta_lib.stack_deltas(deltas)
+        if getattr(self.engine, "mesh", None) is not None:
+            # ingest-shard the miner axis: the full M x params stack never
+            # materializes on one device, and every merge strategy's sum
+            # over that axis runs as partial sums + ICI all-reduce
+            from ..parallel.collectives import merge_axis, stack_deltas_sharded
+            stacked = stack_deltas_sharded(deltas, self.engine.mesh,
+                                           axis=merge_axis(self.engine.mesh))
+        else:
+            stacked = delta_lib.stack_deltas(deltas)
         consensus = getattr(self.chain, "consensus_scores", lambda: {})()
         merged, weights = self.strategy.merge(
             self.engine, self.base_params, stacked, ids,

@@ -102,7 +102,10 @@ class LoRAMinerLoop(MinerLoop):
         self._rng = jax.random.PRNGKey(0)
 
     # -- base lifecycle -----------------------------------------------------
-    def bootstrap(self, rng: jax.Array | None = None) -> None:
+    def bootstrap(self, rng: jax.Array | None = None,
+                  params=None) -> None:
+        """``params`` (value or zero-arg callable) seeds the frozen base when
+        no base is published yet — see MinerLoop.bootstrap."""
         if rng is not None:
             self._rng = rng
         if self._restore_checkpoint(self._rng):
@@ -114,7 +117,8 @@ class LoRAMinerLoop(MinerLoop):
             base, rev = fetched
             self._base_revision = rev
         else:
-            base = template
+            init = params() if callable(params) else params
+            base = init if init is not None else template
         self.base_params = _place(base)
         self.state = self.engine.init_state(self._rng, self.base_params)
 

@@ -259,9 +259,17 @@ class MinerLoop:
                                                self.clock)
 
     # -- base model lifecycle ----------------------------------------------
-    def bootstrap(self, rng: jax.Array | None = None) -> None:
+    def bootstrap(self, rng: jax.Array | None = None,
+                  params: Params | Callable[[], Params] | None = None) -> None:
         """Resume from a local checkpoint if one exists; else pull the
-        published base if one exists; else self-initialize.
+        published base if one exists; else start from ``params`` (e.g. a
+        pretrained checkpoint via models/convert.py, matching the
+        reference's AutoModelForCausalLM.from_pretrained starting point,
+        neurons/miner.py:60); else self-initialize randomly.
+
+        ``params`` may be a zero-arg callable — it is invoked only on the
+        genesis path, so a role restarting under supervision never pays the
+        checkpoint load/convert for weights it immediately discards.
 
         The checkpoint path is strictly better than the reference's restart
         behavior (it preserves optimizer moments across a preemption); the
@@ -269,16 +277,18 @@ class MinerLoop:
         training_manager.py:371-377)."""
         if self._restore_checkpoint(rng):
             return
-        fetched = None
-        template = self.engine.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
-        if self.transport.base_revision() is not None:
-            fetched = self.transport.fetch_base(template)
+        template = self.engine.model.init_params(
+            rng if rng is not None else jax.random.PRNGKey(0))
+        fetched = self.transport.fetch_base(template) \
+            if self.transport.base_revision() is not None else None
         if fetched is not None:
-            params, rev = fetched
+            base, rev = fetched
             self._base_revision = rev
-            self.state = self.engine.init_state(params=params)
+            self.state = self.engine.init_state(params=base)
         else:
-            self.state = self.engine.init_state(params=template)
+            init = params() if callable(params) else params
+            self.state = self.engine.init_state(
+                params=init if init is not None else template)
         self.base_params = _snapshot(self.state.params)
 
     def _check_pull(self) -> None:

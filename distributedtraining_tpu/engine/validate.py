@@ -70,15 +70,20 @@ class Validator:
         self.base_ppl: float | None = None
 
     # -- base model ---------------------------------------------------------
-    def bootstrap(self, rng=None) -> None:
-        template = self.engine.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
+    def bootstrap(self, rng=None, params=None) -> None:
+        """``params`` (value or zero-arg callable, e.g. a pretrained loader)
+        is used only when no base is published yet — see MinerLoop.bootstrap."""
+        template = self.engine.model.init_params(
+            rng if rng is not None else jax.random.PRNGKey(0))
         fetched = self.transport.fetch_base(template) \
             if self.transport.base_revision() is not None else None
         if fetched is not None:
             self.base_params, self._base_revision = fetched
             self.base_params = self.engine.place_params(self.base_params)
         else:
-            self.base_params = self.engine.place_params(template)
+            init = params() if callable(params) else params
+            self.base_params = self.engine.place_params(
+                init if init is not None else template)
         self._eval_base()
 
     def _eval_base(self) -> None:

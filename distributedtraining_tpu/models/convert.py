@@ -1,0 +1,306 @@
+"""HF pretrained-checkpoint import/export (torch/safetensors <-> Flax trees).
+
+The reference's actual workload is fine-tuning *pretrained* GPT-2 —
+``AutoModelForCausalLM.from_pretrained("openai-community/gpt2")``
+(/root/reference/neurons/miner.py:60) with the tokenizer/embedding contract
+at /root/reference/hivetrain/training_manager.py:40-45. This module makes
+the same starting point available to the TPU engines: it maps HF checkpoint
+tensors (safetensors or torch .bin) onto this package's GPT-2/Llama pytrees
+and back, so a miner can `--init-from hf:gpt2` and an exported base can be
+loaded by stock `transformers`.
+
+Shape contracts handled here (and nowhere else):
+- vocab padding: models store ``padded_vocab`` rows (lane-aligned multiple
+  of 128); HF stores the raw vocab. Import zero-pads the tail rows, export
+  slices them back off. Padded rows produce logits ~0 which never win an
+  argmax against real logits and are excluded by the loss's target range.
+- GPT-2 fused QKV: HF's Conv1D ``c_attn`` is already a fused [E, 3E]
+  (in, out) matrix in q|k|v order — identical to this model's layout, so
+  the copy is direct (torch ``nn.Linear`` layers, by contrast, store
+  (out, in) and need the transpose Llama import applies).
+- tied head: HF GPT-2 ties ``lm_head`` to ``wte``; this model computes
+  logits from ``wte`` directly, so ``lm_head.weight`` is skipped on import
+  and emitted as a tie on export.
+- RoPE convention: HF checkpoints store q/k projections pre-permuted for
+  half-split rotate_half rotary — the same convention ops-side
+  ``rotary_embedding`` uses — so Llama q/k import is transpose-only.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+
+# ---------------------------------------------------------------------------
+# Source resolution: spec string -> flat {name: np.ndarray}
+# ---------------------------------------------------------------------------
+
+def _to_numpy(t) -> np.ndarray:
+    """torch tensor / array-like -> numpy, without importing torch up-front."""
+    if hasattr(t, "detach"):  # torch.Tensor
+        t = t.detach().cpu()
+        if str(t.dtype) == "torch.bfloat16":
+            t = t.float()  # numpy has no native bf16; params are fp32 anyway
+        return t.numpy()
+    return np.asarray(t)
+
+
+def _load_safetensors_file(path: str) -> dict[str, np.ndarray]:
+    from .. import serialization as ser
+    with open(path, "rb") as f:
+        data = f.read()
+    return ser._parse_safetensors(data)
+
+
+def _load_torch_file(path: str) -> dict[str, np.ndarray]:
+    import torch
+    # weights_only: never unpickle arbitrary objects from a checkpoint
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: _to_numpy(v) for k, v in state.items()}
+
+
+_WEIGHT_FILES = ("model.safetensors", "pytorch_model.bin")
+
+
+def load_flat(source) -> dict[str, np.ndarray]:
+    """Flat HF-style state dict from any supported source:
+
+    - a mapping (torch ``state_dict()`` or {name: array})
+    - a ``.safetensors`` / ``.bin`` / ``.pt`` file path
+    - a checkpoint directory (picks model.safetensors / pytorch_model.bin,
+      or every ``*.safetensors`` shard)
+    - ``hf:<repo_id>`` — resolved from the local HF cache only (no network;
+      pre-seed the cache on a connected box with
+      ``huggingface_hub.snapshot_download``)
+    """
+    if isinstance(source, Mapping):
+        return {k: _to_numpy(v) for k, v in source.items()}
+    if not isinstance(source, (str, os.PathLike)):
+        raise TypeError(f"unsupported source {type(source)}")
+    spec = os.fspath(source)
+    if spec.startswith("hf:"):
+        from huggingface_hub import snapshot_download
+        spec = snapshot_download(spec[3:], local_files_only=True)
+    if os.path.isdir(spec):
+        shards = sorted(
+            f for f in os.listdir(spec)
+            if re.fullmatch(r".*\.safetensors", f))
+        if shards:
+            flat: dict[str, np.ndarray] = {}
+            for f in shards:
+                flat.update(_load_safetensors_file(os.path.join(spec, f)))
+            return flat
+        for name in _WEIGHT_FILES:
+            p = os.path.join(spec, name)
+            if os.path.exists(p):
+                return load_flat(p)
+        raise FileNotFoundError(f"no weight files under {spec}")
+    if spec.endswith(".safetensors"):
+        return _load_safetensors_file(spec)
+    return _load_torch_file(spec)
+
+
+def _pad_rows(x: np.ndarray, rows: int) -> np.ndarray:
+    if x.shape[0] == rows:
+        return x
+    if x.shape[0] > rows:
+        raise ValueError(f"vocab {x.shape[0]} exceeds padded target {rows}")
+    pad = np.zeros((rows - x.shape[0],) + x.shape[1:], x.dtype)
+    return np.concatenate([x, pad], axis=0)
+
+
+def _strip_prefix(flat: Mapping[str, np.ndarray], prefix: str
+                  ) -> dict[str, np.ndarray]:
+    if any(k.startswith(prefix) for k in flat):
+        return {k[len(prefix):] if k.startswith(prefix) else k: v
+                for k, v in flat.items()}
+    return dict(flat)
+
+
+# ---------------------------------------------------------------------------
+# GPT-2
+# ---------------------------------------------------------------------------
+
+def gpt2_from_hf(source, cfg) -> Params:
+    """HF GPT-2 checkpoint -> this package's GPT-2 param tree
+    (models/gpt2.py). ``cfg`` must match the checkpoint's architecture;
+    shapes are validated leaf-by-leaf."""
+    flat = _strip_prefix(load_flat(source), "transformer.")
+    dt = np.dtype(str(cfg.storage_dtype()))
+
+    def take(name, shape, *, pad_vocab_rows=False):
+        if name not in flat:
+            raise KeyError(f"checkpoint missing {name!r}")
+        x = np.asarray(flat[name], dtype=dt)
+        if pad_vocab_rows:
+            x = _pad_rows(x, cfg.padded_vocab)
+        if tuple(x.shape) != tuple(shape):
+            raise ValueError(f"{name}: shape {x.shape} != expected {shape}")
+        return x
+
+    E = cfg.n_embd
+    params: dict[str, Any] = {
+        "wte": take("wte.weight", (cfg.padded_vocab, E), pad_vocab_rows=True),
+        "wpe": take("wpe.weight", (cfg.n_positions, E)),
+        "ln_f": {"scale": take("ln_f.weight", (E,)),
+                 "bias": take("ln_f.bias", (E,))},
+    }
+    for i in range(cfg.n_layer):
+        p = f"h.{i}."
+        params[f"h_{i}"] = {
+            "ln_1": {"scale": take(p + "ln_1.weight", (E,)),
+                     "bias": take(p + "ln_1.bias", (E,))},
+            # HF Conv1D stores (in, out) — same as a Flax Dense kernel
+            "c_attn": {"kernel": take(p + "attn.c_attn.weight", (E, 3 * E)),
+                       "bias": take(p + "attn.c_attn.bias", (3 * E,))},
+            "c_proj": {"kernel": take(p + "attn.c_proj.weight", (E, E)),
+                       "bias": take(p + "attn.c_proj.bias", (E,))},
+            "ln_2": {"scale": take(p + "ln_2.weight", (E,)),
+                     "bias": take(p + "ln_2.bias", (E,))},
+            "c_fc": {"kernel": take(p + "mlp.c_fc.weight", (E, 4 * E)),
+                     "bias": take(p + "mlp.c_fc.bias", (4 * E,))},
+            "mlp_proj": {"kernel": take(p + "mlp.c_proj.weight", (4 * E, E)),
+                         "bias": take(p + "mlp.c_proj.bias", (E,))},
+        }
+    return params
+
+
+def gpt2_to_hf(params: Params, cfg) -> dict[str, np.ndarray]:
+    """Inverse of :func:`gpt2_from_hf`: emits a ``GPT2LMHeadModel``-shaped
+    state dict (``transformer.*`` + tied ``lm_head.weight``), vocab padding
+    sliced back off, loadable by stock transformers."""
+    g = jax.device_get
+    V = cfg.vocab_size
+    out = {
+        "transformer.wte.weight": np.asarray(g(params["wte"]))[:V],
+        "transformer.wpe.weight": np.asarray(g(params["wpe"])),
+        "transformer.ln_f.weight": np.asarray(g(params["ln_f"]["scale"])),
+        "transformer.ln_f.bias": np.asarray(g(params["ln_f"]["bias"])),
+    }
+    for i in range(cfg.n_layer):
+        b = g(params[f"h_{i}"])
+        p = f"transformer.h.{i}."
+        out[p + "ln_1.weight"] = np.asarray(b["ln_1"]["scale"])
+        out[p + "ln_1.bias"] = np.asarray(b["ln_1"]["bias"])
+        out[p + "attn.c_attn.weight"] = np.asarray(b["c_attn"]["kernel"])
+        out[p + "attn.c_attn.bias"] = np.asarray(b["c_attn"]["bias"])
+        out[p + "attn.c_proj.weight"] = np.asarray(b["c_proj"]["kernel"])
+        out[p + "attn.c_proj.bias"] = np.asarray(b["c_proj"]["bias"])
+        out[p + "ln_2.weight"] = np.asarray(b["ln_2"]["scale"])
+        out[p + "ln_2.bias"] = np.asarray(b["ln_2"]["bias"])
+        out[p + "mlp.c_fc.weight"] = np.asarray(b["c_fc"]["kernel"])
+        out[p + "mlp.c_fc.bias"] = np.asarray(b["c_fc"]["bias"])
+        out[p + "mlp.c_proj.weight"] = np.asarray(b["mlp_proj"]["kernel"])
+        out[p + "mlp.c_proj.bias"] = np.asarray(b["mlp_proj"]["bias"])
+    out["lm_head.weight"] = out["transformer.wte.weight"]  # tied
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+def llama_from_hf(source, cfg) -> Params:
+    """HF Llama checkpoint -> this package's Llama param tree
+    (models/llama.py). torch ``nn.Linear`` stores (out, in); Flax kernels
+    are (in, out), hence the transposes."""
+    flat = load_flat(source)
+    dt = np.dtype(str(cfg.storage_dtype()))
+
+    def take(name, shape, *, transpose=False, pad_vocab_rows=False):
+        if name not in flat:
+            raise KeyError(f"checkpoint missing {name!r}")
+        x = np.asarray(flat[name], dtype=dt)
+        if transpose:
+            x = x.T
+        if pad_vocab_rows:
+            x = _pad_rows(x, cfg.padded_vocab)
+        if tuple(x.shape) != tuple(shape):
+            raise ValueError(f"{name}: shape {x.shape} != expected {shape}")
+        return x
+
+    E, D = cfg.n_embd, cfg.head_dim
+    Hq, Hkv, I = cfg.n_head, cfg.n_kv_head, cfg.intermediate_size
+    params: dict[str, Any] = {
+        "wte": take("model.embed_tokens.weight", (cfg.padded_vocab, E),
+                    pad_vocab_rows=True),
+        "final_norm": {"scale": take("model.norm.weight", (E,))},
+    }
+    if "lm_head.weight" in flat:
+        params["lm_head"] = take("lm_head.weight", (cfg.padded_vocab, E),
+                                 pad_vocab_rows=True)
+    else:  # tied-embedding checkpoints
+        params["lm_head"] = params["wte"].copy()
+    for i in range(cfg.n_layer):
+        p = f"model.layers.{i}."
+        params[f"layer_{i}"] = {
+            "attn_norm": {"scale": take(p + "input_layernorm.weight", (E,))},
+            "wq": {"kernel": take(p + "self_attn.q_proj.weight",
+                                  (E, Hq * D), transpose=True)},
+            "wk": {"kernel": take(p + "self_attn.k_proj.weight",
+                                  (E, Hkv * D), transpose=True)},
+            "wv": {"kernel": take(p + "self_attn.v_proj.weight",
+                                  (E, Hkv * D), transpose=True)},
+            "wo": {"kernel": take(p + "self_attn.o_proj.weight",
+                                  (Hq * D, E), transpose=True)},
+            "mlp_norm": {"scale": take(p + "post_attention_layernorm.weight",
+                                       (E,))},
+            "w_gate": {"kernel": take(p + "mlp.gate_proj.weight", (E, I),
+                                      transpose=True)},
+            "w_up": {"kernel": take(p + "mlp.up_proj.weight", (E, I),
+                                    transpose=True)},
+            "w_down": {"kernel": take(p + "mlp.down_proj.weight", (I, E),
+                                      transpose=True)},
+        }
+    return params
+
+
+def llama_to_hf(params: Params, cfg) -> dict[str, np.ndarray]:
+    """Inverse of :func:`llama_from_hf` (LlamaForCausalLM-shaped)."""
+    g = jax.device_get
+    V = cfg.vocab_size
+    out = {
+        "model.embed_tokens.weight": np.asarray(g(params["wte"]))[:V],
+        "model.norm.weight": np.asarray(g(params["final_norm"]["scale"])),
+        "lm_head.weight": np.asarray(g(params["lm_head"]))[:V],
+    }
+    for i in range(cfg.n_layer):
+        l = g(params[f"layer_{i}"])
+        p = f"model.layers.{i}."
+        out[p + "input_layernorm.weight"] = np.asarray(l["attn_norm"]["scale"])
+        out[p + "post_attention_layernorm.weight"] = \
+            np.asarray(l["mlp_norm"]["scale"])
+        for src, dst in (("wq", "self_attn.q_proj"), ("wk", "self_attn.k_proj"),
+                         ("wv", "self_attn.v_proj"), ("wo", "self_attn.o_proj"),
+                         ("w_gate", "mlp.gate_proj"), ("w_up", "mlp.up_proj"),
+                         ("w_down", "mlp.down_proj")):
+            out[p + dst + ".weight"] = np.asarray(l[src]["kernel"]).T
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Entry-point helper: --init-from
+# ---------------------------------------------------------------------------
+
+def load_params(spec: str, model_cfg) -> Params:
+    """Resolve a miner's ``--init-from`` spec against the model config in
+    use. Dispatches on the config type, so the one flag serves every model
+    family."""
+    from .gpt2 import GPT2Config
+    from .llama import LlamaConfig
+
+    if isinstance(model_cfg, GPT2Config):
+        return gpt2_from_hf(spec, model_cfg)
+    if isinstance(model_cfg, LlamaConfig):
+        return llama_from_hf(spec, model_cfg)
+    raise TypeError(f"no converter for {type(model_cfg).__name__}")

@@ -24,6 +24,44 @@ except ImportError:  # pragma: no cover
 Params = Any
 
 
+def merge_axis(mesh: Mesh) -> str:
+    """The mesh axis the averager shards the miner stack over: the largest
+    axis (ties prefer dp — the conventional replica axis of an averager
+    eval mesh)."""
+    order = {"dp": 0, "fsdp": 1, "sp": 2, "tp": 3}
+    names = sorted(mesh.shape.keys(), key=lambda n: order.get(n, 9))
+    return max(names, key=lambda n: mesh.shape[n])
+
+
+def stack_deltas_sharded(deltas, mesh: Mesh, axis: str = "dp") -> Params:
+    """Stack M deltas into a miner-axis pytree placed with that axis sharded
+    over ``axis`` — the ingest path of the ICI merge (BASELINE config 3).
+
+    Leaves are assembled host-side (numpy) and ``device_put`` directly into
+    the target sharding, so no single device ever materializes the full
+    M x params stack (``delta.stack_deltas`` would). M is padded with
+    zero-deltas up to a multiple of the axis size; the padding contributes
+    nothing to any weighted merge whose weights are zero-padded to match
+    (strategies use ``delta.pad_merge_weights``).
+    """
+    if not deltas:
+        raise ValueError("stack_deltas_sharded: empty sequence")
+    import numpy as np
+    axis_size = mesh.shape[axis]
+    m = len(deltas)
+    target = ((m + axis_size - 1) // axis_size) * axis_size
+
+    def stack_leaf(*xs):
+        arrs = [np.asarray(x) for x in xs]
+        if target > m:
+            arrs.extend(np.zeros_like(arrs[0]) for _ in range(target - m))
+        stacked = np.stack(arrs, axis=0)
+        spec = P(axis, *([None] * arrs[0].ndim))
+        return jax.device_put(stacked, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(stack_leaf, *deltas)
+
+
 def shard_stacked_deltas(stacked: Params, mesh: Mesh, axis: str = "dp") -> Params:
     """Place a [M, ...]-leaved stacked-delta tree with the miner axis sharded
     over ``axis``. M must divide the axis size evenly (pad with zero-deltas
@@ -37,20 +75,28 @@ def shard_stacked_deltas(stacked: Params, mesh: Mesh, axis: str = "dp") -> Param
 
 def pad_miner_axis(stacked: Params, weights: jax.Array, multiple: int
                    ) -> tuple[Params, jax.Array]:
-    """Pad M up to a multiple of the mesh axis with zero deltas + zero
-    weights so sharding divides evenly; padding contributes nothing."""
-    m = weights.shape[0]
+    """Pad the miner axis up to a multiple of the mesh axis with zero deltas
+    + zero weights so sharding divides evenly; padding contributes nothing.
+    ``stacked`` and ``weights`` may already disagree (an ingest-sharded stack
+    is pre-padded, the weight vector is not); each is padded independently
+    to the common target."""
+    m_s = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    m_w = weights.shape[0]
+    m = max(m_s, m_w)
     target = ((m + multiple - 1) // multiple) * multiple
-    if target == m:
-        return stacked, weights
-    pad = target - m
 
-    def pad_leaf(x):
-        return jnp.concatenate(
-            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+    if target > m_s:
+        pad = target - m_s
 
-    return (jax.tree_util.tree_map(pad_leaf, stacked),
-            jnp.concatenate([weights, jnp.zeros((pad,), weights.dtype)]))
+        def pad_leaf(x):
+            return jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], axis=0)
+
+        stacked = jax.tree_util.tree_map(pad_leaf, stacked)
+    if target > m_w:
+        weights = jnp.concatenate(
+            [weights, jnp.zeros((target - m_w,), weights.dtype)])
+    return stacked, weights
 
 
 def psum_weighted_merge(base: Params, stacked: Params, weights: jax.Array,

@@ -79,12 +79,17 @@ def _multiprocess_env() -> bool:
                 return True
         except ValueError:
             pass
-    # last resort, only when this looks like a TPU VM (/dev/accel* is
-    # TPU-specific; /dev/vfio also exists on non-GCE GPU-passthrough hosts
-    # where a metadata.google.internal lookup would stall in DNS): ask the
-    # metadata server like jax's cloud_tpu_cluster does
+    # last resort, only when this looks like a TPU VM: /dev/accel* (v4 and
+    # earlier) or /dev/vfio WITH libtpu importable (v5e+ use vfio, but bare
+    # /dev/vfio also exists on non-GCE GPU-passthrough hosts where a
+    # metadata.google.internal lookup would stall in DNS — jax's own
+    # cloud_tpu detection gates on libtpu the same way). Then ask the
+    # metadata server like jax's cloud_tpu_cluster does.
     import glob
-    if glob.glob("/dev/accel*"):
+    import importlib.util
+    if glob.glob("/dev/accel*") or (
+            glob.glob("/dev/vfio/*")
+            and importlib.util.find_spec("libtpu") is not None):
         return _gce_tpu_worker_count() > 1
     return False
 

@@ -57,7 +57,7 @@ def main(argv=None) -> int:
                         val_batches=c.eval_batches(),
                         address_store=c.address_store,
                         metrics=c.metrics, lora_cfg=c.lora_cfg)
-    loop.bootstrap()
+    loop.bootstrap(params=c.initial_params)
     try:
         merged = loop.run_periodic(interval=cfg.averaging_interval,
                                    rounds=cfg.rounds)

@@ -19,7 +19,7 @@ from distributedtraining_tpu.config import RunConfig
 from distributedtraining_tpu.data import (ByteTokenizer, batch_iterator,
                                           load_tokenizer, text_corpus)
 from distributedtraining_tpu.engine import TrainEngine, default_optimizer
-from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.models import gpt2, llama
 from distributedtraining_tpu.parallel import MeshConfig, make_mesh
 from distributedtraining_tpu.transport import (InMemoryTransport,
                                                LocalFSTransport)
@@ -48,6 +48,19 @@ class Components:
                               seq_len=self.cfg.seq_len, repeat=repeat,
                               max_vocab=self.model_cfg.vocab_size)
 
+    def initial_params(self):
+        """Pretrained starting point per --init-from (None without the flag).
+        Passed to bootstrap as a thunk and invoked only on the genesis path —
+        a published base or local checkpoint always wins, and a supervised
+        restart must not re-pay the checkpoint load/convert for weights it
+        would immediately discard (reference boot order: from_pretrained then
+        pull, neurons/miner.py:60 + training_manager.py:361-378)."""
+        if not self.cfg.init_from:
+            return None
+        from distributedtraining_tpu.models import convert
+        logger.info("loading pretrained weights from %s", self.cfg.init_from)
+        return convert.load_params(self.cfg.init_from, self.model_cfg)
+
     def eval_batches(self) -> Callable[[], Iterable[dict]]:
         """Factory over a fixed held-out shard (the reference evaluates the
         first ~100 test texts, neurons/validator.py:49,98)."""
@@ -70,7 +83,10 @@ class Components:
 def build(cfg: RunConfig) -> Components:
     import jax
 
-    model, model_cfg = gpt2.make_model(cfg.model)
+    if cfg.model in llama.PRESETS:
+        model, model_cfg = llama.make_model(cfg.model)
+    else:
+        model, model_cfg = gpt2.make_model(cfg.model)
 
     mesh = None
     spec = cfg.mesh

@@ -71,7 +71,7 @@ def main(argv=None) -> int:
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval)
     try:
-        loop.bootstrap()
+        loop.bootstrap(params=c.initial_params)
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)
         loop.flush()  # final delta + checkpoint so short runs still publish
     except KeyboardInterrupt:

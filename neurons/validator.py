@@ -39,7 +39,7 @@ def main(argv=None) -> int:
     validator = Validator(c.engine, c.transport, c.chain,
                           eval_batches=c.eval_batches(),
                           metrics=c.metrics, lora_cfg=c.lora_cfg)
-    validator.bootstrap()
+    validator.bootstrap(params=c.initial_params)
     try:
         ok = validator.run_periodic(interval=cfg.validation_interval,
                                     rounds=cfg.rounds)
