@@ -73,6 +73,11 @@ class RunConfig:
     # -- mesh ---------------------------------------------------------------
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
 
+    # -- multi-host (config 5); None = auto-detect from the environment -----
+    multihost_coordinator: Optional[str] = None   # host:port of process 0
+    multihost_processes: Optional[int] = None
+    multihost_id: Optional[int] = None
+
     # -- cadences (seconds) -------------------------------------------------
     send_interval: float = 800.0             # miner.py:125
     check_update_interval: float = 300.0
@@ -173,6 +178,14 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--fsdp", type=int, default=d.mesh.fsdp)
     g.add_argument("--sp", type=int, default=d.mesh.sp)
     g.add_argument("--tp", type=int, default=d.mesh.tp)
+    g.add_argument("--multihost-coordinator", dest="multihost_coordinator",
+                   default=None, metavar="HOST:PORT",
+                   help="explicit jax.distributed coordinator for manual "
+                        "(non-GCE) topologies; TPU pods auto-detect")
+    g.add_argument("--multihost-processes", dest="multihost_processes",
+                   type=int, default=None)
+    g.add_argument("--multihost-id", dest="multihost_id", type=int,
+                   default=None)
 
     g = p.add_argument_group("cadence")
     g.add_argument("--send-interval", dest="send_interval", type=float,

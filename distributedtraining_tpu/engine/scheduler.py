@@ -49,18 +49,29 @@ class PeriodicAction:
     ``fire_immediately`` controls whether the first poll fires (the miner's
     push timer starts counting from loop start — training_manager.py:358 —
     while its pull check fires on the first batch).
+
+    ``decide`` post-processes the local elapsed-time verdict into the final
+    fire decision. Multi-host SPMD roles pass a broadcast hook here: each
+    process's wall clock skews, and ``fn`` bodies contain collectives, so
+    every process must reach the identical fire decision at the identical
+    poll site or the pod's programs diverge and hang.
     """
 
     def __init__(self, interval: float, fn: Callable[[], None], clock: Clock,
-                 *, fire_immediately: bool = False):
+                 *, fire_immediately: bool = False,
+                 decide: Callable[[bool], bool] | None = None):
         self.interval = interval
         self.fn = fn
         self.clock = clock
+        self.decide = decide
         self.last_fired = float("-inf") if fire_immediately else clock.now()
 
     def poll(self) -> bool:
         now = self.clock.now()
-        if now - self.last_fired >= self.interval:
+        fire = now - self.last_fired >= self.interval
+        if self.decide is not None:
+            fire = self.decide(fire)
+        if fire:
             self.last_fired = now
             self.fn()
             return True

@@ -87,6 +87,10 @@ class TrainEngine:
         self.mesh = mesh
         self._param_shardings = None
         self._batch_sharding = None
+        # cached: the mesh never changes, and place_batch runs every step
+        self._spans_processes = mesh is not None and any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat)
         if mesh is not None:
             self._param_shardings = mesh_shardings(model, mesh, seq_len=seq_len)
             seq_parallel = mesh.shape.get("sp", 1) > 1
@@ -132,12 +136,43 @@ class TrainEngine:
                                         self.place_params(params))
         opt_state = jax.jit(self.tx.init)(params) if self.mesh is None \
             else self._sharded_opt_init(params)
-        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+        return TrainState(step=self.place_step(0), params=params,
                           opt_state=opt_state)
+
+    def place_step(self, step) -> jax.Array:
+        """Step counter as a valid train-state leaf: a process-local scalar
+        is not a valid jit input under multi-process SPMD, so on a
+        cross-process mesh it is replicated globally (init AND checkpoint
+        restore must both go through here)."""
+        s = jnp.asarray(step, jnp.int32)
+        if self._mesh_spans_processes():
+            from jax.sharding import NamedSharding, PartitionSpec
+            s = self._put_global(s, NamedSharding(self.mesh,
+                                                  PartitionSpec()))
+        return s
+
+    def _mesh_spans_processes(self) -> bool:
+        """True when the mesh includes devices of other processes (multi-host
+        SPMD, BASELINE config 5) — host arrays must then become global
+        jax.Arrays via make_array_from_* instead of plain device_put."""
+        return self._spans_processes
+
+    def _put_global(self, x, sharding):
+        """Host value -> global array on a cross-process mesh. Every process
+        passes the same full value (params/opt state are deterministic from
+        the same seed or the same fetched base); each supplies its
+        addressable shards."""
+        import numpy as np
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
 
     def place_params(self, params: Params) -> Params:
         if self._param_shardings is None:
             return jax.tree_util.tree_map(jnp.asarray, params)
+        if self._mesh_spans_processes():
+            return jax.tree_util.tree_map(self._put_global, params,
+                                          self._param_shardings)
         return jax.tree_util.tree_map(jax.device_put, params,
                                       self._param_shardings)
 
@@ -178,11 +213,22 @@ class TrainEngine:
         abstract = jax.eval_shape(lambda x: x, opt_state)
         shardings = opt_state_shardings(abstract, self._param_shardings,
                                         self.mesh)
+        if self._mesh_spans_processes():
+            return jax.tree_util.tree_map(self._put_global, opt_state,
+                                          shardings)
         return jax.tree_util.tree_map(jax.device_put, opt_state, shardings)
 
     def place_batch(self, batch: dict) -> dict:
         if self._batch_sharding is None:
             return batch
+        if self._mesh_spans_processes():
+            # multi-host data parallelism: each process loads its own batch
+            # shard (multihost.shard_documents feeds distinct docs per host)
+            # and contributes it as the addressable slice of one global batch
+            import numpy as np
+            return {k: jax.make_array_from_process_local_data(
+                        self._batch_sharding, np.asarray(v))
+                    for k, v in batch.items()}
         return {k: jax.device_put(v, self._batch_sharding)
                 for k, v in batch.items()}
 
@@ -247,16 +293,48 @@ class MinerLoop:
         self._base_revision = None
         self._last_base_time = self.clock.now()
 
+        # Multi-host SPMD (config 5): every cadence decision must be
+        # IDENTICAL on every process — the action bodies contain collectives
+        # (publish allgather, state re-placement), and per-process wall
+        # clocks skew, so a locally-decided fire would desynchronize the
+        # pod's programs and hang it. The coordinator's verdict is broadcast
+        # at each poll site (each process polls at the same loop point).
+        decide = self._synced_decision if self._multi() else None
         self._pull_action = PeriodicAction(check_update_interval,
-                                           self._check_pull, self.clock)
+                                           self._check_pull, self.clock,
+                                           decide=decide)
         self._push_action = PeriodicAction(send_interval, self._push_delta,
-                                           self.clock)
+                                           self.clock, decide=decide)
         self._last_ckpt_key = None
         self._ckpt_action = None
+        if checkpoint_store is not None and self._multi():
+            # orbax save is itself a collective needing a shared fs +
+            # synchronized entry; the local store is not built for that
+            logger.warning(
+                "miner %s: local checkpointing is not supported on a "
+                "multi-host mesh; disabling (restart resumes from the "
+                "published base)", miner_id)
+            checkpoint_store = None
+            self.checkpoint_store = None
         if checkpoint_store is not None:
             self._ckpt_action = PeriodicAction(checkpoint_interval,
                                                self._save_checkpoint,
                                                self.clock)
+
+    # -- multi-host coordination --------------------------------------------
+    def _multi(self) -> bool:
+        fn = getattr(self.engine, "_mesh_spans_processes", None)
+        return bool(fn()) if fn is not None else False
+
+    def _synced_decision(self, fire: bool) -> bool:
+        """Coordinator's verdict, identical on every process (collective)."""
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        from ..parallel import multihost
+        local = fire if multihost.is_coordinator() else False
+        return bool(multihost_utils.broadcast_one_to_all(
+            np.asarray(local, np.int32)))
 
     # -- base model lifecycle ----------------------------------------------
     def bootstrap(self, rng: jax.Array | None = None,
@@ -292,10 +370,13 @@ class MinerLoop:
         self.base_params = _snapshot(self.state.params)
 
     def _check_pull(self) -> None:
-        rev = self.transport.base_revision()
-        if rev is None or rev == self._base_revision:
-            return
-        fetched = self.transport.fetch_base(self.base_params)
+        if self._multi():
+            fetched = self._fetch_base_broadcast()
+        else:
+            rev = self.transport.base_revision()
+            if rev is None or rev == self._base_revision:
+                return
+            fetched = self.transport.fetch_base(self.base_params)
         if fetched is None:
             return
         params, rev = fetched
@@ -308,6 +389,41 @@ class MinerLoop:
         self._base_revision = rev
         self._last_base_time = self.clock.now()
         self.report.base_pulls += 1
+
+    def _fetch_base_broadcast(self):
+        """Multi-host base pull: only the coordinator reads the transport
+        (per-host polls could observe different revisions mid-publish, and
+        --backend local storage may not even be visible off-host); the
+        fetched tree is broadcast so every process resets to IDENTICAL
+        values at the identical loop point. Returns (params, rev) or None,
+        the same on every process."""
+        import numpy as np
+        from jax.experimental import multihost_utils as mhu
+
+        from ..parallel import multihost
+
+        # host-side zeros template: shapes/dtypes for wire validation and
+        # the non-coordinator broadcast buffers (base_params leaves may be
+        # sharded across processes and unreadable on any one host)
+        template = jax.tree_util.tree_map(
+            lambda x: np.zeros(x.shape, x.dtype), self.base_params)
+        fetched = None
+        if multihost.is_coordinator():
+            rev = self.transport.base_revision()
+            if rev is not None and rev != self._base_revision:
+                fetched = self.transport.fetch_base(template)
+        ok = bool(mhu.broadcast_one_to_all(
+            np.asarray(fetched is not None, np.int32)))
+        if not ok:
+            return None
+        params, rev = fetched if fetched is not None else (template, "")
+        params = mhu.broadcast_one_to_all(params)
+        buf = np.zeros((256,), np.uint8)
+        enc = (rev or "").encode()[:256]
+        buf[: len(enc)] = np.frombuffer(enc, np.uint8)
+        buf = np.asarray(mhu.broadcast_one_to_all(buf))
+        rev = bytes(buf[buf != 0]).decode(errors="ignore") or None
+        return params, rev
 
     # -- local checkpoint/resume (checkpoint.py) ----------------------------
     def _save_checkpoint(self) -> None:
@@ -354,7 +470,7 @@ class MinerLoop:
             if snap is None:
                 return False
             self.state = TrainState(
-                step=jnp.asarray(snap.state.step, jnp.int32),
+                step=self.engine.place_step(snap.state.step),
                 params=self.engine.place_params(snap.state.params),
                 opt_state=self.engine.place_opt_state(snap.state.opt_state))
             self.base_params = _snapshot(
@@ -386,10 +502,14 @@ class MinerLoop:
             self._check_pull()
         return True
 
+    # one program instead of an eager per-leaf op stream (each eager op on a
+    # cross-process mesh is its own collective program)
+    _compute_delta = staticmethod(jax.jit(delta_lib.compute_delta))
+
     def _push_delta(self) -> None:
         if self.state is None:
             return
-        d = delta_lib.compute_delta(self.state.params, self.base_params)
+        d = self._compute_delta(self.state.params, self.base_params)
         if self.nan_guard and delta_lib.has_nonfinite(d):
             logger.warning("miner %s: delta has non-finite values, not pushing",
                            self.miner_id)

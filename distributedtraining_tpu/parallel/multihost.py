@@ -158,3 +158,80 @@ def shard_documents(docs, *, process_index: Optional[int] = None,
     for i, doc in enumerate(docs):
         if i % pc == pi:
             yield doc
+
+
+# ---------------------------------------------------------------------------
+# Coordinator gating: in an SPMD role every process computes, but only one
+# may talk to the outside world — N processes each pushing the same delta /
+# setting the same weights would hammer the Hub and the chain N-fold.
+# ---------------------------------------------------------------------------
+
+def _materialize(tree):
+    """Bring a pytree to host-complete values for serialization. FSDP/TP
+    leaves sharded across processes are not fully addressable on any single
+    host, so this runs a process_allgather — a COLLECTIVE: it must execute
+    on every process, which is why the gated publishers call it before the
+    coordinator-only branch, never after."""
+    import jax as _jax
+
+    leaves = _jax.tree_util.tree_leaves(tree)
+    if all(getattr(l, "is_fully_addressable", True) for l in leaves):
+        return tree
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(tree, tiled=True)
+
+
+class CoordinatorGatedTransport:
+    """Reads pass through on every process (each host fetches the base for
+    itself); writes (publish/gc) run only on the coordinator and silently
+    no-op elsewhere. Published trees are materialized host-side first (a
+    collective on every process) so cross-process-sharded params serialize."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def publish_delta(self, miner_id, tree, *a, **kw):
+        tree = _materialize(tree)
+        if not is_coordinator():
+            return None
+        return self._inner.publish_delta(miner_id, tree, *a, **kw)
+
+    def publish_base(self, tree, *a, **kw):
+        tree = _materialize(tree)
+        if not is_coordinator():
+            # non-coordinators poll base_revision() for the real revision
+            return None
+        return self._inner.publish_base(tree, *a, **kw)
+
+    def gc(self, *a, **kw):
+        if not is_coordinator():
+            return None
+        return self._inner.gc(*a, **kw)
+
+
+class CoordinatorGatedChain:
+    """sync/reads pass through; weight emission runs only on the coordinator
+    (the reference's one-wallet-per-role model maps to one chain writer per
+    SPMD role)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def set_weights(self, *a, **kw):
+        if not is_coordinator():
+            return None
+        return self._inner.set_weights(*a, **kw)
+
+
+def gate_io(transport, chain):
+    """Wrap transport/chain with coordinator gates when running
+    multi-process; identity on single host."""
+    if jax.process_count() <= 1:
+        return transport, chain
+    return CoordinatorGatedTransport(transport), CoordinatorGatedChain(chain)

@@ -42,9 +42,24 @@ class Components:
     lora_cfg: Any = None  # set when --lora-rank > 0 (config 4 mode)
 
     def train_batches(self, *, repeat: bool = True) -> Iterable[dict]:
+        import jax
+
         docs = text_corpus(split="train", source=self.cfg.dataset)
-        return batch_iterator(docs, self.tokenizer,
-                              batch_size=self.cfg.batch_size,
+        bs = self.cfg.batch_size
+        if jax.process_count() > 1:
+            # --batch-size is the GLOBAL batch on a pod: each process feeds
+            # its own document shard at batch_size/process_count and the
+            # engine assembles one global array per step (place_batch)
+            from distributedtraining_tpu.parallel import multihost
+            if bs % jax.process_count():
+                # silently shrinking the global batch would surface later as
+                # a baffling dp-axis divisibility error in place_batch
+                raise SystemExit(
+                    f"--batch-size {bs} (global) must be divisible by the "
+                    f"process count {jax.process_count()}")
+            docs = list(multihost.shard_documents(docs))
+            bs //= jax.process_count()
+        return batch_iterator(docs, self.tokenizer, batch_size=bs,
                               seq_len=self.cfg.seq_len, repeat=repeat,
                               max_vocab=self.model_cfg.vocab_size)
 
@@ -83,6 +98,15 @@ class Components:
 def build(cfg: RunConfig) -> Components:
     import jax
 
+    from distributedtraining_tpu.parallel import multihost
+
+    # config 5 (multi-host pod): env-gated no-op on a single host; on a pod
+    # every process of the role runs this same build and forms one SPMD
+    # program over the global mesh
+    multihost.initialize(coordinator_address=cfg.multihost_coordinator,
+                         num_processes=cfg.multihost_processes,
+                         process_id=cfg.multihost_id)
+
     if cfg.model in llama.PRESETS:
         model, model_cfg = llama.make_model(cfg.model)
     else:
@@ -90,11 +114,15 @@ def build(cfg: RunConfig) -> Components:
 
     mesh = None
     spec = cfg.mesh
-    n_visible = len(jax.devices())
-    dp = spec.dp or max(1, n_visible // (spec.fsdp * spec.sp * spec.tp))
-    mcfg = MeshConfig(dp=dp, fsdp=spec.fsdp, sp=spec.sp, tp=spec.tp)
-    if mcfg.n_devices > 1:
-        mesh = make_mesh(mcfg)
+    if jax.process_count() > 1:
+        mesh = multihost.pod_mesh(dp=spec.dp, fsdp=spec.fsdp, sp=spec.sp,
+                                  tp=spec.tp)
+    else:
+        n_visible = len(jax.devices())
+        dp = spec.dp or max(1, n_visible // (spec.fsdp * spec.sp * spec.tp))
+        mcfg = MeshConfig(dp=dp, fsdp=spec.fsdp, sp=spec.sp, tp=spec.tp)
+        if mcfg.n_devices > 1:
+            mesh = make_mesh(mcfg)
 
     seq = cfg.seq_len if cfg.role == "miner" else cfg.eval_seq_len
     engine = TrainEngine(
@@ -141,7 +169,18 @@ def build(cfg: RunConfig) -> Components:
                            epoch_length=cfg.epoch_length,
                            vpermit_stake_limit=cfg.vpermit_stake_limit)
         address_store = LocalAddressStore(chain_dir)
-    if cfg.my_repo_id:
+    # only the coordinator process of a pod role may write to the outside
+    # world (delta pushes, base publishes, weight sets)
+    transport, chain = multihost.gate_io(transport, chain)
+    if jax.process_count() > 1 and cfg.backend != "hf":
+        # reads pass through the gate on every process: with per-host
+        # storage, workers would never observe published bases and diverge
+        logger.warning(
+            "multi-host run with --backend %s: every host reads %s "
+            "directly — it MUST be shared storage (NFS/gcsfuse) across all "
+            "hosts, or use --backend hf", cfg.backend, cfg.work_dir)
+
+    if cfg.my_repo_id and multihost.is_coordinator():
         # advertise our repo like the reference miner does on-chain
         # (neurons/miner.py:36-44)
         address_store.store_repo(cfg.hotkey, cfg.my_repo_id)

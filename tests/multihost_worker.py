@@ -1,0 +1,91 @@
+"""Worker script for the 2-process jax.distributed test (run via subprocess
+by tests/test_multihost_spmd.py — not a pytest file itself).
+
+Each of two processes owns 2 virtual CPU devices; together they form one
+4-device dp mesh and run one full sharded train step as a single SPMD
+program — the miniature of BASELINE config 5 (multi-host v5e-64).
+Prints "RESULT <pid> <loss> <is_coord>" on success.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    addr = sys.argv[2]
+
+    from distributedtraining_tpu.parallel import multihost
+
+    multihost.initialize(coordinator_address=addr, num_processes=2,
+                         process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+    assert len(jax.local_devices()) == 2
+
+    mesh = multihost.pod_mesh()  # dp=4 over both processes
+    assert mesh.shape["dp"] == 4
+
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.models import gpt2
+
+    model, cfg = gpt2.make_model("tiny")
+    seq = 16
+    engine = TrainEngine(model, mesh=mesh, seq_len=seq)
+    state = engine.init_state(jax.random.PRNGKey(0))
+
+    # distinct per-process data (as multihost.shard_documents would feed);
+    # global batch = 4, local shard = 2 rows per process
+    rng = np.random.default_rng(100 + pid)
+    local = {"input_ids": rng.integers(0, cfg.vocab_size, (2, seq),
+                                       dtype=np.int32)}
+    for _ in range(2):
+        state, m = engine.train_step(state, engine.place_batch(local))
+    loss = float(m["loss"])
+    assert np.isfinite(loss), loss
+    assert int(state.step) == 2
+
+    # coordinator gating: only process 0 writes
+    sent = []
+
+    class FakeTransport:
+        def publish_delta(self, mid, d):
+            sent.append(mid)
+            return "rev"
+
+        def publish_base(self, p):
+            sent.append("base")
+            return "rev"
+
+        def gc(self):
+            sent.append("gc")
+
+    class FakeChain:
+        def set_weights(self, w):
+            sent.append("weights")
+
+    t, c = multihost.gate_io(FakeTransport(), FakeChain())
+    t.publish_delta("m0", None)
+    t.publish_base(None)
+    t.gc()
+    c.set_weights({})
+    expected = 4 if multihost.is_coordinator() else 0
+    assert len(sent) == expected, (pid, sent)
+
+    print(f"RESULT {pid} {loss:.6f} {int(multihost.is_coordinator())}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
