@@ -33,10 +33,13 @@ class Metagraph:
 
 
 class AddressStore(Protocol):
-    """hotkey -> artifact repo id (chain commitments, chain_manager.py:57-115)."""
+    """hotkey -> artifact repo id (chain commitments, chain_manager.py:57-115)
+    and hotkey -> signing pubkey (artifact authenticity, transport/signed.py)."""
 
     def store_repo(self, hotkey: str, repo_id: str) -> None: ...
     def retrieve_repo(self, hotkey: str) -> Optional[str]: ...
+    def store_pubkey(self, hotkey: str, pubkey: bytes) -> None: ...
+    def retrieve_pubkey(self, hotkey: str) -> Optional[bytes]: ...
 
 
 class Network(Protocol):
@@ -49,7 +52,68 @@ class Network(Protocol):
     def current_block(self) -> int: ...
     def set_weights(self, scores: dict[str, float]) -> bool: ...
     def should_set_weights(self) -> bool: ...
-    def get_validator_uids(self, stake_limit: float = 1000.0) -> list[int]: ...
+    def get_validator_uids(self, stake_limit: float | None = None) -> list[int]: ...
+
+
+class RateLimiter:
+    """Too-fast callers are refused; repeat offenders get blacklisted
+    (btt_connector.py:454-480). Shared by the chain simulator and the peer
+    registry so every request-serving surface applies one policy. A single
+    transient double-poll must not permanently ban a well-behaved hotkey."""
+
+    BLACKLIST_AFTER = 3      # violations before a permanent ban
+    MAX_TRACKED = 65536      # bound on per-caller bookkeeping entries
+
+    def __init__(self, min_interval: float, *, now_fn=None,
+                 max_tracked: int = MAX_TRACKED,
+                 blacklist_after: int | None = BLACKLIST_AFTER):
+        """``blacklist_after=None`` disables the permanent ban — REQUIRED on
+        surfaces where the caller id is self-claimed (the peer registry's
+        HTTP hotkeys): an attacker spoofing a victim's id must at worst
+        rate-limit it, never lock it out forever."""
+        import threading
+        import time
+        self.min_interval = min_interval
+        self.max_tracked = max_tracked
+        self.blacklist_after = blacklist_after
+        self._now = now_fn or time.time
+        self._last_request: dict[str, float] = {}
+        self._violations: dict[str, int] = {}
+        self._blacklist: set[str] = set()
+        # callers include ThreadingHTTPServer handler threads (the peer
+        # registry): the evict-while-iterating path must be serialized
+        self._mutex = threading.Lock()
+
+    def allow(self, caller: str) -> bool:
+        if self.min_interval <= 0:
+            # limiter disabled: keep NO per-caller state — an attacker
+            # cycling distinct hotkeys must not grow server memory
+            return True
+        with self._mutex:
+            return self._allow_locked(caller)
+
+    def _allow_locked(self, caller: str) -> bool:
+        if caller in self._blacklist:
+            return False
+        now = self._now()
+        last = self._last_request.get(caller)
+        if last is None and len(self._last_request) >= self.max_tracked:
+            # evict the stalest half; distinct-hotkey floods stay bounded
+            # (an evicted well-paced caller just gets one free pass)
+            for k, _ in sorted(self._last_request.items(),
+                               key=lambda kv: kv[1])[:self.max_tracked // 2]:
+                del self._last_request[k]
+                self._violations.pop(k, None)
+        self._last_request[caller] = now
+        if last is not None and now - last < self.min_interval:
+            self._violations[caller] = self._violations.get(caller, 0) + 1
+            if (self.blacklist_after is not None
+                    and self._violations[caller] >= self.blacklist_after):
+                if len(self._blacklist) >= self.max_tracked:
+                    self._blacklist.pop()  # bounded, at the cost of un-banning
+                self._blacklist.add(caller)
+            return False
+        return True
 
 
 # ---------------------------------------------------------------------------

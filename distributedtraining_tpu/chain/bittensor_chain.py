@@ -61,16 +61,34 @@ class BittensorAddressStore:
         except ChainTimeout:
             return None
 
+    def store_pubkey(self, hotkey: str, pubkey: bytes) -> None:
+        """No-op: on bittensor the ss58 hotkey IS a public key and artifact
+        authenticity rides chain identity + repo ownership; the Ed25519
+        envelope registry (transport/signed.py) serves local/HF-only
+        deployments."""
+
+    def retrieve_pubkey(self, hotkey: str) -> Optional[bytes]:
+        return None
+
 
 class BittensorChain:
     """Network impl over a live subtensor."""
 
     def __init__(self, *, netuid: int, wallet_name: str, wallet_hotkey: str,
-                 network: str = "finney", epoch_length: int = 100):
+                 network: str = "finney", epoch_length: int = 100,
+                 resync_blocks: int = 0,
+                 vpermit_stake_limit: float = 1000.0):
         bt = _require_bittensor()
         self.bt = bt
         self.netuid = netuid
         self.epoch_length = epoch_length
+        # metagraph resync throttle (reference resyncs on a cadence, not per
+        # call — resync_metagraph, btt_connector.py:270-282): within
+        # ``resync_blocks`` of the last sync, sync() serves the cached
+        # metagraph without an RPC. 0 = resync every call.
+        self.resync_blocks = resync_blocks
+        self._last_sync_block = -(10**9)
+        self.vpermit_stake_limit = vpermit_stake_limit
         self.wallet = bt.wallet(name=wallet_name, hotkey=wallet_hotkey)
         self.subtensor = bt.subtensor(network=network)
         self.metagraph = self.subtensor.metagraph(netuid)
@@ -85,13 +103,19 @@ class BittensorChain:
         return self.wallet.hotkey.ss58_address
 
     def sync(self) -> Metagraph:
-        def op():
-            self.metagraph.sync(subtensor=self.subtensor, lite=True)
-            return self.metagraph
-        m = run_with_timeout(op, CHAIN_OP_TIMEOUT, name="metagraph_sync")
+        block = self.current_block()
+        if (self.resync_blocks > 0
+                and block - self._last_sync_block < self.resync_blocks):
+            m = self.metagraph  # cached within the resync window
+        else:
+            def op():
+                self.metagraph.sync(subtensor=self.subtensor, lite=True)
+                return self.metagraph
+            m = run_with_timeout(op, CHAIN_OP_TIMEOUT, name="metagraph_sync")
+            self._last_sync_block = block
         return Metagraph(hotkeys=list(m.hotkeys), uids=list(range(len(m.hotkeys))),
                          stakes=[float(s) for s in m.S],
-                         block=self.current_block())
+                         block=block)
 
     def current_block(self) -> int:
         return int(run_with_timeout(lambda: self.subtensor.block,
@@ -100,9 +124,12 @@ class BittensorChain:
     def should_set_weights(self) -> bool:
         return (self.current_block() - self._last_weight_block) >= self.epoch_length
 
-    def get_validator_uids(self, stake_limit: float = 1000.0) -> list[int]:
+    def get_validator_uids(self, stake_limit: float | None = None) -> list[int]:
+        """UIDs with stake >= the vpermit limit; None means the configured
+        --vpermit-stake-limit (same contract as LocalChain)."""
+        limit = self.vpermit_stake_limit if stake_limit is None else stake_limit
         m = self.metagraph
-        return [i for i, s in enumerate(m.S) if float(s) >= stake_limit]
+        return [i for i, s in enumerate(m.S) if float(s) >= limit]
 
     def set_weights(self, scores: dict[str, float]) -> bool:
         """EMA -> MAD anomaly screen -> normalize -> u16 -> chain extrinsic

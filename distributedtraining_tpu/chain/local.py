@@ -28,6 +28,7 @@ from ..engine.scheduler import Clock, RealClock
 from .base import (
     EMA_ALPHA,
     Metagraph,
+    RateLimiter,
     ema_update,
     mad_anomaly_mask,
     normalize_scores,
@@ -61,20 +62,62 @@ def _read_json(path: str, default):
 
 
 class LocalAddressStore:
-    """hotkey -> repo id in storage.json."""
+    """hotkey -> repo id in storage.json; hotkey -> pubkey in pubkeys.json
+    (the artifact-authenticity anchor for SignedTransport — on bittensor the
+    hotkey IS the public key, here it must be registered once).
+
+    Read-modify-write cycles hold an fcntl lock on a sidecar lockfile: the
+    store is shared by SEPARATE role processes on one box (SURVEY §4.1
+    multi-process rounds), and a thread lock alone would let two booting
+    roles lose each other's registrations — for pubkeys that silently
+    voids the trust-on-first-use guarantee."""
 
     def __init__(self, directory: str):
+        self.directory = directory
         self.path = os.path.join(directory, "storage.json")
+        self.pubkey_path = os.path.join(directory, "pubkeys.json")
         self._lock = threading.Lock()
 
+    def _file_lock(self):
+        import contextlib
+        import fcntl
+
+        @contextlib.contextmanager
+        def held():
+            os.makedirs(self.directory, exist_ok=True)
+            with self._lock, open(os.path.join(self.directory,
+                                               ".store.lock"), "w") as f:
+                fcntl.flock(f, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(f, fcntl.LOCK_UN)
+        return held()
+
     def store_repo(self, hotkey: str, repo_id: str) -> None:
-        with self._lock:
+        with self._file_lock():
             data = _read_json(self.path, {})
             data[hotkey] = repo_id
             _atomic_write_json(self.path, data)
 
     def retrieve_repo(self, hotkey: str) -> Optional[str]:
         return _read_json(self.path, {}).get(hotkey)
+
+    def store_pubkey(self, hotkey: str, pubkey: bytes) -> None:
+        """First write wins: an attacker must not be able to rotate a
+        registered key out from under a hotkey (trust-on-first-use)."""
+        with self._file_lock():
+            data = _read_json(self.pubkey_path, {})
+            if hotkey in data and data[hotkey] != pubkey.hex():
+                raise ValueError(
+                    f"pubkey for {hotkey} already registered; refusing to "
+                    "overwrite")
+            data[hotkey] = pubkey.hex()
+            _atomic_write_json(self.pubkey_path, data)
+
+    def retrieve_pubkey(self, hotkey: str) -> Optional[bytes]:
+        hexkey = _read_json(self.pubkey_path, {}).get(hotkey)
+        return bytes.fromhex(hexkey) if hexkey else None
 
 
 class LocalChain:
@@ -92,9 +135,8 @@ class LocalChain:
         self._epoch_start = self.clock.now()
         self.rate_limit_seconds = rate_limit_seconds
         self.vpermit_stake_limit = vpermit_stake_limit
-        self._last_request: dict[str, float] = {}
-        self._violations: dict[str, int] = {}
-        self._blacklist: set[str] = set()
+        self._limiter = RateLimiter(rate_limit_seconds,
+                                    now_fn=self.clock.now)
         self._lock = threading.Lock()
         self._last_weight_block = -(10**9)
         if not os.path.exists(self.path):
@@ -188,21 +230,8 @@ class LocalChain:
         return acc
 
     # -- abuse guards (rate limiter + blacklist, btt_connector.py:454-480) --
-    BLACKLIST_AFTER = 3  # violations before a permanent ban
+    BLACKLIST_AFTER = RateLimiter.BLACKLIST_AFTER
 
     def rate_limit(self, caller: str) -> bool:
-        """True = allowed. Too-fast requests are refused; repeat offenders
-        (3 violations) get blacklisted. A single transient double-poll must
-        not permanently ban a well-behaved hotkey."""
-        if caller in self._blacklist:
-            return False
-        now = self.clock.now()
-        last = self._last_request.get(caller)
-        self._last_request[caller] = now
-        if last is not None and self.rate_limit_seconds > 0 \
-                and now - last < self.rate_limit_seconds:
-            self._violations[caller] = self._violations.get(caller, 0) + 1
-            if self._violations[caller] >= self.BLACKLIST_AFTER:
-                self._blacklist.add(caller)
-            return False
-        return True
+        """True = allowed — delegates to the shared RateLimiter policy."""
+        return self._limiter.allow(caller)

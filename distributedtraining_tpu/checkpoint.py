@@ -81,7 +81,11 @@ class CheckpointStore:
                 tree=ocp.args.StandardSave(snapshot.as_tree()),
                 meta=ocp.args.JsonSave(
                     {"base_revision": snapshot.base_revision,
-                     "lifetime_steps": snapshot.lifetime_steps}),
+                     "lifetime_steps": snapshot.lifetime_steps,
+                     # restore must know whether to expect a base subtree
+                     # (revision-recoverable bases are not persisted —
+                     # MinerLoop._checkpoint_base)
+                     "has_base": snapshot.base_params is not None}),
             ),
         )
         self._mgr.wait_until_finished()
@@ -98,6 +102,17 @@ class CheckpointStore:
     # -- read ---------------------------------------------------------------
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def read_meta(self, step: int | None = None) -> Optional[dict]:
+        """The JSON sidecar alone (cheap) — callers shape their restore
+        template from it before paying for the tensor restore."""
+        ocp = self._ocp
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            int(step), args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+        return restored["meta"] or {}
 
     def all_steps(self) -> list[int]:
         return sorted(self._mgr.all_steps())

@@ -48,12 +48,19 @@ class RunConfig:
     subtensor_network: str = "finney"        # bittensor network endpoint
     epoch_length: int = 100                  # blocks between weight sets
     vpermit_stake_limit: float = 1000.0
+    allow_no_vpermit: bool = False           # run an unpermitted validator
+    resync_blocks: int = 0                   # metagraph resync throttle
 
     # -- storage / transport ------------------------------------------------
     backend: str = "local"                   # local | memory | hf
     work_dir: str = "./hivetrain_run"
     my_repo_id: Optional[str] = None
     averaged_model_repo_id: Optional[str] = None
+
+    # -- artifact authenticity (transport/signed.py) ------------------------
+    sign_artifacts: bool = False             # Ed25519-envelope publishes
+    wallet_path: Optional[str] = None        # default: <work_dir>/wallets/<hotkey>.json
+    base_signer: Optional[str] = None        # hotkey expected to sign the base
 
     # -- model / optimization ----------------------------------------------
     model: str = "gpt2-124m"                 # gpt2/llama preset name
@@ -100,6 +107,8 @@ class RunConfig:
     # -- observability ------------------------------------------------------
     metrics_path: Optional[str] = None       # JSONL sink
     mlflow_uri: Optional[str] = None
+    profile_dir: Optional[str] = None        # jax.profiler trace capture
+    profile_steps: int = 5                   # train steps per capture
 
     @classmethod
     def from_args(cls, role: str, argv: Sequence[str] | None = None
@@ -133,8 +142,19 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    default=d.subtensor_network)
     g.add_argument("--epoch-length", dest="epoch_length", type=int,
                    default=d.epoch_length)
+    g.add_argument("--resync-blocks", dest="resync_blocks", type=int,
+                   default=d.resync_blocks,
+                   help="serve the cached metagraph within this many blocks "
+                        "of the last resync (0 = resync every sync call); "
+                        "bittensor chain only")
     g.add_argument("--vpermit-stake-limit", dest="vpermit_stake_limit",
                    type=float, default=d.vpermit_stake_limit)
+    if role == "validator":
+        g.add_argument("--allow-no-vpermit", dest="allow_no_vpermit",
+                       action="store_true",
+                       help="run even when this hotkey holds no validator "
+                            "stake (scores are computed but weights are "
+                            "never emitted; useful for dry runs)")
 
     g = p.add_argument_group("storage")
     g.add_argument("--backend", choices=("local", "memory", "hf"),
@@ -143,6 +163,18 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--my-repo-id", dest="my_repo_id", default=None)
     g.add_argument("--averaged-model-repo-id", dest="averaged_model_repo_id",
                    default=None)
+    g.add_argument("--sign-artifacts", dest="sign_artifacts",
+                   action="store_true",
+                   help="publish artifacts in Ed25519 signature envelopes "
+                        "and verify peers' signatures against their "
+                        "registered pubkeys (transport/signed.py)")
+    g.add_argument("--wallet-path", dest="wallet_path", default=None,
+                   help="identity keyfile for --sign-artifacts (created if "
+                        "missing); default <work-dir>/wallets/<hotkey>.json")
+    g.add_argument("--base-signer", dest="base_signer", default=None,
+                   help="hotkey expected to sign the published base model "
+                        "(the averager's); with a registered pubkey, base "
+                        "fetches then REQUIRE a valid signature")
 
     g = p.add_argument_group("model")
     g.add_argument("--model", default=d.model)
@@ -228,4 +260,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g = p.add_argument_group("observability")
     g.add_argument("--metrics-path", dest="metrics_path", default=None)
     g.add_argument("--mlflow-uri", dest="mlflow_uri", default=None)
+    if role == "miner":  # only the miner's train loop ticks TraceCapture
+        g.add_argument("--profile-dir", dest="profile_dir", default=None,
+                       help="capture a jax.profiler trace of a few "
+                            "post-warmup train steps into this directory "
+                            "(TensorBoard/xprof-readable), then continue "
+                            "at full speed")
+        g.add_argument("--profile-steps", dest="profile_steps", type=int,
+                       default=d.profile_steps)
     return p

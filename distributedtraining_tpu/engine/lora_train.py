@@ -25,33 +25,33 @@ import optax
 
 from .. import delta as delta_lib
 from ..models import lora as lora_lib
-from .train import (MinerLoop, TrainState, _default_lm_loss,
-                    default_optimizer)
+from .train import MinerLoop, TrainEngine, TrainState, _default_lm_loss
 
 logger = logging.getLogger(__name__)
 
 
-def _place(base):
-    """Device placement for the frozen base. Transport fetches restore numpy
-    leaves; feeding those to the jitted step would re-transfer the entire
-    base host-to-device EVERY step (GBs/step at the 7B config-4 scale)."""
-    return jax.tree_util.tree_map(jnp.asarray, base)
-
-
-class LoRAEngine:
+class LoRAEngine(TrainEngine):
     """Jitted adapter-only train/eval steps.
 
     The base is an explicit argument of the step (not a closure) so a base
     pull never recompiles, and donation applies only to the adapter state.
+
+    Mesh semantics (config 4: a 7B frozen base does not fit one chip):
+    the BASE is sharded by the same logical rules as full-param training
+    (fsdp/tp over embed/qkv/mlp axes — inherited from TrainEngine), while
+    the ADAPTERS and their optimizer state replicate: at rank<=64 they are
+    ~0.1% of base bytes, and replicating them means the adapter all-reduce
+    after the backward pass is the ONLY extra collective per step.
     """
 
     def __init__(self, model, lora_cfg: lora_lib.LoRAConfig, *,
                  optimizer: optax.GradientTransformation | None = None,
-                 loss_fn=None):
-        self.model = model
+                 loss_fn=None, mesh=None, seq_len: int = 8):
+        # sets up tx, mesh, base param shardings, batch sharding, placement
+        # helpers; the full-param step closures it defines are shadowed below
+        super().__init__(model, optimizer=optimizer, mesh=mesh,
+                         seq_len=seq_len)
         self.lora_cfg = lora_cfg
-        self.tx = optimizer or default_optimizer()
-        self.mesh = None  # adapter training is single-chip in this round
         task_loss = loss_fn or _default_lm_loss
 
         def loss(lora_params, base, batch):
@@ -75,13 +75,53 @@ class LoRAEngine:
         self.train_step = jax.jit(train_step, donate_argnums=(0,))
         self.eval_step = jax.jit(eval_step)
 
+    # -- adapter placement (replicated; base placement is inherited) --------
+    def _replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def place_adapters(self, adapters):
+        if self.mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, adapters)
+        s = self._replicated()
+        if self._mesh_spans_processes():
+            return jax.tree_util.tree_map(
+                lambda x: self._put_global(x, s), adapters)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, s), adapters)
+
+    def place_state_params(self, params):
+        """The train state holds ADAPTERS (MinerLoop checkpoint restore)."""
+        return self.place_adapters(params)
+
+    def place_opt_state(self, opt_state):
+        """Adapter optimizer state replicates like the adapters."""
+        if self.mesh is None:
+            return jax.tree_util.tree_map(jnp.asarray, opt_state)
+        return self.place_adapters(opt_state)
+
     def init_state(self, rng: jax.Array, base) -> TrainState:
-        lp = lora_lib.init_lora(rng, base, self.lora_cfg)
-        return TrainState(step=jnp.zeros((), jnp.int32), params=lp,
+        lp = self.place_adapters(
+            lora_lib.init_lora(rng, base, self.lora_cfg))
+        return TrainState(step=self.place_step(0), params=lp,
                           opt_state=jax.jit(self.tx.init)(lp))
 
-    def place_batch(self, batch: dict) -> dict:
-        return batch
+    def abstract_state(self) -> TrainState:
+        """Adapter-tree skeleton (checkpoint restore template)."""
+        params_abs = jax.eval_shape(
+            lambda: self.model.init_params(jax.random.PRNGKey(0)))
+        adapters = jax.eval_shape(
+            lambda p: lora_lib.init_lora(jax.random.PRNGKey(0), p,
+                                         self.lora_cfg), params_abs)
+        opt_state = jax.eval_shape(self.tx.init, adapters)
+        if self.mesh is not None:
+            s = self._replicated()
+            attach = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                    sharding=s)
+            adapters = jax.tree_util.tree_map(attach, adapters)
+            opt_state = jax.tree_util.tree_map(attach, opt_state)
+        return TrainState(step=jax.ShapeDtypeStruct((), jnp.int32),
+                          params=adapters, opt_state=opt_state)
 
 
 class LoRAMinerLoop(MinerLoop):
@@ -93,11 +133,6 @@ class LoRAMinerLoop(MinerLoop):
     adapters."""
 
     def __init__(self, engine: LoRAEngine, transport, miner_id: str, **kw):
-        if kw.get("checkpoint_store") is not None:
-            raise NotImplementedError(
-                "local checkpointing for LoRA miners is not wired yet; "
-                "adapters are small enough that restart-from-base loses "
-                "minutes, not hours")
         super().__init__(engine, transport, miner_id, **kw)
         self._rng = jax.random.PRNGKey(0)
 
@@ -106,33 +141,47 @@ class LoRAMinerLoop(MinerLoop):
                   params=None) -> None:
         """``params`` (value or zero-arg callable) seeds the frozen base when
         no base is published yet — see MinerLoop.bootstrap."""
+        from .train import host_zeros_template
+
         if rng is not None:
             self._rng = rng
         if self._restore_checkpoint(self._rng):
             return
-        template = self.engine.model.init_params(self._rng)
-        fetched = self.transport.fetch_base(template) \
+        fetched = self.transport.fetch_base(
+            host_zeros_template(self.engine)) \
             if self.transport.base_revision() is not None else None
         if fetched is not None:
             base, rev = fetched
             self._base_revision = rev
         else:
             init = params() if callable(params) else params
-            base = init if init is not None else template
-        self.base_params = _place(base)
+            # genesis only — an eager init at the 7B config-4 scale would
+            # materialize the full unsharded base on one chip
+            base = init if init is not None \
+                else self.engine.model.init_params(self._rng)
+        # sharded placement (fsdp/tp on a mesh): the frozen base must never
+        # re-transfer host->device per step, and at the 7B config-4 scale it
+        # only FITS sharded
+        self.base_params = self.engine.place_params(base)
         self.state = self.engine.init_state(self._rng, self.base_params)
 
     def _check_pull(self) -> None:
-        rev = self.transport.base_revision()
-        if rev is None or rev == self._base_revision:
-            return
-        fetched = self.transport.fetch_base(self.base_params)
+        if self._multi():
+            # multi-host pod: coordinator-only transport read + broadcast,
+            # identical on every process (MinerLoop._fetch_base_broadcast) —
+            # per-process reads would diverge the pod's collective programs
+            fetched = self._fetch_base_broadcast()
+        else:
+            rev = self.transport.base_revision()
+            if rev is None or rev == self._base_revision:
+                return
+            fetched = self.transport.fetch_base(self.base_params)
         if fetched is None:
             return
         base, rev = fetched
         logger.info("lora miner %s: new base %s — resetting adapters + "
                     "optimizer", self.miner_id, rev and rev[:8])
-        self.base_params = _place(base)
+        self.base_params = self.engine.place_params(base)
         self.state = self.engine.init_state(self._rng, self.base_params)
         self._base_revision = rev
         self._last_base_time = self.clock.now()

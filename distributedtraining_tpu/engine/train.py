@@ -182,6 +182,21 @@ class TrainEngine:
                                         self.mesh)
         return jax.jit(self.tx.init, out_shardings=shardings)(params)
 
+    def abstract_params(self) -> Params:
+        """Shape/dtype skeleton of the MODEL param tree (with this engine's
+        shardings attached on a mesh) — the restore template for base
+        snapshots. Distinct from ``abstract_state().params`` only in
+        subclasses whose train state is not the model params (LoRA adapters,
+        engine/lora_train.py)."""
+        params = jax.eval_shape(
+            lambda: self.model.init_params(jax.random.PRNGKey(0)))
+        if self._param_shardings is not None:
+            attach = lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                       sharding=s)
+            params = jax.tree_util.tree_map(attach, params,
+                                            self._param_shardings)
+        return params
+
     def abstract_state(self) -> TrainState:
         """Shape/dtype skeleton of a TrainState with zero device allocation
         (restore templates — building a concrete state just to strip it would
@@ -189,20 +204,23 @@ class TrainEngine:
         skeleton carries the engine's shardings so the checkpoint store
         restores directly sharded — materializing the full unsharded tree
         first would OOM exactly the models FSDP exists to fit."""
-        params = jax.eval_shape(
-            lambda: self.model.init_params(jax.random.PRNGKey(0)))
+        params = self.abstract_params()
         opt_state = jax.eval_shape(self.tx.init, params)
         if self._param_shardings is not None:
             attach = lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                                        sharding=s)
-            params = jax.tree_util.tree_map(attach, params,
-                                            self._param_shardings)
             opt_state = jax.tree_util.tree_map(
                 attach, opt_state,
                 opt_state_shardings(opt_state, self._param_shardings,
                                     self.mesh))
         return TrainState(step=jax.ShapeDtypeStruct((), jnp.int32),
                           params=params, opt_state=opt_state)
+
+    def place_state_params(self, params: Params) -> Params:
+        """Placement for the TRAIN-STATE param leaves — identical to
+        ``place_params`` here; the LoRA engine overrides it (its state holds
+        replicated adapters while ``place_params`` shards base trees)."""
+        return self.place_params(params)
 
     def place_opt_state(self, opt_state):
         """Re-place a restored optimizer state on this engine's mesh (restored
@@ -237,16 +255,91 @@ class TrainEngine:
                  ) -> tuple[float, float]:
         """(mean loss, perplexity) over an eval set — exact token-weighted
         aggregation across batches (ModelValidator.evaluate_model parity,
-        validation_logic.py:78-97)."""
-        total, count = 0.0, 0.0
+        validation_logic.py:78-97).
+
+        Accumulation stays ON DEVICE: the validator's hot loop is
+        O(miners x eval batches) calls here, and a ``float()`` per batch
+        would serialize every step on a device->host round-trip. One sync at
+        the end fetches both totals."""
+        total = count = None
         for batch in batches:
             l, c = self.eval_step(params, self.place_batch(batch))
-            total += float(l)
-            count += float(c)
-        if count == 0:
+            total = l if total is None else total + l
+            count = c if count is None else count + c
+        if count is None:
             return float("nan"), float("nan")
-        mean = total / count
+        count_f = float(count)
+        if count_f == 0:
+            return float("nan"), float("nan")
+        mean = float(total) / count_f
         return mean, float(jnp.exp(mean))
+
+
+def broadcast_optional_tree(host_template: Params, coordinator_fetch
+                            ) -> Params | None:
+    """The pod's one 'optional pytree from the coordinator' protocol:
+    ``coordinator_fetch()`` runs ONLY on the coordinator (may return None);
+    every process returns the identical tree or the identical None. The
+    collective ORDER here (ok-flag broadcast, then tree broadcast) is what
+    keeps the pod in lockstep — base pulls and the validator's delta
+    fetches must share this one implementation, not re-roll it."""
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    from ..parallel import multihost
+
+    t = coordinator_fetch() if multihost.is_coordinator() else None
+    ok = bool(mhu.broadcast_one_to_all(np.asarray(t is not None, np.int32)))
+    if not ok:
+        return None
+    t = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)),
+        t if t is not None else host_template)
+    return mhu.broadcast_one_to_all(t)
+
+
+def broadcast_base_fetch(transport, host_template: Params,
+                         current_revision) -> tuple[Params, str | None] | None:
+    """Multi-host base pull: only the coordinator reads the transport
+    (per-host polls could observe different revisions mid-publish, and
+    --backend local storage may not even be visible off-host); the fetched
+    tree is broadcast so every process resets to IDENTICAL values at the
+    identical loop point. Returns (params, rev) or None, the same on every
+    process. Shared by MinerLoop, LoRAMinerLoop, and Validator."""
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    def fetch():
+        rev = transport.base_revision()
+        if rev is None or rev == current_revision:
+            return None
+        fetched = transport.fetch_base(host_template)
+        if fetched is None:
+            return None
+        # the revision rides in the broadcast as a fixed u8 leaf
+        buf = np.zeros((256,), np.uint8)
+        enc = (fetched[1] or "").encode()[:256]
+        buf[: len(enc)] = np.frombuffer(enc, np.uint8)
+        return {"params": fetched[0], "rev": buf}
+
+    out = broadcast_optional_tree(
+        {"params": host_template, "rev": np.zeros((256,), np.uint8)}, fetch)
+    if out is None:
+        return None
+    buf = np.asarray(out["rev"])
+    rev = bytes(buf[buf != 0]).decode(errors="ignore") or None
+    return out["params"], rev
+
+
+def host_zeros_template(engine) -> Params:
+    """Host-side zeros tree of the engine's MODEL param shapes — wire
+    validation / broadcast buffers with zero device allocation (an eager
+    ``init_params`` here would materialize a full unsharded tree on one
+    chip, which at the 7B scale is exactly the OOM the mesh exists to
+    avoid)."""
+    import numpy as np
+    return jax.tree_util.tree_map(lambda a: np.zeros(a.shape, a.dtype),
+                                  engine.abstract_params())
 
 
 def _snapshot(params: Params) -> Params:
@@ -277,12 +370,15 @@ class MinerLoop:
                  log_every: int = 1000,               # ref :394-402
                  nan_guard: bool = True,
                  checkpoint_store=None,
-                 checkpoint_interval: float = 600.0):
+                 checkpoint_interval: float = 600.0,
+                 trace=None):
         self.engine = engine
         self.transport = transport
         self.miner_id = miner_id
         self.clock = clock or RealClock()
         self.metrics = metrics
+        # optional bounded jax.profiler capture (utils.metrics.TraceCapture)
+        self.trace = trace
         self.log_every = log_every
         self.nan_guard = nan_guard
         self.checkpoint_store = checkpoint_store
@@ -355,9 +451,7 @@ class MinerLoop:
         training_manager.py:371-377)."""
         if self._restore_checkpoint(rng):
             return
-        template = self.engine.model.init_params(
-            rng if rng is not None else jax.random.PRNGKey(0))
-        fetched = self.transport.fetch_base(template) \
+        fetched = self.transport.fetch_base(host_zeros_template(self.engine)) \
             if self.transport.base_revision() is not None else None
         if fetched is not None:
             base, rev = fetched
@@ -365,8 +459,13 @@ class MinerLoop:
             self.state = self.engine.init_state(params=base)
         else:
             init = params() if callable(params) else params
-            self.state = self.engine.init_state(
-                params=init if init is not None else template)
+            if init is None:
+                # genesis only: materializing a fresh random tree is the one
+                # path that cannot avoid a full init (fetches/broadcasts use
+                # the zero-alloc host template instead)
+                init = self.engine.model.init_params(
+                    rng if rng is not None else jax.random.PRNGKey(0))
+            self.state = self.engine.init_state(params=init)
         self.base_params = _snapshot(self.state.params)
 
     def _check_pull(self) -> None:
@@ -391,39 +490,10 @@ class MinerLoop:
         self.report.base_pulls += 1
 
     def _fetch_base_broadcast(self):
-        """Multi-host base pull: only the coordinator reads the transport
-        (per-host polls could observe different revisions mid-publish, and
-        --backend local storage may not even be visible off-host); the
-        fetched tree is broadcast so every process resets to IDENTICAL
-        values at the identical loop point. Returns (params, rev) or None,
-        the same on every process."""
-        import numpy as np
-        from jax.experimental import multihost_utils as mhu
-
-        from ..parallel import multihost
-
-        # host-side zeros template: shapes/dtypes for wire validation and
-        # the non-coordinator broadcast buffers (base_params leaves may be
-        # sharded across processes and unreadable on any one host)
-        template = jax.tree_util.tree_map(
-            lambda x: np.zeros(x.shape, x.dtype), self.base_params)
-        fetched = None
-        if multihost.is_coordinator():
-            rev = self.transport.base_revision()
-            if rev is not None and rev != self._base_revision:
-                fetched = self.transport.fetch_base(template)
-        ok = bool(mhu.broadcast_one_to_all(
-            np.asarray(fetched is not None, np.int32)))
-        if not ok:
-            return None
-        params, rev = fetched if fetched is not None else (template, "")
-        params = mhu.broadcast_one_to_all(params)
-        buf = np.zeros((256,), np.uint8)
-        enc = (rev or "").encode()[:256]
-        buf[: len(enc)] = np.frombuffer(enc, np.uint8)
-        buf = np.asarray(mhu.broadcast_one_to_all(buf))
-        rev = bytes(buf[buf != 0]).decode(errors="ignore") or None
-        return params, rev
+        """See broadcast_base_fetch (module level, shared with Validator)."""
+        return broadcast_base_fetch(self.transport,
+                                    host_zeros_template(self.engine),
+                                    self._base_revision)
 
     # -- local checkpoint/resume (checkpoint.py) ----------------------------
     def _save_checkpoint(self) -> None:
@@ -445,12 +515,22 @@ class MinerLoop:
         try:
             self.checkpoint_store.save(
                 self.checkpoint_store.next_step(),
-                Snapshot(state=self.state, base_params=self.base_params,
+                Snapshot(state=self.state,
+                         base_params=self._checkpoint_base(),
                          base_revision=self._base_revision,
                          lifetime_steps=self.report.steps))
             self._last_ckpt_key = key
         except Exception:  # a failed save must not kill training
             logger.exception("miner %s: checkpoint save failed", self.miner_id)
+
+    def _checkpoint_base(self):
+        """The base subtree to persist: None when the base is recoverable
+        from the transport by revision — it is immutable between pulls, so
+        re-writing it every interval is pure redundant IO (for a LoRA miner
+        it is ~99.9% of the bytes: a 7B frozen base vs ~20 MB of adapters).
+        Only a self-initialized genesis base (no published revision) must
+        travel in the snapshot."""
+        return None if self._base_revision is not None else self.base_params
 
     def _restore_checkpoint(self, rng) -> bool:
         if self.checkpoint_store is None:
@@ -459,22 +539,39 @@ class MinerLoop:
             return False
         from ..checkpoint import Snapshot
         abstract = self.engine.abstract_state()
-        template = Snapshot(state=abstract, base_params=abstract.params,
-                            base_revision=None)
         # A corrupt/partial/incompatible checkpoint (disk fault, model-config
         # change between runs) must not wedge the miner: under supervise.sh an
         # unhandled raise here crash-loops forever, defeating the
         # restart-recovers-from-base escape hatch the save path protects.
         try:
+            meta = self.checkpoint_store.read_meta() or {}
+            template = Snapshot(
+                state=abstract,
+                base_params=(self.engine.abstract_params()
+                             if meta.get("has_base", True) else None),
+                base_revision=None)
             snap = self.checkpoint_store.restore(template)
             if snap is None:
                 return False
+            base = snap.base_params
+            if base is None:
+                # base omitted from the snapshot (recoverable by revision):
+                # it must still be AT that revision on the transport —
+                # otherwise fall through to bootstrap, which pulls the new
+                # base fresh (the same optimizer/adapter reset a live base
+                # pull would have forced anyway)
+                base = self._refetch_base(snap.base_revision)
+                if base is None:
+                    logger.info(
+                        "miner %s: checkpoint base %s no longer published; "
+                        "bootstrapping from the current base", self.miner_id,
+                        (snap.base_revision or "?")[:8])
+                    return False
             self.state = TrainState(
                 step=self.engine.place_step(snap.state.step),
-                params=self.engine.place_params(snap.state.params),
+                params=self.engine.place_state_params(snap.state.params),
                 opt_state=self.engine.place_opt_state(snap.state.opt_state))
-            self.base_params = _snapshot(
-                self.engine.place_params(snap.base_params))
+            self.base_params = _snapshot(self.engine.place_params(base))
             self._base_revision = snap.base_revision
             # lifetime counter drives metrics step numbering; falling back to
             # the in-base step would replay step numbers after a resume
@@ -501,6 +598,19 @@ class MinerLoop:
                         self.miner_id)
             self._check_pull()
         return True
+
+    def _refetch_base(self, revision) -> Params | None:
+        """Host-side re-pull of the snapshot's base, valid only if the
+        transport still serves exactly that revision. Single-host only by
+        construction: local checkpointing is disabled on cross-process
+        meshes (__init__), so this never runs inside a pod's SPMD program
+        where a per-process read could diverge."""
+        if revision is None or self.transport.base_revision() != revision:
+            return None
+        fetched = self.transport.fetch_base(host_zeros_template(self.engine))
+        if fetched is None or fetched[1] != revision:
+            return None
+        return fetched[0]
 
     # one program instead of an eager per-leaf op stream (each eager op on a
     # cross-process mesh is its own collective program)
@@ -538,6 +648,8 @@ class MinerLoop:
                 break
             self._pull_action.poll()
             m = self._train_one(batch)
+            if self.trace is not None:
+                self.trace.tick()
             self.report.steps += 1
             self.report.last_loss = float(m["loss"])
             if self.metrics and self.report.steps % self.log_every == 0:
@@ -554,3 +666,5 @@ class MinerLoop:
         """Force a delta push (and checkpoint, if configured) now."""
         self._push_delta()
         self._save_checkpoint()
+        if self.trace is not None:
+            self.trace.close()

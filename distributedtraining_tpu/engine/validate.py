@@ -68,22 +68,56 @@ class Validator:
         self._base_revision = None
         self.base_loss: float | None = None
         self.base_ppl: float | None = None
+        self._warned_no_permit = False
+
+    # -- validator permit ---------------------------------------------------
+    def has_vpermit(self, meta=None) -> bool:
+        """True when this hotkey's uid holds validator stake — the reference
+        gates weight-setting to permitted validators
+        (btt_connector.py:358-385; --neuron.vpermit_tao_limit)."""
+        get_vuids = getattr(self.chain, "get_validator_uids", None)
+        if get_vuids is None:
+            return True  # chain impl has no permit concept (bare stubs)
+        meta = meta if meta is not None else self.chain.sync()
+        try:
+            uid = meta.uids[list(meta.hotkeys).index(self.chain.my_hotkey)]
+        except ValueError:
+            return False  # not registered on the subnet
+        return uid in get_vuids()
+
+    # -- multi-host (config 5: the validator can span a pod too) ------------
+    def _multi(self) -> bool:
+        fn = getattr(self.engine, "_mesh_spans_processes", None)
+        return bool(fn()) if fn is not None else False
+
+    def _host_template(self):
+        from .train import host_zeros_template
+        return host_zeros_template(self.engine)
+
+    def _broadcast_base(self, current_revision):
+        from .train import broadcast_base_fetch
+        return broadcast_base_fetch(self.transport, self._host_template(),
+                                    current_revision)
 
     # -- base model ---------------------------------------------------------
     def bootstrap(self, rng=None, params=None) -> None:
         """``params`` (value or zero-arg callable, e.g. a pretrained loader)
         is used only when no base is published yet — see MinerLoop.bootstrap."""
-        template = self.engine.model.init_params(
-            rng if rng is not None else jax.random.PRNGKey(0))
-        fetched = self.transport.fetch_base(template) \
-            if self.transport.base_revision() is not None else None
+        if self._multi():
+            fetched = self._broadcast_base(None)
+        elif self.transport.base_revision() is not None:
+            fetched = self.transport.fetch_base(self._host_template())
+        else:
+            fetched = None
         if fetched is not None:
-            self.base_params, self._base_revision = fetched
-            self.base_params = self.engine.place_params(self.base_params)
+            base, self._base_revision = fetched
         else:
             init = params() if callable(params) else params
-            self.base_params = self.engine.place_params(
-                init if init is not None else template)
+            # genesis only: the one path that must materialize a full tree
+            base = init if init is not None \
+                else self.engine.model.init_params(
+                    rng if rng is not None else jax.random.PRNGKey(0))
+        self.base_params = self.engine.place_params(base)
         self._eval_base()
 
     def _eval_base(self) -> None:
@@ -94,10 +128,16 @@ class Validator:
                     self.base_loss, self.base_ppl)
 
     def _maybe_refresh_base(self) -> None:
-        rev = self.transport.base_revision()
-        if rev is None or rev == self._base_revision:
-            return
-        fetched = self.transport.fetch_base(self.base_params)
+        if self._multi():
+            # per-process transport reads would hand different base trees
+            # to one cross-process SPMD program — coordinator reads,
+            # everyone applies the identical broadcast
+            fetched = self._broadcast_base(self._base_revision)
+        else:
+            rev = self.transport.base_revision()
+            if rev is None or rev == self._base_revision:
+                return
+            fetched = self.transport.fetch_base(self._host_template())
         if fetched is None:
             return
         self.base_params = self.engine.place_params(fetched[0])
@@ -114,11 +154,27 @@ class Validator:
                                                    self.lora_cfg)
         return self._lora_template
 
-    def score_miner(self, hotkey: str) -> MinerScore:
+    def _fetch_delta(self, hotkey: str):
+        """Dense delta for ``hotkey`` (any wire form), or None. On a
+        multi-host pod only the coordinator touches the transport; the
+        result is broadcast so every process scores the IDENTICAL delta —
+        a mid-publish read skew would otherwise turn one SPMD eval into
+        divergent programs emitting silently wrong scores."""
         from .lora_train import fetch_delta_any
-        d = fetch_delta_any(self.transport, hotkey, self.base_params,
-                            self.lora_cfg,
-                            lora_template=self._adapter_template())
+        if not self._multi():
+            return fetch_delta_any(self.transport, hotkey, self.base_params,
+                                   self.lora_cfg,
+                                   lora_template=self._adapter_template())
+        from .train import broadcast_optional_tree
+        template = self._host_template()
+        return broadcast_optional_tree(
+            template,
+            lambda: fetch_delta_any(self.transport, hotkey, template,
+                                    self.lora_cfg,
+                                    lora_template=self._adapter_template()))
+
+    def score_miner(self, hotkey: str) -> MinerScore:
+        d = self._fetch_delta(hotkey)
         if d is None:
             return MinerScore(hotkey, 0.0, reason="no_delta")
         ok, reason = delta_lib.screen_delta(d, self.base_params,
@@ -149,7 +205,14 @@ class Validator:
                                   f"score_{s.hotkey}": s.score})
         scored = {s.hotkey: s.score for s in results}
         if self.chain.should_set_weights():
-            self.chain.set_weights(scored)  # EMA+normalize inside the chain
+            if self.has_vpermit(meta):
+                self.chain.set_weights(scored)  # EMA+normalize inside chain
+            elif not self._warned_no_permit:
+                self._warned_no_permit = True
+                logger.warning(
+                    "validator %s holds no validator permit (stake below "
+                    "the vpermit limit) — scoring continues but weights "
+                    "are NOT emitted", self.chain.my_hotkey)
         return results
 
     def run_periodic(self, *, interval: float = 1800.0,   # neurons/validator.py:112

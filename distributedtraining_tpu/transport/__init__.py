@@ -11,6 +11,8 @@ polls commit SHAs for change detection. Here the same contract is a
   LocalHFManager twin, hf_manager.py:200-241, made first-class)
 - HFHubTransport    — the real Hub: safetensors/msgpack artifacts, commit-SHA
   revisions, history squashing as GC (network-gated)
+- SignedTransport   — Ed25519 authenticity envelope over any of the above
+  (signs publishes, verifies fetches against registered pubkeys)
 
 All payloads cross the boundary as validated msgpack/safetensors — never
 pickle.
@@ -20,4 +22,18 @@ from .base import Transport, Revision
 from .memory import InMemoryTransport
 from .localfs import LocalFSTransport
 
-__all__ = ["Transport", "Revision", "InMemoryTransport", "LocalFSTransport"]
+__all__ = ["Transport", "Revision", "InMemoryTransport", "LocalFSTransport",
+           "SignedTransport", "HFHubTransport"]
+
+
+def __getattr__(name):
+    # lazy: importing the package must require neither huggingface_hub nor
+    # cryptography (SignedTransport -> signing -> utils.identity pulls the
+    # latter; both are optional extras)
+    if name == "HFHubTransport":
+        from .hf_hub import HFHubTransport
+        return HFHubTransport
+    if name == "SignedTransport":
+        from .signed import SignedTransport
+        return SignedTransport
+    raise AttributeError(name)

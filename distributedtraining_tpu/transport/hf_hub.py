@@ -35,26 +35,38 @@ class HFHubTransport:
     def __init__(self, *, averaged_model_repo_id: str,
                  my_repo_id: str | None = None,
                  token: str | None = None,
-                 max_bytes: int = ser.DEFAULT_MAX_BYTES):
-        try:
-            import huggingface_hub  # noqa: F401
-        except ImportError as e:  # pragma: no cover
-            raise RuntimeError(
-                "HFHubTransport requires huggingface_hub; use "
-                "LocalFSTransport/InMemoryTransport for offline operation"
-            ) from e
-        from huggingface_hub import HfApi
+                 max_bytes: int = ser.DEFAULT_MAX_BYTES,
+                 owns_base_repo: bool = False,
+                 api: Any | None = None):
+        if api is None:
+            try:
+                import huggingface_hub  # noqa: F401
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "HFHubTransport requires huggingface_hub; use "
+                    "LocalFSTransport/InMemoryTransport for offline operation"
+                ) from e
+            from huggingface_hub import HfApi
+            api = HfApi(token=token or os.environ.get("HF_TOKEN"))
 
-        self.api = HfApi(token=token or os.environ.get("HF_TOKEN"))
+        self.api = api
         self.my_repo_id = my_repo_id
         self.base_repo_id = averaged_model_repo_id
         self.max_bytes = max_bytes
+        # which repos this node may squash: its own delta repo, plus the
+        # shared averaged-model repo when this node is the averager that
+        # owns it (the reference squashes BOTH repos, hf_manager.py:73-136;
+        # a validator squashing someone else's shared repo would 403)
+        self.owns_base_repo = owns_base_repo
         # miner_id -> repo_id mapping is supplied by the chain store
         # (chain/base.py); transports only see repo ids.
 
     # -- helpers ------------------------------------------------------------
     def _upload(self, repo_id: str, filename: str, tree: Params) -> Revision:
-        data = ser.to_msgpack(tree)
+        return self._upload_bytes(repo_id, filename, ser.to_msgpack(tree))
+
+    def _upload_bytes(self, repo_id: str, filename: str,
+                      data: bytes) -> Revision:
         with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
             f.write(data)
             tmp = f.name
@@ -69,11 +81,11 @@ class HFHubTransport:
     def _download_bytes(self, repo_id: str, filename: str) -> bytes | None:
         """One network download -> capped raw bytes; the cached blob is
         deleted after reading to bound disk (hf_manager.py:195)."""
-        from huggingface_hub import hf_hub_download
         from huggingface_hub.utils import EntryNotFoundError, RepositoryNotFoundError
         try:
-            path = hf_hub_download(repo_id=repo_id, filename=filename,
-                                   token=self.api.token)
+            # routed through the api object (not the module function) so a
+            # stub HfApi exercises the full download path in tests
+            path = self.api.hf_hub_download(repo_id=repo_id, filename=filename)
         except (EntryNotFoundError, RepositoryNotFoundError):
             return None
         try:
@@ -95,7 +107,11 @@ class HFHubTransport:
         if data is None:
             return None
         try:
-            return ser.from_msgpack(data, template, max_bytes=self.max_bytes)
+            # envelope-tolerant without verification (verification lives in
+            # SignedTransport, which reads the raw-bytes path)
+            from .. import signing
+            return ser.from_msgpack(signing.strip_envelope(data), template,
+                                    max_bytes=self.max_bytes)
         except ser.PayloadError:
             return None
 
@@ -111,6 +127,11 @@ class HFHubTransport:
         repo = self.my_repo_id or miner_id
         return self._upload(repo, DELTA_FILE, delta)
 
+    def publish_raw(self, miner_id: str, data: bytes) -> Revision:
+        """Pre-serialized (possibly signature-enveloped) delta bytes."""
+        repo = self.my_repo_id or miner_id
+        return self._upload_bytes(repo, DELTA_FILE, data)
+
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
         return self._download(miner_id, DELTA_FILE, template)
 
@@ -122,8 +143,28 @@ class HFHubTransport:
     def delta_revision(self, miner_id: str) -> Revision:
         return self._revision(miner_id)
 
+    def _squash_base_repo(self) -> None:
+        """Squash BEFORE publishing (reference order, hf_manager.py:73-136):
+        squashing after would rewrite the just-returned commit SHA, so the
+        averager's recorded revision would go stale and every peer that
+        pulled in the publish->squash window would see a phantom revision
+        change and reset a second time on identical bytes."""
+        if self.owns_base_repo:
+            try:
+                self.api.super_squash_history(repo_id=self.base_repo_id)
+            except Exception:
+                pass  # best-effort, like the reference
+
     def publish_base(self, base: Params) -> Revision:
+        self._squash_base_repo()
         return self._upload(self.base_repo_id, BASE_FILE, base)
+
+    def publish_base_raw(self, data: bytes) -> Revision:
+        self._squash_base_repo()
+        return self._upload_bytes(self.base_repo_id, BASE_FILE, data)
+
+    def fetch_base_bytes(self) -> bytes | None:
+        return self._download_bytes(self.base_repo_id, BASE_FILE)
 
     def fetch_base(self, template: Params):
         tree = self._download(self.base_repo_id, BASE_FILE, template)
@@ -135,9 +176,11 @@ class HFHubTransport:
         return self._revision(self.base_repo_id)
 
     def gc(self) -> None:
-        """Squash history on our own repos to bound Hub storage."""
-        for repo in filter(None, [self.my_repo_id]):
+        """Squash history on this node's delta repo to bound Hub storage.
+        The averaged-model repo is squashed on the publish path instead
+        (_squash_base_repo) so the recorded base revision stays live."""
+        if self.my_repo_id:
             try:
-                self.api.super_squash_history(repo_id=repo)
+                self.api.super_squash_history(repo_id=self.my_repo_id)
             except Exception:
                 pass  # GC is best-effort, like the reference's try/except

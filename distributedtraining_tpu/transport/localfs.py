@@ -22,6 +22,7 @@ import os
 from typing import Any
 
 from .. import serialization as ser
+from .. import signing
 from .base import Revision
 
 Params = Any
@@ -38,6 +39,23 @@ def _hash_file(path: str) -> Revision:
         for chunk in iter(lambda: f.read(1 << 20), b""):
             h.update(chunk)
     return h.hexdigest()
+
+
+def _write_atomic(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)  # readers never see a torn artifact
+
+
+def _read_capped(path: str, max_bytes: int) -> bytes | None:
+    try:
+        if os.path.getsize(path) > max_bytes:
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
 
 
 class LocalFSTransport:
@@ -62,36 +80,30 @@ class LocalFSTransport:
         return _hash_file(path)
 
     def publish_raw(self, miner_id: str, data: bytes) -> Revision:
-        """Arbitrary bytes as a 'delta' — hostile-miner simulation for the
-        admission screens (utils/loadgen.py)."""
+        """Arbitrary (possibly signature-enveloped, possibly hostile) bytes
+        as a 'delta' — signed publishes and loadgen both land here."""
         path = self._delta_path(miner_id)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        _write_atomic(path, data)
         return _hash_file(path)
 
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
-        path = self._delta_path(miner_id)
-        if not os.path.exists(path):
+        data = self.fetch_delta_bytes(miner_id)
+        if data is None:
             return None
         try:
-            return ser.load_file(path, template, max_bytes=self.max_bytes)
+            # envelope-tolerant WITHOUT verification: an unsigned node on a
+            # signed fleet still reads artifacts (verification lives in
+            # SignedTransport, which uses the raw-bytes path instead)
+            return ser.validated_load(signing.strip_envelope(data), template,
+                                      max_bytes=self.max_bytes)
         except ser.PayloadError:
             return None
 
     def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
         """Raw artifact bytes (size-capped), one read — for multi-template
-        validation (full-param vs LoRA adapter submissions)."""
-        path = self._delta_path(miner_id)
-        try:
-            if os.path.getsize(path) > self.max_bytes:
-                return None
-            with open(path, "rb") as f:
-                return f.read()
-        except OSError:
-            return None
+        validation and for SignedTransport's verification."""
+        return _read_capped(self._delta_path(miner_id), self.max_bytes)
 
     def delta_revision(self, miner_id: str) -> Revision:
         return _hash_file(self._delta_path(miner_id))
@@ -101,12 +113,21 @@ class LocalFSTransport:
         ser.save_file(base, self._base_path)
         return _hash_file(self._base_path)
 
+    def publish_base_raw(self, data: bytes) -> Revision:
+        """Pre-serialized (possibly signature-enveloped) base bytes."""
+        _write_atomic(self._base_path, data)
+        return _hash_file(self._base_path)
+
+    def fetch_base_bytes(self) -> bytes | None:
+        return _read_capped(self._base_path, self.max_bytes)
+
     def fetch_base(self, template: Params):
-        if not os.path.exists(self._base_path):
+        data = self.fetch_base_bytes()
+        if data is None:
             return None
         try:
-            tree = ser.load_file(self._base_path, template,
-                                 max_bytes=self.max_bytes)
+            tree = ser.validated_load(signing.strip_envelope(data), template,
+                                      max_bytes=self.max_bytes)
         except ser.PayloadError:
             # a torn/corrupt base must read as "absent", not crash the node
             return None

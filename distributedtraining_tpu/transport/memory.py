@@ -10,6 +10,7 @@ import hashlib
 from typing import Any
 
 from .. import serialization as ser
+from .. import signing
 from .base import Revision
 
 Params = Any
@@ -38,7 +39,9 @@ class InMemoryTransport:
         if data is None:
             return None
         try:
-            return ser.validated_load(data, template)
+            # envelope-tolerant without verification (verification lives in
+            # SignedTransport, which reads the raw-bytes path)
+            return ser.validated_load(signing.strip_envelope(data), template)
         except ser.PayloadError:
             return None
 
@@ -57,11 +60,20 @@ class InMemoryTransport:
         self._base = ser.to_msgpack(base)
         return self.base_revision()
 
+    def publish_base_raw(self, data: bytes) -> Revision:
+        """Pre-serialized (possibly signature-enveloped) base bytes."""
+        self._base = bytes(data)
+        return self.base_revision()
+
+    def fetch_base_bytes(self) -> bytes | None:
+        return self._base
+
     def fetch_base(self, template: Params):
         if self._base is None:
             return None
         try:
-            tree = ser.validated_load(self._base, template)
+            tree = ser.validated_load(signing.strip_envelope(self._base),
+                                      template)
         except ser.PayloadError:
             return None
         return tree, self.base_revision()

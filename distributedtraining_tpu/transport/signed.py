@@ -1,0 +1,176 @@
+"""SignedTransport: Ed25519 authenticity over any byte-capable transport.
+
+Wraps a Transport whose artifacts are raw bytes on the wire (LocalFS,
+InMemory, HFHub all qualify) and:
+
+- signs every publish with this node's Identity (signing.wrap), binding the
+  artifact kind and hotkey into the signed message so a delta can never be
+  replayed as a base or under another hotkey;
+- verifies every fetch against the hotkey's *registered* public key
+  (``pubkey_resolver``, normally AddressStore.retrieve_pubkey). Policy:
+
+    | artifact state        | key registered | no key registered        |
+    |-----------------------|----------------|--------------------------|
+    | valid envelope        | accept         | accept                   |
+    | forged/tampered       | reject         | reject                   |
+    | unsigned              | reject         | accept unless ``strict`` |
+
+  A registered key makes signatures mandatory for that hotkey — an attacker
+  who can write artifacts but not sign them cannot "downgrade" to unsigned.
+
+The reference's equivalent trust anchor is HF repo ownership plus
+hotkey-signed metric posts (hivetrain/utils/dummy_miner.py:63-68); this
+closes the same hole for deployments with no repo ownership (LocalFS, the
+peer registry) and defends HF deployments against hijacked repos too.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Optional
+
+from .. import serialization as ser
+from .. import signing
+from .base import Revision
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+PubkeyResolver = Callable[[str], Optional[bytes]]
+
+
+class SignedTransport:
+    def __init__(self, inner, *, identity=None,
+                 pubkey_resolver: PubkeyResolver | None = None,
+                 base_signer: str | None = None,
+                 my_hotkey: str | None = None,
+                 strict: bool = False,
+                 max_bytes: int = ser.DEFAULT_MAX_BYTES,
+                 now_fn=None):
+        """``identity``: this node's signing key (None = fetch-only role).
+        ``base_signer``: hotkey expected to sign the published base (the
+        averager); with a registered key for it, base fetches require a
+        valid signature. ``my_hotkey``: this node's PROTOCOL hotkey — the
+        domain-separation context for its base publishes must match what
+        peers configure as ``base_signer`` (pubkeys are registered under
+        protocol hotkeys, not derived identity ids). ``strict``: refuse ALL
+        unsigned artifacts."""
+        import time
+        self.inner = inner
+        self.identity = identity
+        self.pubkey_resolver = pubkey_resolver or (lambda hotkey: None)
+        self.base_signer = base_signer
+        self.my_hotkey = my_hotkey or (identity.hotkey if identity else "")
+        self.strict = strict
+        self.max_bytes = max_bytes
+        self._now = now_fn or time.time
+        # anti-rollback watermark: the highest base sequence this node has
+        # accepted. An attacker with write access replaying an OLD validly
+        # signed base changes the content hash (a "new" revision) but not
+        # the signed sequence — monotonicity rejects it. In-memory only: a
+        # freshly booted node accepts the first base it sees (bounded
+        # protection; persistent pinning would need chain-side anchoring).
+        self._base_seq_seen = 0
+
+    # -- policy -------------------------------------------------------------
+    def _open(self, data: bytes, hotkey: str, context: bytes) -> bytes:
+        expected = self.pubkey_resolver(hotkey)
+        return signing.unwrap(data, context, expected_pub=expected,
+                              require=self.strict or expected is not None)
+
+    # -- miner side ---------------------------------------------------------
+    def publish_delta(self, miner_id: str, delta: Params) -> Revision:
+        data = ser.to_msgpack(delta)
+        if self.identity is not None:
+            data = signing.wrap(data, self.identity,
+                                signing.delta_context(miner_id))
+        return self.inner.publish_raw(miner_id, data)
+
+    def publish_raw(self, miner_id: str, data: bytes) -> Revision:
+        """Pass-through (hostile-miner simulation publishes unsigned/forged
+        bytes on purpose — utils/loadgen.py)."""
+        return self.inner.publish_raw(miner_id, data)
+
+    # -- validator / averager side -----------------------------------------
+    def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
+        raw = self.inner.fetch_delta_bytes(miner_id)
+        if raw is None:
+            return None
+        try:
+            return self._open(raw, miner_id, signing.delta_context(miner_id))
+        except ser.PayloadError as e:
+            logger.warning("delta from %s rejected: %s", miner_id, e)
+            return None
+
+    def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
+        data = self.fetch_delta_bytes(miner_id)
+        if data is None:
+            return None
+        try:
+            return ser.validated_load(data, template,
+                                      max_bytes=self.max_bytes)
+        except ser.PayloadError:
+            return None
+
+    def delta_revision(self, miner_id: str) -> Revision:
+        return self.inner.delta_revision(miner_id)
+
+    # -- base model ---------------------------------------------------------
+    def publish_base(self, base: Params) -> Revision:
+        data = ser.to_msgpack(base)
+        if self.identity is not None:
+            # the signed context carries a monotonic sequence (unix time):
+            # peers reject bases whose sequence goes backwards, so a
+            # replayed old-but-validly-signed base cannot roll the fleet back
+            ctx = (signing.base_context(self.my_hotkey)
+                   + b":" + str(int(self._now())).encode())
+            data = signing.wrap(data, self.identity, ctx)
+        return self.inner.publish_base_raw(data)
+
+    def _open_base(self, raw: bytes) -> bytes | None:
+        """With ``base_signer`` configured, the envelope must carry exactly
+        that identity's context and key (mandatory once the key is
+        registered). Without it there is no trust anchor to bind identity
+        to, but the artifact KIND is still enforced — a signed delta
+        replayed as a base is rejected either way."""
+        signer = self.base_signer
+        try:
+            if signer:
+                prefix = signing.base_context(signer)
+                expected = self.pubkey_resolver(signer)
+                payload, ctx = signing.unwrap_with_context(
+                    raw, context_prefix=prefix,
+                    expected_pub=expected,
+                    require=self.strict or expected is not None)
+                seq = signing.context_seq(ctx, prefix)
+                if seq and seq < self._base_seq_seen:
+                    raise ser.PayloadError(
+                        f"base sequence rolled back ({seq} < "
+                        f"{self._base_seq_seen}) — replayed stale base")
+                self._base_seq_seen = max(self._base_seq_seen, seq)
+                return payload
+            return signing.unwrap(raw, kind=b"base", require=self.strict)
+        except ser.PayloadError as e:
+            logger.warning("published base rejected: %s", e)
+            return None
+
+    def fetch_base(self, template: Params):
+        raw = self.inner.fetch_base_bytes()
+        if raw is None:
+            return None
+        data = self._open_base(raw)
+        if data is None:
+            return None
+        try:
+            tree = ser.validated_load(data, template,
+                                      max_bytes=self.max_bytes)
+        except ser.PayloadError:
+            return None
+        return tree, self.inner.base_revision()
+
+    def base_revision(self) -> Revision:
+        return self.inner.base_revision()
+
+    # -- lifecycle ----------------------------------------------------------
+    def gc(self) -> None:
+        self.inner.gc()

@@ -8,8 +8,10 @@ weight deltas from many identities, so a validator/averager under test
 exercises its full download -> screen -> score path at scale.
 
 Poison modes map one-to-one onto the admission screens in delta.py /
-serialization.py: "nan" (has_nonfinite), "shape" (shapes_match),
-"huge" (max_abs cap), "garbage" (msgpack structure validation).
+serialization.py / signing.py: "nan" (has_nonfinite), "shape"
+(shapes_match), "huge" (max_abs cap), "garbage" (msgpack structure
+validation), "forged" (a well-formed delta in a signature envelope signed
+by the WRONG key — the authenticity screen in transport/signed.py).
 """
 
 from __future__ import annotations
@@ -25,7 +27,7 @@ from .identity import Identity
 
 logger = logging.getLogger(__name__)
 
-POISON_MODES = ("nan", "shape", "huge", "garbage")
+POISON_MODES = ("nan", "shape", "huge", "garbage", "forged")
 
 
 @dataclasses.dataclass
@@ -40,7 +42,8 @@ class LoadGenerator:
 
     def __init__(self, transport, template_params: Any, *,
                  n_miners: int = 10, scale: float = 1e-3,
-                 poison_fraction: float = 0.0, seed: int = 0):
+                 poison_fraction: float = 0.0, seed: int = 0,
+                 sign: bool = False):
         self.transport = transport
         self.template = template_params
         self.identities = [Identity.generate() for _ in range(n_miners)]
@@ -48,6 +51,15 @@ class LoadGenerator:
         self.poison_fraction = poison_fraction
         self.rng = np.random.default_rng(seed)
         self.report = LoadReport()
+        # sign=True: each identity signs its own artifacts (what honest
+        # miners on a signed fleet do); numeric poisons then pass the
+        # authenticity screen and must still be caught by the value screens.
+        # "forged" is only meaningful on a signed fleet — unsigned readers
+        # strip envelopes unverified, so a wrong-key artifact would read as
+        # benign and the poison accounting would lie
+        self.sign = sign
+        self.poison_modes = POISON_MODES if sign else tuple(
+            m for m in POISON_MODES if m != "forged")
 
     def _benign_delta(self):
         return jax.tree_util.tree_map(
@@ -74,18 +86,32 @@ class LoadGenerator:
         n_poison = int(round(self.poison_fraction * len(self.identities)))
         for i, ident in enumerate(self.identities):
             if i < n_poison:
-                mode = POISON_MODES[i % len(POISON_MODES)]
+                mode = self.poison_modes[i % len(self.poison_modes)]
                 self.report.poisoned += 1
                 self.report.by_mode[mode] = self.report.by_mode.get(mode, 0) + 1
                 if mode == "garbage":
                     self._publish_garbage(ident)
                     continue
+                if mode == "forged":
+                    self._publish_forged(ident)
+                    continue
                 delta = self._poisoned_delta(mode)
             else:
                 delta = self._benign_delta()
-            self.transport.publish_delta(ident.hotkey, delta)
+            self._publish(ident, delta)
             self.report.published += 1
         return self.report
+
+    def _publish(self, ident: Identity, tree) -> None:
+        publish_raw = getattr(self.transport, "publish_raw", None)
+        if self.sign and publish_raw is not None:
+            from .. import serialization as ser
+            from .. import signing
+            env = signing.wrap(ser.to_msgpack(tree), ident,
+                               signing.delta_context(ident.hotkey))
+            publish_raw(ident.hotkey, env)
+        else:
+            self.transport.publish_delta(ident.hotkey, tree)
 
     def _publish_garbage(self, ident: Identity) -> None:
         """Raw malformed bytes, bypassing the serializer (a hostile miner is
@@ -100,5 +126,29 @@ class LoadGenerator:
                                          {"junk": np.zeros(7, np.float32)})
             self.report.published += 1
 
+    def _publish_forged(self, ident: Identity) -> None:
+        """A PLAUSIBLE delta signed by an attacker's key, published under the
+        victim's hotkey — only the authenticity screen can catch this (the
+        payload passes every numeric/shape screen)."""
+        from .. import serialization as ser
+        from .. import signing
+
+        attacker = Identity.generate()
+        payload = ser.to_msgpack(self._benign_delta())
+        env = signing.wrap(payload, attacker,
+                           signing.delta_context(ident.hotkey))
+        publish_raw = getattr(self.transport, "publish_raw", None)
+        if publish_raw is not None:
+            publish_raw(ident.hotkey, env)
+        else:  # no raw path: an unsigned publish is the closest forgery
+            self.transport.publish_delta(ident.hotkey, self._benign_delta())
+        self.report.published += 1
+
     def hotkeys(self) -> list[str]:
         return [i.hotkey for i in self.identities]
+
+    def register_pubkeys(self, address_store) -> None:
+        """Register every identity's pubkey (what honest miners do at boot;
+        makes signatures mandatory for these hotkeys in SignedTransport)."""
+        for ident in self.identities:
+            address_store.store_pubkey(ident.hotkey, ident.public_bytes)

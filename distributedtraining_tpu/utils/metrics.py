@@ -88,6 +88,49 @@ def multi_sink(*sinks: MetricsSink) -> MetricsSink:
     return _MultiSink(sinks)
 
 
+class TraceCapture:
+    """Bounded ``jax.profiler`` trace capture for the perf loop (SURVEY §5).
+
+    Captures exactly ``steps`` train steps into a TensorBoard-readable trace
+    directory, then stops itself — the role keeps running at full speed.
+    Poll ``tick()`` once per step from the training loop; it is a no-op
+    after the capture window closes. Start is deferred to the first tick
+    AFTER ``skip`` steps so compile time never pollutes the trace.
+    """
+
+    def __init__(self, log_dir: str, *, steps: int = 5, skip: int = 3):
+        self.log_dir = log_dir
+        self.steps = steps
+        self.skip = skip
+        self._seen = 0
+        self._active = False
+        self._done = False
+
+    def tick(self) -> None:
+        if self._done:
+            return
+        import jax
+        self._seen += 1
+        if not self._active and self._seen > self.skip:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and self._seen > self.skip + self.steps:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        """Stop an in-flight capture (role shutdown mid-window)."""
+        if self._active:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._active = False
+                self._done = True
+
+
 def device_metrics() -> dict[str, float]:
     """TPU-side system metrics (replaces torch.cuda.utilization,
     utils/mlflow_utils.py:15-29): per-device HBM in use, via JAX

@@ -34,14 +34,26 @@ class PeerRegistry:
     """In-process registry state (also usable directly in tests)."""
 
     def __init__(self, ttl: float = DEFAULT_TTL,
-                 max_peers: int = DEFAULT_MAX_PEERS):
+                 max_peers: int = DEFAULT_MAX_PEERS,
+                 rate_limit_seconds: float = 0.0,
+                 now_fn=None):
+        from ..chain.base import RateLimiter
         self.ttl = ttl
         self.max_peers = max_peers
         self._peers: dict[str, tuple[str, float]] = {}
         self._lock = threading.Lock()
+        # refuse-on-hammering like the chain surface (btt_connector.py:
+        # 454-480) — but NO permanent blacklist: the hotkey here is an
+        # unauthenticated self-claim, so banning it would let an attacker
+        # spoof a victim's id into a permanent lockout
+        self.limiter = RateLimiter(rate_limit_seconds, now_fn=now_fn,
+                                   blacklist_after=None)
 
     def register(self, hotkey: str, address: str,
-                 now: Optional[float] = None) -> None:
+                 now: Optional[float] = None) -> bool:
+        """True = accepted; False = refused by the rate limiter."""
+        if not self.limiter.allow(hotkey):
+            return False
         t = time.time() if now is None else now
         with self._lock:
             # bounded memory: a hostile client POSTing unlimited distinct
@@ -54,6 +66,7 @@ class PeerRegistry:
                     oldest = min(self._peers, key=lambda h: self._peers[h][1])
                     del self._peers[oldest]
             self._peers[hotkey] = (address, t)
+        return True
 
     def peers(self, now: Optional[float] = None) -> list[dict]:
         t = time.time() if now is None else now
@@ -100,7 +113,9 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, KeyError, TypeError):  # non-dict JSON included
             self._send(400, {"error": "bad request"})
             return
-        self.registry.register(hotkey, address)
+        if not self.registry.register(hotkey, address):
+            self._send(429, {"error": "rate limited"})
+            return
         self._send(200, {"ok": True})
 
     def log_message(self, *args):  # quiet by default
@@ -108,10 +123,11 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(host: str = "127.0.0.1", port: int = 0,
-          ttl: float = DEFAULT_TTL) -> tuple[ThreadingHTTPServer, str]:
+          ttl: float = DEFAULT_TTL,
+          rate_limit_seconds: float = 0.0) -> tuple[ThreadingHTTPServer, str]:
     """Start the registry server on a daemon thread; returns (server, url).
     port=0 picks a free port."""
-    registry = PeerRegistry(ttl=ttl)
+    registry = PeerRegistry(ttl=ttl, rate_limit_seconds=rate_limit_seconds)
     handler = type("Handler", (_Handler,), {"registry": registry})
     srv = ThreadingHTTPServer((host, port), handler)
     srv.registry = registry  # type: ignore[attr-defined]

@@ -142,7 +142,8 @@ def build(cfg: RunConfig) -> Components:
         from distributedtraining_tpu.transport import HFHubTransport
         transport = HFHubTransport(
             averaged_model_repo_id=cfg.averaged_model_repo_id,
-            my_repo_id=cfg.my_repo_id)
+            my_repo_id=cfg.my_repo_id,
+            owns_base_repo=(cfg.role == "averager"))
     else:
         transport = LocalFSTransport(os.path.join(cfg.work_dir, "artifacts"))
 
@@ -153,7 +154,9 @@ def build(cfg: RunConfig) -> Components:
                                wallet_name=cfg.wallet_name,
                                wallet_hotkey=cfg.wallet_hotkey,
                                network=cfg.subtensor_network,
-                               epoch_length=cfg.epoch_length)
+                               epoch_length=cfg.epoch_length,
+                               resync_blocks=cfg.resync_blocks,
+                               vpermit_stake_limit=cfg.vpermit_stake_limit)
         address_store = BittensorAddressStore(chain.subtensor, cfg.netuid,
                                               wallet=chain.wallet)
     else:
@@ -169,6 +172,57 @@ def build(cfg: RunConfig) -> Components:
                            epoch_length=cfg.epoch_length,
                            vpermit_stake_limit=cfg.vpermit_stake_limit)
         address_store = LocalAddressStore(chain_dir)
+    # artifact authenticity: sign publishes, verify fetches against
+    # registered pubkeys (reference anchor: repo ownership + hotkey-signed
+    # metrics, dummy_miner.py:63-68). Wrapped INSIDE the coordinator gate so
+    # pod writes stay coordinator-only.
+    identity = None
+    if cfg.sign_artifacts:
+        from distributedtraining_tpu.transport import SignedTransport
+        from distributedtraining_tpu.utils.identity import Identity
+        wallet_path = cfg.wallet_path or os.path.join(
+            cfg.work_dir, "wallets", f"{cfg.hotkey}.json")
+        # pod roles: ONLY the coordinator holds a signing identity — its
+        # publishes are the only ones that leave the pod (gate_io), and N
+        # processes generate-and-saving to one shared wallet path would
+        # race, registering one process's key while another's lands in the
+        # file (bricking the hotkey under first-write-wins on next boot)
+        if multihost.is_coordinator():
+            if os.path.exists(wallet_path):
+                identity = Identity.load(wallet_path)
+            else:
+                identity = Identity.generate()
+                identity.save(wallet_path)
+                logger.info("generated signing identity %s at %s",
+                            identity.hotkey, wallet_path)
+        base_signer = cfg.base_signer or (
+            cfg.hotkey if cfg.role == "averager" else None)
+        transport = SignedTransport(
+            transport, identity=identity,
+            pubkey_resolver=address_store.retrieve_pubkey,
+            base_signer=base_signer, my_hotkey=cfg.hotkey)
+        register_ok = True
+        if multihost.is_coordinator():
+            try:
+                address_store.store_pubkey(cfg.hotkey, identity.public_bytes)
+            except ValueError:
+                register_ok = False
+        if jax.process_count() > 1:
+            # every process must learn the coordinator's verdict: a
+            # coordinator-only SystemExit would leave the workers alive and
+            # hung at their first collective
+            import numpy as _np
+            from jax.experimental import multihost_utils as _mhu
+            register_ok = bool(_mhu.broadcast_one_to_all(
+                _np.asarray(register_ok, _np.int32)))
+        if not register_ok:
+            # key already registered for this hotkey and differs — a
+            # rotated local wallet must fail loudly, not publish
+            # artifacts every peer will reject
+            raise SystemExit(
+                f"hotkey {cfg.hotkey} has a different registered "
+                f"pubkey; restore the original wallet file or use a "
+                f"new hotkey")
     # only the coordinator process of a pod role may write to the outside
     # world (delta pushes, base publishes, weight sets)
     transport, chain = multihost.gate_io(transport, chain)

@@ -37,39 +37,39 @@ def main(argv=None) -> int:
     cfg = RunConfig.from_args("miner", argv)
     c = build(cfg)
 
+    trace = None
+    if cfg.profile_dir:
+        from distributedtraining_tpu.utils.metrics import TraceCapture
+        trace = TraceCapture(cfg.profile_dir, steps=cfg.profile_steps)
     store = None
+    if cfg.checkpoint_interval > 0:
+        from distributedtraining_tpu.checkpoint import CheckpointStore
+        ckpt_dir = cfg.checkpoint_dir or os.path.join(
+            cfg.work_dir, "checkpoints", cfg.hotkey)
+        store = CheckpointStore(ckpt_dir)
     if c.lora_cfg is not None:
         # config-4 mode: adapter-only training, adapter-tree artifacts.
         # Reuse the composed engine's optimizer so --learning-rate and
-        # --grad-clip apply to adapters too.
+        # --grad-clip apply to adapters too; the mesh shards the frozen
+        # base (fsdp/tp) while adapters replicate.
         from distributedtraining_tpu.engine import LoRAEngine, LoRAMinerLoop
-        if cfg.checkpoint_interval > 0:
-            logging.warning(
-                "LoRA miners do not support local checkpointing yet; "
-                "running WITHOUT preemption recovery (adapters retrain "
-                "from the published base on restart)")
-        if c.engine.mesh is not None:
-            logging.warning(
-                "LoRA adapter training is single-device this release; "
-                "ignoring the configured %s mesh (dp/fsdp/sp/tp flags are "
-                "inert with --lora-rank)", dict(c.engine.mesh.shape))
-        engine = LoRAEngine(c.model, c.lora_cfg, optimizer=c.engine.tx)
+        engine = LoRAEngine(c.model, c.lora_cfg, optimizer=c.engine.tx,
+                            mesh=c.engine.mesh, seq_len=cfg.seq_len)
         loop = LoRAMinerLoop(engine, c.transport, cfg.hotkey,
                              send_interval=cfg.send_interval,
                              check_update_interval=cfg.check_update_interval,
-                             metrics=c.metrics)
+                             metrics=c.metrics,
+                             checkpoint_store=store,
+                             checkpoint_interval=cfg.checkpoint_interval,
+                             trace=trace)
     else:
-        if cfg.checkpoint_interval > 0:
-            from distributedtraining_tpu.checkpoint import CheckpointStore
-            ckpt_dir = cfg.checkpoint_dir or os.path.join(
-                cfg.work_dir, "checkpoints", cfg.hotkey)
-            store = CheckpointStore(ckpt_dir)
         loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
                          send_interval=cfg.send_interval,
                          check_update_interval=cfg.check_update_interval,
                          metrics=c.metrics,
                          checkpoint_store=store,
-                         checkpoint_interval=cfg.checkpoint_interval)
+                         checkpoint_interval=cfg.checkpoint_interval,
+                         trace=trace)
     try:
         loop.bootstrap(params=c.initial_params)
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)

@@ -39,6 +39,17 @@ def main(argv=None) -> int:
     validator = Validator(c.engine, c.transport, c.chain,
                           eval_batches=c.eval_batches(),
                           metrics=c.metrics, lora_cfg=c.lora_cfg)
+    # the reference gates weight-setting to staked validators
+    # (btt_connector.py:358-385); refuse up front instead of silently
+    # burning eval compute on scores no one will ever see
+    if not validator.has_vpermit():
+        if not cfg.allow_no_vpermit:
+            raise SystemExit(
+                f"hotkey {c.chain.my_hotkey} holds no validator permit "
+                f"(stake < {cfg.vpermit_stake_limit}); pass "
+                f"--allow-no-vpermit to run anyway without emitting weights")
+        logging.warning("running WITHOUT a validator permit: weights will "
+                        "not be emitted")
     validator.bootstrap(params=c.initial_params)
     try:
         ok = validator.run_periodic(interval=cfg.validation_interval,

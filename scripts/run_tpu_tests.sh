@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# On-device test lane: Pallas kernel numerics on real TPU hardware.
+#
+# The main suite (tests/) forces a virtual 8-device CPU mesh, so the
+# flash-attention parity cases skip there by design. This lane runs them on
+# the chip. Run it from the repo root on any machine where jax.devices()
+# shows a TPU:
+#
+#   scripts/run_tpu_tests.sh            # whole lane
+#   scripts/run_tpu_tests.sh -k grads   # pytest args pass through
+#
+# No CPU-forcing conftest is in scope here; tests skip loudly if no TPU is
+# visible rather than passing vacuously.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests_tpu/ -q -p no:cacheprovider "$@"

@@ -82,6 +82,40 @@ def main() -> int:
     expected = 4 if multihost.is_coordinator() else 0
     assert len(sent) == expected, (pid, sent)
 
+    # -- validator on the pod: coordinator-only transport reads ------------
+    # the worker's transport is EMPTY — if the validator read it locally
+    # instead of broadcasting the coordinator's fetch, bootstrap would
+    # self-init a different base and scores would diverge (or hang)
+    from jax.experimental import multihost_utils as mhu
+
+    from distributedtraining_tpu.engine import Validator
+    from distributedtraining_tpu.transport import InMemoryTransport
+
+    transport = InMemoryTransport()
+    if multihost.is_coordinator():
+        base = model.init_params(jax.random.PRNGKey(7))
+        transport.publish_base(base)
+        delta = jax.tree_util.tree_map(
+            lambda x: np.full(x.shape, 1e-3, np.float32), base)
+        transport.publish_delta("m1", delta)
+
+    veng = TrainEngine(model, mesh=mesh, seq_len=seq)
+    eval_batch = {"input_ids": np.arange(2 * seq, dtype=np.int32)
+                  .reshape(2, seq) % cfg.vocab_size}
+    v = Validator(veng, transport, FakeChain(),
+                  eval_batches=lambda: iter([eval_batch]))
+    v.bootstrap()
+    assert v._base_revision is not None, \
+        f"pid {pid}: validator must see the coordinator's base"
+    score = v.score_miner("m1")
+    assert score.reason == "ok", (pid, score)
+    # the coordinator's numbers are everyone's numbers
+    ref = np.asarray(mhu.broadcast_one_to_all(
+        np.asarray([score.score, v.base_loss], np.float64)))
+    np.testing.assert_allclose([score.score, v.base_loss], ref, rtol=1e-6)
+    missing = v.score_miner("m_absent")
+    assert missing.reason == "no_delta", (pid, missing)
+
     print(f"RESULT {pid} {loss:.6f} {int(multihost.is_coordinator())}",
           flush=True)
     return 0

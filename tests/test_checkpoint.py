@@ -274,3 +274,57 @@ def test_restore_empty_store_returns_none(tmp_path, setup):
         template = Snapshot(state=engine.init_state(jax.random.PRNGKey(0)),
                             base_params=None, base_revision=None)
         assert store.restore(template) is None
+
+
+def test_published_base_not_persisted_in_snapshot(tmp_path):
+    """When the base is recoverable by transport revision, checkpoints omit
+    it (for a LoRA miner the frozen base is ~99.9% of the bytes); restore
+    re-pulls it and resumes. A self-init genesis base (no revision) still
+    travels in the snapshot."""
+    import os
+
+    from distributedtraining_tpu.checkpoint import CheckpointStore
+    from distributedtraining_tpu.engine import FakeClock, MinerLoop, TrainEngine
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+
+    model, cfg = gpt2.make_model("tiny")
+    transport = InMemoryTransport()
+    transport.publish_base(model.init_params(jax.random.PRNGKey(1)))
+
+    def du(d):
+        return sum(os.path.getsize(os.path.join(r, f))
+                   for r, _, fs in os.walk(d) for f in fs)
+
+    with CheckpointStore(str(tmp_path / "pub")) as store:
+        engine = TrainEngine(model, seq_len=16)
+        m = MinerLoop(engine, transport, "m0", clock=FakeClock(),
+                      send_interval=1e9, check_update_interval=1e9,
+                      checkpoint_store=store)
+        m.bootstrap(jax.random.PRNGKey(0))
+        m.flush()
+        assert store.read_meta()["has_base"] is False
+        pub_bytes = du(str(tmp_path / "pub"))
+
+    with CheckpointStore(str(tmp_path / "gen")) as store2:
+        engine2 = TrainEngine(model, seq_len=16)
+        m2 = MinerLoop(engine2, InMemoryTransport(), "m0", clock=FakeClock(),
+                       send_interval=1e9, check_update_interval=1e9,
+                       checkpoint_store=store2)
+        m2.bootstrap(jax.random.PRNGKey(0))  # no published base: genesis
+        m2.flush()
+        assert store2.read_meta()["has_base"] is True
+        gen_bytes = du(str(tmp_path / "gen"))
+
+    # the published-base snapshot skips a full param tree (state is params +
+    # 2 adam moments + base -> dropping base saves ~1/4)
+    assert pub_bytes < gen_bytes * 0.85, (pub_bytes, gen_bytes)
+
+    # and the omitted-base checkpoint actually resumes
+    with CheckpointStore(str(tmp_path / "pub")) as store3:
+        engine3 = TrainEngine(model, seq_len=16)
+        m3 = MinerLoop(engine3, transport, "m0", clock=FakeClock(),
+                       send_interval=1e9, check_update_interval=1e9,
+                       checkpoint_store=store3)
+        m3.bootstrap(jax.random.PRNGKey(7))
+        assert m3._base_revision == m._base_revision

@@ -314,3 +314,18 @@ def test_outer_opt_in_averager_loop(setup):
     base_loss, _ = engine.evaluate(loop.base_params, val_batches())
     assert loop.run_round()
     assert loop.report.last_loss < base_loss
+
+
+def test_unpermitted_validator_never_emits_weights(setup, tmp_path):
+    """A miner-stake hotkey running the validator scores but must not
+    set_weights (vpermit gate, btt_connector.py:358-385)."""
+    model, cfg, engine, train_batches, val_batches = setup
+    transport = InMemoryTransport()
+    chain = LocalChain(str(tmp_path), my_hotkey="hotkey_5", epoch_length=0,
+                       clock=FakeClock())
+    transport.publish_base(model.init_params(jax.random.PRNGKey(0)))
+    v = Validator(engine, transport, chain, eval_batches=val_batches)
+    v.bootstrap(jax.random.PRNGKey(0))
+    assert not v.has_vpermit()
+    assert v.validate_and_score()          # scoring itself still works
+    assert chain.get_weights() == {}       # but nothing was emitted

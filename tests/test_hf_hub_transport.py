@@ -1,0 +1,181 @@
+"""HFHubTransport against a stub HfApi — no network.
+
+The stub models just enough of the Hub: per-repo file blobs, a commit SHA
+that changes on every upload, and download-to-a-local-cache-file semantics
+(including the transport's delete-after-read behavior). Covers the full
+Transport protocol plus gc() ownership rules (reference squashes both its
+delta repo and the shared averaged-model repo it owns,
+hivetrain/hf_manager.py:73-136).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import serialization as ser
+from distributedtraining_tpu.transport.hf_hub import (BASE_FILE, DELTA_FILE,
+                                                      HFHubTransport)
+
+
+class StubHfApi:
+    """In-memory Hub: {repo_id: {filename: bytes}} + fake commit SHAs."""
+
+    def __init__(self, tmpdir):
+        self.tmpdir = str(tmpdir)
+        self.repos: dict[str, dict[str, bytes]] = {}
+        self.shas: dict[str, str] = {}
+        self.squashed: list[str] = []
+        self.token = None
+
+    def _bump(self, repo_id: str) -> str:
+        blob = b"".join(self.repos.get(repo_id, {}).get(f, b"")
+                        for f in sorted(self.repos.get(repo_id, {})))
+        sha = hashlib.sha1(blob + repo_id.encode()).hexdigest()
+        self.shas[repo_id] = sha
+        return sha
+
+    def upload_file(self, *, path_or_fileobj, path_in_repo, repo_id,
+                    repo_type="model"):
+        with open(path_or_fileobj, "rb") as f:
+            data = f.read()
+        self.repos.setdefault(repo_id, {})[path_in_repo] = data
+        sha = self._bump(repo_id)
+
+        class Info:
+            oid = sha
+        return Info()
+
+    def hf_hub_download(self, *, repo_id, filename, **kw):
+        from huggingface_hub.utils import EntryNotFoundError
+        try:
+            data = self.repos[repo_id][filename]
+        except KeyError:
+            raise EntryNotFoundError(f"{repo_id}/{filename} not found")
+        path = os.path.join(self.tmpdir, f"{repo_id}_{filename}".replace(
+            "/", "_"))
+        with open(path, "wb") as f:
+            f.write(data)
+        return path
+
+    def list_repo_refs(self, repo_id):
+        class Branch:
+            def __init__(self, sha):
+                self.target_commit = sha
+
+        class Refs:
+            branches = ([Branch(self.shas[repo_id])]
+                        if repo_id in self.shas else [])
+        return Refs()
+
+    def super_squash_history(self, *, repo_id):
+        if repo_id not in self.repos:
+            raise RuntimeError(f"403: not your repo {repo_id}")
+        self.squashed.append(repo_id)
+
+
+def tree():
+    return {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.ones((3,), np.float32)}
+
+
+@pytest.fixture
+def api(tmp_path):
+    return StubHfApi(tmp_path / "hub_cache")
+
+
+def make(api, **kw):
+    os.makedirs(api.tmpdir, exist_ok=True)
+    return HFHubTransport(averaged_model_repo_id="org/averaged", api=api, **kw)
+
+
+def test_delta_round_trip_and_revision(api):
+    t = make(api, my_repo_id="org/miner0")
+    template = tree()
+    assert t.fetch_delta("org/miner0", template) is None
+    assert t.delta_revision("org/miner0") is None
+
+    rev1 = t.publish_delta("miner_hotkey_ignored", tree())
+    assert rev1 is not None
+    got = t.fetch_delta("org/miner0", template)
+    np.testing.assert_array_equal(got["w"], template["w"])
+    assert t.delta_revision("org/miner0") == rev1
+
+    # revision changes when content changes (commit-SHA polling semantics)
+    changed = tree()
+    changed["w"] = changed["w"] + 1
+    rev2 = t.publish_delta("x", changed)
+    assert rev2 != rev1
+
+
+def test_download_deletes_cached_blob(api):
+    t = make(api, my_repo_id="org/miner0")
+    t.publish_delta("x", tree())
+    assert t.fetch_delta("org/miner0", tree()) is not None
+    # the cache file must not survive the read (disk-bounding behavior)
+    leftovers = [f for f in os.listdir(api.tmpdir)
+                 if DELTA_FILE.replace("/", "_") in f]
+    assert leftovers == []
+
+
+def test_base_round_trip(api):
+    t = make(api)
+    assert t.fetch_base(tree()) is None
+    assert t.base_revision() is None
+    rev = t.publish_base(tree())
+    fetched = t.fetch_base(tree())
+    assert fetched is not None
+    got, got_rev = fetched
+    np.testing.assert_array_equal(got["b"], np.ones((3,), np.float32))
+    assert got_rev == rev == t.base_revision()
+    assert BASE_FILE in api.repos["org/averaged"]
+
+
+def test_fetch_rejects_oversize_and_garbage(api):
+    t = make(api, my_repo_id="org/miner0", max_bytes=16)
+    t.publish_delta("x", tree())  # serialized form exceeds 16 bytes
+    assert t.fetch_delta("org/miner0", tree()) is None
+
+    t2 = make(api, my_repo_id="org/miner1")
+    api.repos["org/miner1"] = {DELTA_FILE: b"\xff\x00garbage"}
+    api._bump("org/miner1")
+    assert t2.fetch_delta("org/miner1", tree()) is None  # PayloadError -> None
+
+
+def test_fetch_delta_bytes_single_read(api):
+    t = make(api, my_repo_id="org/miner0")
+    t.publish_delta("x", tree())
+    data = t.fetch_delta_bytes("org/miner0")
+    assert data is not None
+    assert ser.from_msgpack(data, tree()) is not None
+    assert t.fetch_delta_bytes("org/nonexistent") is None
+
+
+def test_gc_squashes_own_repos_only(api):
+    miner = make(api, my_repo_id="org/miner0")
+    miner.publish_delta("x", tree())
+    miner.gc()
+    assert api.squashed == ["org/miner0"]
+
+    api.squashed.clear()
+    validator = make(api)  # no repo of its own, does not own the base
+    validator.gc()
+    assert api.squashed == []
+
+
+def test_base_repo_squashed_before_publish_not_after(api):
+    """Squash must precede the upload (reference order) so the revision
+    publish_base returns stays the live one — squashing after would hand
+    every peer a phantom revision change on identical bytes."""
+    averager = make(api, owns_base_repo=True)
+    rev1 = averager.publish_base(tree())          # repo absent: squash no-ops
+    assert averager.base_revision() == rev1       # recorded rev is live
+    api.squashed.clear()
+    rev2 = averager.publish_base(tree())
+    assert api.squashed == ["org/averaged"]       # squashed on publish...
+    assert averager.base_revision() == rev2       # ...but rev still live
+    averager.gc()                                  # gc never touches it
+    assert api.squashed == ["org/averaged"]

@@ -118,3 +118,21 @@ def test_lora_adapts_expected_kernels():
     lp = lora.init_lora(jax.random.PRNGKey(5), params, lora.LoRAConfig(rank=2))
     # 2 layers x (wq, wk, wv, wo) = 8 adapted kernels
     assert len(lora.adapted_pairs(lp)) == 8
+
+
+def test_llama2_7b_shapes_on_v4_32_mesh():
+    """Shape-validate the llama2-7b preset (full-param AND LoRA engines) on
+    a 32-device virtual mesh — subprocess because it needs its own
+    XLA_FLAGS device count (VERDICT r01: presets never shape-validated at
+    scale break on first contact, e.g. GQA kv-heads vs tp divisibility)."""
+    import os
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "validate_7b_worker.py")
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=32")
+    proc = subprocess.run([sys.executable, worker], env=env,
+                          capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.startswith("OK "), proc.stdout

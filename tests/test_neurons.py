@@ -134,3 +134,52 @@ def test_config_defaults_match_reference():
     assert cfg.meta_epochs == 7               # neurons/averager.py:106
     assert cfg.epoch_length == 100            # base_subnet_config.py:72-77
     assert cfg.seq_len == 64 and cfg.eval_seq_len == 512
+
+
+def test_validator_entry_refuses_without_vpermit(tmp_path):
+    """hotkey_0 has miner stake (10 < vpermit limit 1000): the entry point
+    must refuse up front unless --allow-no-vpermit is passed."""
+    with pytest.raises(SystemExit, match="validator permit"):
+        validator.main(_common(tmp_path, "hotkey_0", ["--rounds", "1"]))
+    # escape hatch: runs, scores, but emits no weights
+    rc = validator.main(_common(
+        tmp_path, "hotkey_0", ["--rounds", "1", "--allow-no-vpermit"]))
+    assert rc == 0
+    meta = json.loads((tmp_path / "chain" / "metagraph.json").read_text())
+    assert "hotkey_0" not in meta.get("weights", {})
+
+
+def test_signed_round_end_to_end(tmp_path):
+    """Full miner -> validator -> averager round with --sign-artifacts: every
+    artifact crosses the wire in an Ed25519 envelope, pubkeys land in the
+    chain dir, and a forged overwrite of the miner's delta is screened."""
+    signed = ["--sign-artifacts", "--base-signer", "hotkey_99"]
+    rc = miner.main(_common(
+        tmp_path, "hotkey_0",
+        ["--max-steps", "20", "--send-interval", "1e9", *signed]))
+    assert rc == 0
+    delta_path = tmp_path / "artifacts" / "deltas" / "hotkey_0.msgpack"
+    from distributedtraining_tpu import signing
+    assert signing.is_enveloped(delta_path.read_bytes())
+    assert (tmp_path / "chain" / "pubkeys.json").exists()
+
+    rc = validator.main(_common(tmp_path, "hotkey_91",
+                                ["--rounds", "1", *signed]))
+    assert rc == 0
+    meta = json.loads((tmp_path / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 0) > 0
+
+    rc = averager.main(_common(
+        tmp_path, "hotkey_99",
+        ["--rounds", "1", "--strategy", "weighted", *signed]))
+    assert rc == 0
+    base_path = tmp_path / "artifacts" / "base" / "averaged_model.msgpack"
+    assert signing.is_enveloped(base_path.read_bytes())
+
+    # attacker overwrites the miner's delta with an unsigned payload: the
+    # next validator round must score that miner 0 (no_delta)
+    import numpy as np
+    delta_path.write_bytes(b"\x00" * 64)
+    rc = validator.main(_common(tmp_path, "hotkey_91",
+                                ["--rounds", "1", *signed]))
+    assert rc == 0

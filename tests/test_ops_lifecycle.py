@@ -8,6 +8,7 @@ traffic (utils/dummy_miner.py), DHT bootstrap pool (utils/bootstrap_server.py).
 """
 
 import itertools
+import os
 
 import numpy as np
 import pytest
@@ -217,3 +218,68 @@ def test_registry_http_roundtrip():
         assert len(reg.get_peers(url)) == 52
     finally:
         srv.shutdown()
+
+
+def test_registry_rate_limiter_refuses_hammering_without_banning():
+    """Too-fast re-registration is refused (chain-style hammering guard,
+    btt_connector.py:454-480) but the registry NEVER permanently bans: the
+    hotkey is an unauthenticated self-claim, so an attacker spoofing a
+    victim's id must at worst rate-limit it, not lock it out forever."""
+    t = [100.0]
+    r = reg.PeerRegistry(ttl=60.0, rate_limit_seconds=5.0,
+                         now_fn=lambda: t[0])
+    assert r.register("hkA", "a:1")
+    for _ in range(5):          # an attacker hammers the victim's hotkey
+        t[0] += 1.0
+        assert not r.register("hkA", "x:666")
+    t[0] += 100.0
+    # the real peer re-registers fine after the interval — no spoofed ban
+    assert r.register("hkA", "a:1")
+    assert r.register("hkB", "b:1")       # other callers unaffected
+
+
+def test_registry_http_rate_limited_429():
+    srv, url = reg.serve(ttl=60.0, rate_limit_seconds=30.0)
+    try:
+        assert reg.register_peer(url, "hkA", "10.0.0.1:5000")
+        # immediate re-register is refused (HTTP 429 -> client False)
+        assert not reg.register_peer(url, "hkA", "10.0.0.1:5000")
+        # the first registration is still live
+        assert {p["hotkey"] for p in reg.get_peers(url)} == {"hkA"}
+    finally:
+        srv.shutdown()
+
+
+def test_trace_capture_bounded_window(tmp_path):
+    """TraceCapture profiles exactly the post-warmup window and writes a
+    TensorBoard-readable trace, then goes inert (jax.profiler, SURVEY §5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtraining_tpu.utils.metrics import TraceCapture
+
+    d = str(tmp_path / "trace")
+    cap = TraceCapture(d, steps=2, skip=1)
+    f = jax.jit(lambda x: x * 2 + 1)
+    for _ in range(6):
+        f(jnp.ones((4,)))
+        cap.tick()
+    assert cap._done and not cap._active
+    produced = [os.path.join(r, fn) for r, _, fns in os.walk(d) for fn in fns]
+    assert produced, "no trace files written"
+    cap.tick()  # inert after the window
+    cap.close()
+
+
+def test_trace_capture_close_mid_window(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtraining_tpu.utils.metrics import TraceCapture
+
+    cap = TraceCapture(str(tmp_path / "t2"), steps=100, skip=0)
+    jax.jit(lambda x: x + 1)(jnp.ones(()))
+    cap.tick()
+    assert cap._active
+    cap.close()
+    assert cap._done and not cap._active

@@ -1,0 +1,282 @@
+"""Artifact authenticity: Ed25519 envelopes + SignedTransport policy.
+
+Reference anchor: hotkey-signed metric posts verified by the receiver
+(hivetrain/utils/dummy_miner.py:63-68) and HF repo ownership. Here the same
+trust applies to the artifacts themselves: forged or tampered payloads are
+rejected, unsigned payloads are rejected once a hotkey has a registered key,
+and the full loadgen poison battery (including "forged") is screened.
+"""
+
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import serialization as ser
+from distributedtraining_tpu import signing
+from distributedtraining_tpu.chain import LocalAddressStore
+from distributedtraining_tpu.transport import (InMemoryTransport,
+                                               LocalFSTransport,
+                                               SignedTransport)
+from distributedtraining_tpu.utils.identity import Identity
+from distributedtraining_tpu.utils.loadgen import LoadGenerator
+
+
+def tree():
+    return {"w": np.arange(4, dtype=np.float32), "b": np.zeros(2, np.float32)}
+
+
+# -- envelope primitives -----------------------------------------------------
+
+def test_wrap_unwrap_roundtrip():
+    ident = Identity.generate()
+    payload = b"hello artifact"
+    ctx = signing.delta_context("hk1")
+    env = signing.wrap(payload, ident, ctx)
+    assert signing.is_enveloped(env)
+    assert signing.unwrap(env, ctx) == payload
+    assert signing.unwrap(env, ctx, expected_pub=ident.public_bytes) == payload
+
+
+def test_unwrap_rejects_tamper_and_wrong_key_and_context():
+    ident = Identity.generate()
+    ctx = signing.delta_context("hk1")
+    env = signing.wrap(b"data", ident, ctx)
+    # payload tamper
+    bad = env[:-1] + bytes([env[-1] ^ 1])
+    with pytest.raises(ser.PayloadError):
+        signing.unwrap(bad, ctx)
+    # wrong expected pub (claimed hotkey has a different registered key)
+    other = Identity.generate()
+    with pytest.raises(ser.PayloadError):
+        signing.unwrap(env, ctx, expected_pub=other.public_bytes)
+    # cross-protocol replay: a delta envelope presented as a base
+    with pytest.raises(ser.PayloadError):
+        signing.unwrap(env, signing.base_context("hk1"))
+    # replay under another hotkey
+    with pytest.raises(ser.PayloadError):
+        signing.unwrap(env, signing.delta_context("hk2"))
+
+
+def test_unwrap_unsigned_policy():
+    raw = b"plain bytes"
+    assert signing.unwrap(raw, b"ctx") == raw
+    with pytest.raises(ser.PayloadError):
+        signing.unwrap(raw, b"ctx", require=True)
+
+
+# -- SignedTransport over real backends --------------------------------------
+
+@pytest.fixture(params=["memory", "localfs"])
+def inner(request, tmp_path):
+    if request.param == "memory":
+        return InMemoryTransport()
+    return LocalFSTransport(str(tmp_path / "artifacts"))
+
+
+def test_signed_delta_roundtrip_and_forgery(inner, tmp_path):
+    store = LocalAddressStore(str(tmp_path / "chain"))
+    miner_ident = Identity.generate()
+    store.store_pubkey("m0", miner_ident.public_bytes)
+
+    miner_t = SignedTransport(inner, identity=miner_ident,
+                              pubkey_resolver=store.retrieve_pubkey,
+                              my_hotkey="m0")
+    validator_t = SignedTransport(inner,
+                                  pubkey_resolver=store.retrieve_pubkey)
+
+    miner_t.publish_delta("m0", tree())
+    got = validator_t.fetch_delta("m0", tree())
+    assert got is not None
+    np.testing.assert_array_equal(got["w"], tree()["w"])
+
+    # attacker overwrites with an artifact signed by their own key
+    attacker = Identity.generate()
+    forged = signing.wrap(ser.to_msgpack(tree()), attacker,
+                          signing.delta_context("m0"))
+    inner.publish_raw("m0", forged)
+    assert validator_t.fetch_delta("m0", tree()) is None
+
+    # attacker downgrades to unsigned: also rejected (key is registered)
+    inner.publish_raw("m0", ser.to_msgpack(tree()))
+    assert validator_t.fetch_delta("m0", tree()) is None
+
+    # unregistered hotkey, unsigned artifact: accepted (mixed fleet)
+    inner.publish_raw("anon", ser.to_msgpack(tree()))
+    assert validator_t.fetch_delta("anon", tree()) is not None
+    # ... unless strict
+    strict_t = SignedTransport(inner, pubkey_resolver=store.retrieve_pubkey,
+                               strict=True)
+    assert strict_t.fetch_delta("anon", tree()) is None
+
+
+def test_signed_base_roundtrip_and_forgery(inner, tmp_path):
+    store = LocalAddressStore(str(tmp_path / "chain"))
+    avg_ident = Identity.generate()
+    store.store_pubkey("hotkey_99", avg_ident.public_bytes)
+
+    averager_t = SignedTransport(inner, identity=avg_ident,
+                                 pubkey_resolver=store.retrieve_pubkey,
+                                 my_hotkey="hotkey_99")
+    miner_t = SignedTransport(inner, pubkey_resolver=store.retrieve_pubkey,
+                              base_signer="hotkey_99")
+
+    averager_t.publish_base(tree())
+    fetched = miner_t.fetch_base(tree())
+    assert fetched is not None
+    got, rev = fetched
+    assert rev is not None
+    np.testing.assert_array_equal(got["b"], tree()["b"])
+
+    # attacker replaces the base with one signed by their own key
+    attacker = Identity.generate()
+    inner.publish_base_raw(signing.wrap(ser.to_msgpack(tree()), attacker,
+                                        signing.base_context("hotkey_99")))
+    assert miner_t.fetch_base(tree()) is None
+    # or an unsigned base: rejected too (signer key is registered)
+    inner.publish_base_raw(ser.to_msgpack(tree()))
+    assert miner_t.fetch_base(tree()) is None
+
+
+def test_pubkey_first_write_wins(tmp_path):
+    store = LocalAddressStore(str(tmp_path))
+    a, b = Identity.generate(), Identity.generate()
+    store.store_pubkey("hk", a.public_bytes)
+    store.store_pubkey("hk", a.public_bytes)  # idempotent re-register ok
+    with pytest.raises(ValueError):
+        store.store_pubkey("hk", b.public_bytes)
+    assert store.retrieve_pubkey("hk") == a.public_bytes
+
+
+# -- loadgen forged mode ------------------------------------------------------
+
+def test_loadgen_forged_poison_screened(tmp_path):
+    """A signed fleet under the full poison battery: forged artifacts die at
+    the authenticity screen, numeric poisons pass it (correctly signed) and
+    die at the value screens."""
+    inner = InMemoryTransport()
+    store = LocalAddressStore(str(tmp_path))
+    gen = LoadGenerator(inner, tree(), n_miners=10, poison_fraction=0.5,
+                        sign=True)
+    gen.register_pubkeys(store)
+    gen.publish_round()
+    assert gen.report.by_mode.get("forged", 0) >= 1
+
+    validator_t = SignedTransport(inner, pubkey_resolver=store.retrieve_pubkey)
+    fetched = {hk: validator_t.fetch_delta_bytes(hk) for hk in gen.hotkeys()}
+    # poison order is deterministic: first n_poison identities, cycling modes
+    modes = ("nan", "shape", "huge", "garbage", "forged")
+    for i, hk in enumerate(gen.hotkeys()):
+        data = fetched[hk]
+        if i < 5 and modes[i] in ("garbage", "forged"):
+            # garbage is unsigned (registered key -> rejected);
+            # forged is wrong-key (rejected)
+            assert data is None, (i, modes[i])
+        elif i < 5:
+            # correctly signed numeric poison: authenticity passes, the
+            # value screens must catch it downstream
+            assert data is not None
+        else:
+            # benign signed artifacts fetch and validate
+            assert data is not None
+            assert ser.validated_load(data, tree()) is not None
+
+
+def test_base_accepted_without_configured_signer(inner, tmp_path):
+    """A node with --sign-artifacts but no --base-signer still accepts a
+    validly signed base (no trust anchor to check identity against) but
+    rejects a delta envelope replayed as a base (kind check rides in the
+    envelope)."""
+    avg = Identity.generate()
+    averager_t = SignedTransport(inner, identity=avg, my_hotkey="hotkey_99")
+    miner_t = SignedTransport(inner)  # no base_signer, no resolver
+
+    averager_t.publish_base(tree())
+    fetched = miner_t.fetch_base(tree())
+    assert fetched is not None
+
+    # a signed DELTA replayed into the base slot is rejected by kind
+    replay = signing.wrap(ser.to_msgpack(tree()), avg,
+                          signing.delta_context("hotkey_99"))
+    inner.publish_base_raw(replay)
+    assert miner_t.fetch_base(tree()) is None
+
+    # strict mode refuses unsigned bases even without a signer identity
+    inner.publish_base_raw(ser.to_msgpack(tree()))
+    assert miner_t.fetch_base(tree()) is not None   # lenient: accepted
+    strict_t = SignedTransport(inner, strict=True)
+    assert strict_t.fetch_base(tree()) is None
+
+
+def test_rate_limiter_bounded_state():
+    """Distinct-hotkey floods cannot grow limiter bookkeeping without bound;
+    with the limiter disabled no state is kept at all."""
+    from distributedtraining_tpu.chain.base import RateLimiter
+
+    off = RateLimiter(0.0)
+    for i in range(1000):
+        assert off.allow(f"hk{i}")
+    assert not off._last_request
+
+    t = [0.0]
+    on = RateLimiter(5.0, now_fn=lambda: t[0], max_tracked=64)
+    for i in range(1000):
+        t[0] += 10.0
+        assert on.allow(f"hk{i}")
+    assert len(on._last_request) <= 64
+
+
+def test_unsigned_node_reads_signed_fleet(inner, tmp_path):
+    """Mixed fleet, reverse direction: a node NOT running --sign-artifacts
+    must still read a signed fleet's artifacts (it gains no authenticity,
+    same trust as unsigned) instead of silently seeing 'no base' and
+    self-initializing a divergent genesis."""
+    avg, miner = Identity.generate(), Identity.generate()
+    SignedTransport(inner, identity=avg,
+                    my_hotkey="hotkey_99").publish_base(tree())
+    SignedTransport(inner, identity=miner,
+                    my_hotkey="m0").publish_delta("m0", tree())
+
+    # plain transport (no SignedTransport wrapper at all)
+    assert inner.base_revision() is not None
+    fetched = inner.fetch_base(tree())
+    assert fetched is not None, "unsigned node must read the signed base"
+    np.testing.assert_array_equal(fetched[0]["w"], tree()["w"])
+    got = inner.fetch_delta("m0", tree())
+    assert got is not None, "unsigned node must read signed deltas"
+    # raw-bytes path stays enveloped (SignedTransport verifies from it)
+    assert signing.is_enveloped(inner.fetch_delta_bytes("m0"))
+
+
+def test_replayed_stale_base_rejected(tmp_path):
+    """An attacker with write access replaying an OLD validly-signed base
+    (fleet rollback) is rejected: the signed context carries a monotonic
+    sequence and verifiers keep a high-water mark."""
+    inner = InMemoryTransport()
+    store = LocalAddressStore(str(tmp_path))
+    avg = Identity.generate()
+    store.store_pubkey("hotkey_99", avg.public_bytes)
+
+    t = [1000.0]
+    averager_t = SignedTransport(inner, identity=avg,
+                                 pubkey_resolver=store.retrieve_pubkey,
+                                 my_hotkey="hotkey_99", now_fn=lambda: t[0])
+    miner_t = SignedTransport(inner, pubkey_resolver=store.retrieve_pubkey,
+                              base_signer="hotkey_99")
+
+    averager_t.publish_base(tree())
+    stale_bytes = inner.fetch_base_bytes()      # attacker records round N
+    assert miner_t.fetch_base(tree()) is not None
+
+    t[0] = 2000.0
+    newer = tree()
+    newer["w"] = newer["w"] + 1
+    averager_t.publish_base(newer)
+    fetched = miner_t.fetch_base(tree())
+    assert fetched is not None                  # round N+1 accepted
+
+    inner.publish_base_raw(stale_bytes)         # rollback attempt
+    assert miner_t.fetch_base(tree()) is None   # sequence went backwards
+
+    # but a freshly booted node (no watermark yet) still bootstraps
+    fresh = SignedTransport(inner, pubkey_resolver=store.retrieve_pubkey,
+                            base_signer="hotkey_99")
+    assert fresh.fetch_base(tree()) is not None

@@ -229,3 +229,137 @@ def test_bittensor_chain_weight_pipeline_screens_anomalies():
     assert max(captured["weights"]) == 65535     # u16 normalization
     assert chain._last_weight_block == 1000      # epoch gate advanced
     assert not chain.should_set_weights()
+
+
+# -- BittensorChain against a stub subtensor (no SDK, no network) ------------
+
+def _stub_chain(*, resync_blocks=0, epoch_length=100):
+    """A BittensorChain over fake subtensor/metagraph/wallet objects,
+    bypassing __init__ (the SDK is absent in this image)."""
+    from distributedtraining_tpu.chain.bittensor_chain import BittensorChain
+
+    class FakeSub:
+        def __init__(self):
+            self.block = 1000
+            self.commits = {}
+            self.weight_calls = []
+
+        def set_weights(self, *, wallet, netuid, uids, weights, version_key,
+                        wait_for_inclusion):
+            self.weight_calls.append((uids, weights, version_key))
+            return True
+
+        def commit(self, wallet, netuid, data):
+            self.commits[(netuid, wallet.hotkey.ss58_address)] = data
+
+        def get_commitment(self, netuid, hotkey):
+            return self.commits.get((netuid, hotkey), "")
+
+    class FakeMeta:
+        def __init__(self):
+            self.hotkeys = [f"hk{i}" for i in range(6)]
+            self.S = [10.0, 10.0, 10.0, 10.0, 5000.0, 2000.0]
+            self.sync_calls = 0
+
+        def sync(self, subtensor=None, lite=True):
+            self.sync_calls += 1
+
+    class FakeWallet:
+        class hotkey:
+            ss58_address = "hk4"
+
+    chain = BittensorChain.__new__(BittensorChain)
+    chain.netuid = 7
+    chain.epoch_length = epoch_length
+    chain.resync_blocks = resync_blocks
+    chain.vpermit_stake_limit = 1000.0
+    chain._last_sync_block = -(10**9)
+    chain.wallet = FakeWallet()
+    chain.subtensor = FakeSub()
+    chain.metagraph = FakeMeta()
+    chain._ema = {}
+    chain._last_weight_block = -(10**9)
+    return chain
+
+
+def test_bittensor_chain_sync_and_permits():
+    c = _stub_chain()
+    meta = c.sync()
+    assert meta.hotkeys[4] == c.my_hotkey == "hk4"
+    assert meta.block == 1000
+    assert meta.stakes[4] == 5000.0
+    # vpermit: uids with stake >= limit (btt_connector.py:358-380)
+    assert c.get_validator_uids() == [4, 5]
+    assert c.get_validator_uids(stake_limit=3000.0) == [4]
+
+
+def test_bittensor_chain_resync_throttle():
+    """Within resync_blocks of the last sync the cached metagraph is served
+    without an RPC (reference resync cadence, btt_connector.py:270-282)."""
+    c = _stub_chain(resync_blocks=50)
+    c.sync()
+    assert c.metagraph.sync_calls == 1
+    c.subtensor.block = 1040            # +40 blocks: inside the window
+    c.sync()
+    assert c.metagraph.sync_calls == 1  # cached
+    c.subtensor.block = 1060            # +60: window expired
+    c.sync()
+    assert c.metagraph.sync_calls == 2
+
+    always = _stub_chain(resync_blocks=0)
+    always.sync(); always.sync()
+    assert always.metagraph.sync_calls == 2
+
+
+def test_bittensor_chain_weight_epoch_gate():
+    c = _stub_chain(epoch_length=100)
+    assert c.should_set_weights()
+    assert c.set_weights({"hk0": 1.0})
+    assert c._last_weight_block == 1000
+    assert not c.should_set_weights()        # same block: gated
+    c.subtensor.block = 1099
+    assert not c.should_set_weights()
+    c.subtensor.block = 1100
+    assert c.should_set_weights()            # epoch boundary
+
+
+def test_bittensor_chain_set_weights_emits_u16():
+    c = _stub_chain()
+    assert c.set_weights({"hk0": 2.0, "hk1": 1.0})
+    uids, weights, version = c.subtensor.weight_calls[-1]
+    assert uids == [0, 1]
+    assert max(weights) == 65535             # u16 quantization
+    assert weights[0] > weights[1]
+    from distributedtraining_tpu import spec_version
+    assert version == spec_version()
+
+
+def test_bittensor_address_store_roundtrip():
+    from distributedtraining_tpu.chain.bittensor_chain import (
+        BittensorAddressStore)
+    c = _stub_chain()
+    store = BittensorAddressStore(c.subtensor, 7, wallet=c.wallet)
+    assert store.retrieve_repo("hk4") is None       # empty commitment -> None
+    store.store_repo("hk4", "org/repo")
+    assert store.retrieve_repo("hk4") == "org/repo"
+    # pubkey registry is chain-identity's job on bittensor: no-op surface
+    store.store_pubkey("hk4", b"\x00" * 32)
+    assert store.retrieve_pubkey("hk4") is None
+
+
+def test_bittensor_chain_hung_rpc_times_out():
+    """A wedged substrate connection surfaces as ChainTimeout from sync()
+    instead of hanging the engine loop (utils/timeout.py deadline)."""
+    import time as _time
+
+    from distributedtraining_tpu.chain import bittensor_chain as bc
+
+    c = _stub_chain()
+    c.metagraph.sync = lambda **kw: _time.sleep(10)
+    old = bc.CHAIN_OP_TIMEOUT
+    bc.CHAIN_OP_TIMEOUT = 0.2
+    try:
+        with pytest.raises(ChainTimeout):
+            c.sync()
+    finally:
+        bc.CHAIN_OP_TIMEOUT = old

@@ -1,0 +1,84 @@
+"""Worker: shape-validate the llama2-7b preset on a v4-32-shaped virtual
+mesh (32 CPU devices, dp=2 x fsdp=8 x tp=2). Run via subprocess by
+tests/test_models.py — not a pytest file itself.
+
+Everything is shape-level (jax.eval_shape): no 7B weights are materialized.
+Catches exactly the class of first-contact failures a preset that has only
+ever run at tiny scale hides — non-divisible sharded axes (GQA kv heads vs
+tp), logical-rule gaps, LoRA target selection at full width, optimizer-state
+sharding resolution. Prints "OK <n_params>" on success.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from distributedtraining_tpu.engine import LoRAEngine, TrainEngine
+    from distributedtraining_tpu.models import llama
+    from distributedtraining_tpu.models.lora import LoRAConfig
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+    from distributedtraining_tpu.parallel.sharding import mesh_shardings
+
+    assert len(jax.devices()) == 32, jax.devices()
+    model, cfg = llama.make_model("llama2-7b")
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=8, tp=2))
+    seq = 4096
+
+    # 1. every param leaf gets a sharding whose sharded axes divide evenly
+    #    (shard_shape raises otherwise — e.g. GQA kv heads not divisible
+    #    by tp)
+    shardings = mesh_shardings(model, mesh, seq_len=seq)
+    abstract = jax.eval_shape(
+        lambda: model.init_params(jax.random.PRNGKey(0)))
+    leaves = jax.tree_util.tree_leaves(abstract)
+    slvs = jax.tree_util.tree_leaves(shardings)
+    assert len(leaves) == len(slvs)
+    n_params = 0
+    n_sharded = 0
+    for leaf, s in zip(leaves, slvs):
+        s.shard_shape(leaf.shape)  # raises on non-divisible
+        n_params += int(np.prod(leaf.shape))
+        if any(ax is not None for ax in s.spec):
+            n_sharded += 1
+    assert 6.5e9 < n_params < 7.5e9, n_params
+    assert n_sharded > len(leaves) * 0.8, (n_sharded, len(leaves))
+
+    # 2. full-param engine: state skeleton + one traced train step
+    engine = TrainEngine(model, mesh=mesh, seq_len=seq)
+    state_abs = engine.abstract_state()
+    batch_abs = {"input_ids": jax.ShapeDtypeStruct((4, seq), np.int32)}
+    out_state, metrics = jax.eval_shape(engine.train_step, state_abs,
+                                        batch_abs)
+    assert metrics["loss"].shape == ()
+
+    # 3. LoRA engine (config 4): sharded frozen base, replicated adapters,
+    #    adapter-only step traces end to end
+    lcfg = LoRAConfig(rank=8)
+    leng = LoRAEngine(model, lcfg, mesh=mesh, seq_len=seq)
+    lstate_abs = leng.abstract_state()
+    base_abs = leng.abstract_params()
+    n_adapter = sum(
+        int(np.prod(l.shape))
+        for l in jax.tree_util.tree_leaves(lstate_abs.params))
+    assert n_adapter < n_params / 100, (n_adapter, n_params)
+    lout, lmetrics = jax.eval_shape(leng.train_step, lstate_abs, base_abs,
+                                    batch_abs)
+    assert lmetrics["loss"].shape == ()
+
+    print(f"OK {n_params}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

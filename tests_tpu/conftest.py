@@ -1,0 +1,31 @@
+"""On-device test lane (run on real TPU hardware; see scripts/run_tpu_tests.sh).
+
+Unlike tests/conftest.py this does NOT force the CPU platform — the whole
+point of this lane is to exercise the Pallas kernels on the hardware that
+runs them in production (VERDICT r01: flash-attention numerics were never
+verified on the device that runs them). Collection skips everything with a
+clear message when no TPU is visible, so accidentally running this lane on
+a CPU box is loud, not silently green.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() not in ("tpu", "axon"):
+        skip = pytest.mark.skip(
+            reason=f"tests_tpu/ needs TPU hardware; backend is "
+                   f"{jax.default_backend()!r}")
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.fixture(scope="session")
+def tpu_device():
+    return jax.devices()[0]

@@ -1,0 +1,99 @@
+"""Flash-attention numerics on the real chip: forward AND grad parity vs the
+dense oracle at T in {256, 1024}, packed segments included.
+
+This is the on-device half of tests/test_flash_attention.py (whose kernel
+parity cases skip under the CPU-forcing conftest). The +14%/+16% train-path
+claims (models/gpt2.py) and the custom _block_sizes schedule
+(ops/flash_attention.py) rest on these numerics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.ops.attention import causal_attention
+from distributedtraining_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(B=2, T=512, H=4, D=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.bfloat16)
+                 for _ in range(3))
+
+
+def _segments(B, T, seed=1):
+    """Block-constant packing ids, 128-aligned like data/packing.py output."""
+    rng = np.random.default_rng(seed)
+    seg = np.repeat(rng.integers(0, 3, (B, T // 128)), 128, axis=1)
+    return jnp.asarray(np.sort(seg, axis=1), jnp.int32)  # monotone per row
+
+
+@pytest.mark.parametrize("T", [256, 1024])
+def test_forward_matches_dense(T):
+    q, k, v = _qkv(T=T)
+    out = flash_attention(q, k, v)
+    assert out is not None, "kernel declined on TPU at a supported shape"
+    ref = causal_attention(q, k, v, impl="dense")
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+
+@pytest.mark.parametrize("T", [256, 1024])
+def test_forward_matches_dense_packed(T):
+    q, k, v = _qkv(T=T)
+    seg = _segments(*q.shape[:2])
+    out = flash_attention(q, k, v, segment_ids=seg)
+    assert out is not None
+    ref = causal_attention(q, k, v, segment_ids=seg, impl="dense")
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
+
+
+@pytest.mark.parametrize("T", [256, 1024])
+@pytest.mark.parametrize("packed", [False, True])
+def test_grads_match_dense(T, packed):
+    q, k, v = _qkv(T=T)
+    seg = _segments(*q.shape[:2]) if packed else None
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, segment_ids=seg)
+                       .astype(jnp.float32) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(causal_attention(q, k, v, segment_ids=seg,
+                                        impl="dense")
+                       .astype(jnp.float32) ** 2)
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=1e-1, err_msg=f"d{name} mismatch (T={T}, packed={packed})")
+
+
+def test_train_step_flash_vs_dense_loss():
+    """One GPT-2 train step each way: the flash path's loss must track the
+    dense path's (same init, same batch) — catches wiring bugs where the
+    kernel silently drops masks."""
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.models import gpt2
+
+    losses = {}
+    for impl in ("flash", "dense"):
+        # head_dim 64 + T 256: shapes the kernel accepts (a tinier config
+        # would silently decline to dense and compare dense vs dense)
+        cfg = gpt2.GPT2Config(vocab_size=512, n_positions=256, n_embd=256,
+                              n_layer=2, n_head=4, vocab_multiple=128,
+                              attention_impl=impl)
+        model, cfg = gpt2.make_model(cfg)
+        engine = TrainEngine(model, seq_len=256)
+        state = engine.init_state(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, 256)), jnp.int32)}
+        _, m = engine.train_step(state, batch)
+        losses[impl] = float(m["loss"])
+    assert np.isfinite(losses["flash"])
+    np.testing.assert_allclose(losses["flash"], losses["dense"], rtol=2e-2)
