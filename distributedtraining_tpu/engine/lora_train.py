@@ -147,9 +147,13 @@ class LoRAMinerLoop(MinerLoop):
             self._rng = rng
         if self._restore_checkpoint(self._rng):
             return
-        fetched = self.transport.fetch_base(
-            host_zeros_template(self.engine)) \
-            if self.transport.base_revision() is not None else None
+        if self._multi():
+            fetched = self._fetch_base_broadcast()
+        elif self.transport.base_revision() is not None:
+            fetched = self.transport.fetch_base(
+                host_zeros_template(self.engine))
+        else:
+            fetched = None
         if fetched is not None:
             base, rev = fetched
             self._base_revision = rev
@@ -250,24 +254,49 @@ def fetch_delta_any(transport, hotkey: str, base,
 
     fetch_bytes = getattr(transport, "fetch_delta_bytes", None)
     if fetch_bytes is not None:
-        from .. import serialization as ser
         data = fetch_bytes(hotkey)
         if data is None:
             return None
-        try:
-            return ser.validated_load(data, base)
-        except ser.PayloadError:
-            pass
-        try:
-            adapters = ser.validated_load(data, template())
-        except ser.PayloadError:
-            return None
-        return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)
+        return densify_delta_bytes(data, base, lora_cfg,
+                                   lora_template=template())
 
     d = transport.fetch_delta(hotkey, base)
     if d is not None:
         return d
     adapters = transport.fetch_delta(hotkey, template())
     if adapters is None:
+        return None
+    return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)
+
+
+def densify_delta_bytes(data: bytes, base,
+                        lora_cfg: Optional[lora_lib.LoRAConfig] = None,
+                        *, lora_template=None):
+    """Validated artifact bytes -> dense delta (or None): the byte half of
+    ``fetch_delta_any``, split out so a pod validator can broadcast the RAW
+    bytes once (20 MB of adapters, not a densified full-model tree) and
+    densify identically on every process."""
+    from .. import serialization as ser
+    from .. import signing
+
+    # SignedTransport verifies AND strips before bytes get here (strip is
+    # then a no-op); bytes from a plain transport may still be enveloped —
+    # strip unverified so an unsigned validator on a signed fleet scores
+    # the payload instead of reading every submission as malformed
+    try:
+        data = signing.strip_envelope(data)
+    except ser.PayloadError:
+        return None
+    try:
+        return ser.validated_load(data, base)
+    except ser.PayloadError:
+        pass
+    if lora_cfg is None:
+        return None
+    if lora_template is None:
+        lora_template = adapter_template(base, lora_cfg)
+    try:
+        adapters = ser.validated_load(data, lora_template)
+    except ser.PayloadError:
         return None
     return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)

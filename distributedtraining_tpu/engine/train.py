@@ -298,6 +298,29 @@ def broadcast_optional_tree(host_template: Params, coordinator_fetch
     return mhu.broadcast_one_to_all(t)
 
 
+def broadcast_optional_bytes(data: bytes | None) -> bytes | None:
+    """Bytes flavor of broadcast_optional_tree: ``data`` from the
+    coordinator (None elsewhere, and None = nothing to send) becomes the
+    identical bytes (or identical None) on every process. Same lockstep
+    rule: one length/sentinel broadcast, then at most one payload
+    broadcast — never re-roll this sequence inline."""
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    from ..parallel import multihost
+
+    if not multihost.is_coordinator():
+        data = None
+    n = int(mhu.broadcast_one_to_all(
+        np.asarray(-1 if data is None else len(data), np.int64)))
+    if n < 0:
+        return None
+    buf = np.zeros((n,), np.uint8)
+    if data is not None:
+        buf[:] = np.frombuffer(data, np.uint8)
+    return np.asarray(mhu.broadcast_one_to_all(buf)).tobytes()
+
+
 def broadcast_base_fetch(transport, host_template: Params,
                          current_revision) -> tuple[Params, str | None] | None:
     """Multi-host base pull: only the coordinator reads the transport
@@ -451,8 +474,16 @@ class MinerLoop:
         training_manager.py:371-377)."""
         if self._restore_checkpoint(rng):
             return
-        fetched = self.transport.fetch_base(host_zeros_template(self.engine)) \
-            if self.transport.base_revision() is not None else None
+        if self._multi():
+            # pod boot: the same coordinator-read + broadcast as _check_pull
+            # — per-process reads could see different mid-publish bases (or
+            # none at all off the coordinator host) and silently train the
+            # pod on divergent params
+            fetched = self._fetch_base_broadcast()
+        elif self.transport.base_revision() is not None:
+            fetched = self.transport.fetch_base(host_zeros_template(self.engine))
+        else:
+            fetched = None
         if fetched is not None:
             base, rev = fetched
             self._base_revision = rev

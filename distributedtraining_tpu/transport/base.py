@@ -31,9 +31,23 @@ class Transport(Protocol):
         """Upload this miner's current weight delta (overwrites previous)."""
         ...
 
+    def publish_raw(self, miner_id: str, data: bytes) -> Revision:
+        """Pre-serialized (possibly signature-enveloped, possibly hostile)
+        delta bytes — SignedTransport publishes through this, and the load
+        generator uses it to simulate miners that don't run our code."""
+        ...
+
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
-        """Download + validate a miner's delta; None if absent or invalid."""
+        """Download + validate a miner's delta; None if absent or invalid.
+        Must tolerate (strip, unverified) signature envelopes."""
+        ...
+
+    def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
+        """Raw size-capped artifact bytes, one network read — for
+        multi-template validation (full-param vs LoRA wire forms) and for
+        SignedTransport's signature verification. Envelopes are returned
+        INTACT here."""
         ...
 
     def delta_revision(self, miner_id: str) -> Revision:
@@ -43,7 +57,15 @@ class Transport(Protocol):
     def publish_base(self, base: Params) -> Revision:
         ...
 
+    def publish_base_raw(self, data: bytes) -> Revision:
+        """Byte-level twin of publish_base (signature envelopes)."""
+        ...
+
     def fetch_base(self, template: Params) -> tuple[Params, Revision] | None:
+        ...
+
+    def fetch_base_bytes(self) -> bytes | None:
+        """Raw base bytes, envelope intact (SignedTransport verification)."""
         ...
 
     def base_revision(self) -> Revision:

@@ -171,6 +171,16 @@ class SignedTransport:
     def base_revision(self) -> Revision:
         return self.inner.base_revision()
 
+    def publish_base_raw(self, data: bytes) -> Revision:
+        """Pass-through (pre-built bytes are the caller's responsibility to
+        envelope — the hostile/simulation path, like publish_raw)."""
+        return self.inner.publish_base_raw(data)
+
+    def fetch_base_bytes(self) -> bytes | None:
+        """Raw base bytes, envelope intact — a second verifying layer or a
+        byte-level broadcast reads through this untouched."""
+        return self.inner.fetch_base_bytes()
+
     # -- lifecycle ----------------------------------------------------------
     def gc(self) -> None:
         self.inner.gc()

@@ -41,8 +41,21 @@ def main(argv=None) -> int:
                           metrics=c.metrics, lora_cfg=c.lora_cfg)
     # the reference gates weight-setting to staked validators
     # (btt_connector.py:358-385); refuse up front instead of silently
-    # burning eval compute on scores no one will ever see
-    if not validator.has_vpermit():
+    # burning eval compute on scores no one will ever see. On a pod the
+    # COORDINATOR's verdict is broadcast: per-process chain syncs could
+    # disagree at a stake boundary, and one process exiting while the rest
+    # proceed would strand them at their first collective.
+    import jax
+    permitted = validator.has_vpermit() if jax.process_count() <= 1 else None
+    if permitted is None:
+        import numpy as np
+        from jax.experimental import multihost_utils as mhu
+
+        from distributedtraining_tpu.parallel import multihost
+        local = validator.has_vpermit() if multihost.is_coordinator() else False
+        permitted = bool(mhu.broadcast_one_to_all(
+            np.asarray(local, np.int32)))
+    if not permitted:
         if not cfg.allow_no_vpermit:
             raise SystemExit(
                 f"hotkey {c.chain.my_hotkey} holds no validator permit "

@@ -280,3 +280,20 @@ def test_replayed_stale_base_rejected(tmp_path):
     fresh = SignedTransport(inner, pubkey_resolver=store.retrieve_pubkey,
                             base_signer="hotkey_99")
     assert fresh.fetch_base(tree()) is not None
+
+
+def test_unsigned_validator_scores_signed_fleet(tmp_path):
+    """fetch_delta_any's raw-bytes fast path (what the Validator actually
+    uses) must strip unverified envelopes too — otherwise an unsigned
+    validator on a signed fleet silently scores every miner 0."""
+    from distributedtraining_tpu.engine.lora_train import fetch_delta_any
+    from distributedtraining_tpu.models.lora import LoRAConfig
+
+    inner = InMemoryTransport()
+    miner = Identity.generate()
+    SignedTransport(inner, identity=miner,
+                    my_hotkey="m0").publish_delta("m0", tree())
+
+    got = fetch_delta_any(inner, "m0", tree(), LoRAConfig(rank=2))
+    assert got is not None
+    np.testing.assert_array_equal(got["w"], tree()["w"])
