@@ -324,41 +324,74 @@ class AveragerLoop:
         self.report = AveragerReport()
         self.base_params: Params | None = None
         self._base_revision = None
+        self._host_template_cache = None
+
+    # -- multi-host (the averager can span a pod too) -----------------------
+    def _multi(self) -> bool:
+        from .train import mesh_spans
+        return mesh_spans(self.engine)
+
+    def _host_template(self):
+        if self._host_template_cache is None:
+            from .train import host_zeros_template
+            self._host_template_cache = host_zeros_template(self.engine)
+        return self._host_template_cache
 
     def bootstrap(self, rng=None, params=None) -> None:
         """``params`` (value or zero-arg callable, e.g. a pretrained loader)
         seeds the genesis base; an already-published base always wins."""
-        given = None if callable(params) else params
-        template = given if given is not None else \
-            self.engine.model.init_params(rng if rng is not None else jax.random.PRNGKey(0))
-        fetched = self.transport.fetch_base(template) \
-            if self.transport.base_revision() is not None else None
+        if self._multi():
+            # coordinator-read + broadcast, like every pod transport read
+            from .train import broadcast_base_fetch
+            fetched = broadcast_base_fetch(self.transport,
+                                           self._host_template(), None)
+        elif self.transport.base_revision() is not None:
+            fetched = self.transport.fetch_base(self._host_template())
+        else:
+            fetched = None
         if fetched is not None:
             self.base_params, self._base_revision = fetched
         else:
+            given = None if callable(params) else params
             if given is None and callable(params):
-                loaded = params()
-                template = loaded if loaded is not None else template
+                given = params()
+            # genesis: identical on every process (deterministic from the
+            # same rng / the same loaded weights)
+            template = given if given is not None else \
+                self.engine.model.init_params(
+                    rng if rng is not None else jax.random.PRNGKey(0))
             self.base_params = template
-            # genesis: the averager owns the shared repo and publishes the
-            # first base (averaging_logic.py:549-568)
+            # the averager owns the shared repo and publishes the first base
+            # (averaging_logic.py:549-568); coordinator-gated on a pod
             self._base_revision = self.transport.publish_base(template)
         self.base_params = self.engine.place_params(self.base_params)
 
+    def _fetch_delta(self, hotkey: str):
+        from .lora_train import (adapter_template, fetch_delta_any,
+                                 fetch_delta_any_broadcast)
+        if self.lora_cfg is not None and self._lora_template is None:
+            self._lora_template = adapter_template(self.base_params,
+                                                   self.lora_cfg)
+        if self._multi():
+            return fetch_delta_any_broadcast(
+                self.transport, hotkey, self._host_template(), self.lora_cfg,
+                lora_template=self._lora_template)
+        return fetch_delta_any(self.transport, hotkey, self.base_params,
+                               self.lora_cfg,
+                               lora_template=self._lora_template)
+
     def gather_deltas(self) -> tuple[list[str], list[Params]]:
-        meta = self.chain.sync()
+        if self._multi():
+            from .train import broadcast_metagraph
+            meta = broadcast_metagraph(self.chain)
+        else:
+            meta = self.chain.sync()
         ids, deltas = [], []
         rejected = 0
         for hotkey in meta.hotkeys:
             if hotkey == getattr(self.chain, "my_hotkey", None):
                 continue
-            from .lora_train import adapter_template, fetch_delta_any
-            if self.lora_cfg is not None and self._lora_template is None:
-                self._lora_template = adapter_template(self.base_params,
-                                                       self.lora_cfg)
-            d = fetch_delta_any(self.transport, hotkey, self.base_params,
-                                self.lora_cfg,
-                                lora_template=self._lora_template)
+            d = self._fetch_delta(hotkey)
             if d is None:
                 continue
             ok, reason = delta_lib.screen_delta(d, self.base_params,
@@ -390,7 +423,15 @@ class AveragerLoop:
                                            axis=merge_axis(self.engine.mesh))
         else:
             stacked = delta_lib.stack_deltas(deltas)
-        consensus = getattr(self.chain, "consensus_scores", lambda: {})()
+        if self._multi():
+            # small chain read, same lockstep rule as everything else
+            from .train import broadcast_json
+            from ..parallel import multihost
+            consensus = broadcast_json(
+                getattr(self.chain, "consensus_scores", lambda: {})()
+                if multihost.is_coordinator() else None) or {}
+        else:
+            consensus = getattr(self.chain, "consensus_scores", lambda: {})()
         merged, weights = self.strategy.merge(
             self.engine, self.base_params, stacked, ids,
             val_batches=self.val_batches, consensus=consensus)

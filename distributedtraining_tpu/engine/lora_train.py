@@ -257,8 +257,10 @@ def fetch_delta_any(transport, hotkey: str, base,
         data = fetch_bytes(hotkey)
         if data is None:
             return None
+        # lora_template passes through as-is: densify builds it lazily, so
+        # a full-param submission never pays the adapter-template alloc
         return densify_delta_bytes(data, base, lora_cfg,
-                                   lora_template=template())
+                                   lora_template=lora_template)
 
     d = transport.fetch_delta(hotkey, base)
     if d is not None:
@@ -267,6 +269,31 @@ def fetch_delta_any(transport, hotkey: str, base,
     if adapters is None:
         return None
     return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)
+
+
+def fetch_delta_any_broadcast(transport, hotkey: str, base_template,
+                              lora_cfg: Optional[lora_lib.LoRAConfig] = None,
+                              *, lora_template=None):
+    """Pod variant of ``fetch_delta_any``: the coordinator reads the RAW
+    artifact bytes, every process receives the identical broadcast and
+    densifies locally (a LoRA submission stays ~MB on the interconnect).
+    ``base_template`` must be a host tree (shapes only are used)."""
+    from ..parallel import multihost
+    from .train import broadcast_optional_bytes, broadcast_optional_tree
+
+    fetch_bytes = getattr(transport, "fetch_delta_bytes", None)
+    if fetch_bytes is None:
+        # no raw path: broadcast the densified tree (full-model-sized)
+        return broadcast_optional_tree(
+            base_template,
+            lambda: fetch_delta_any(transport, hotkey, base_template,
+                                    lora_cfg, lora_template=lora_template))
+    data = broadcast_optional_bytes(
+        fetch_bytes(hotkey) if multihost.is_coordinator() else None)
+    if data is None:
+        return None
+    return densify_delta_bytes(data, base_template, lora_cfg,
+                               lora_template=lora_template)
 
 
 def densify_delta_bytes(data: bytes, base,

@@ -321,6 +321,43 @@ def broadcast_optional_bytes(data: bytes | None) -> bytes | None:
     return np.asarray(mhu.broadcast_one_to_all(buf)).tobytes()
 
 
+def mesh_spans(engine) -> bool:
+    """True when the engine's mesh includes other processes' devices — the
+    switch every role uses to route transport/chain reads through the
+    coordinator-broadcast paths. One implementation; roles must not re-roll
+    this check."""
+    fn = getattr(engine, "_mesh_spans_processes", None)
+    return bool(fn()) if fn is not None else False
+
+
+def broadcast_metagraph(chain):
+    """Round-start metagraph on a pod: the coordinator's snapshot, identical
+    on every process. The hotkey list orders per-miner loops whose bodies
+    contain collectives — processes syncing at different blocks could
+    iterate different sets and desynchronize the pod."""
+    from ..chain.base import Metagraph
+    from ..parallel import multihost
+
+    m = chain.sync() if multihost.is_coordinator() else None
+    d = broadcast_json(None if m is None else
+                       {"hotkeys": list(m.hotkeys), "uids": list(m.uids),
+                        "stakes": list(m.stakes), "block": m.block})
+    assert d is not None, "coordinator metagraph sync cannot be empty"
+    return Metagraph(**d)
+
+
+def broadcast_json(obj):
+    """Coordinator's JSON-able value -> identical value on every process
+    (consensus scores and other small chain reads)."""
+    import json
+
+    from ..parallel import multihost
+
+    data = json.dumps(obj).encode() if multihost.is_coordinator() else None
+    data = broadcast_optional_bytes(data)
+    return None if data is None else json.loads(data)
+
+
 def broadcast_base_fetch(transport, host_template: Params,
                          current_revision) -> tuple[Params, str | None] | None:
     """Multi-host base pull: only the coordinator reads the transport
@@ -442,8 +479,7 @@ class MinerLoop:
 
     # -- multi-host coordination --------------------------------------------
     def _multi(self) -> bool:
-        fn = getattr(self.engine, "_mesh_spans_processes", None)
-        return bool(fn()) if fn is not None else False
+        return mesh_spans(self.engine)
 
     def _synced_decision(self, fire: bool) -> bool:
         """Coordinator's verdict, identical on every process (collective)."""

@@ -87,8 +87,8 @@ class Validator:
 
     # -- multi-host (config 5: the validator can span a pod too) ------------
     def _multi(self) -> bool:
-        fn = getattr(self.engine, "_mesh_spans_processes", None)
-        return bool(fn()) if fn is not None else False
+        from .train import mesh_spans
+        return mesh_spans(self.engine)
 
     _host_template_cache = None
 
@@ -167,34 +167,14 @@ class Validator:
         result is broadcast so every process scores the IDENTICAL delta —
         a mid-publish read skew would otherwise turn one SPMD eval into
         divergent programs emitting silently wrong scores."""
-        from .lora_train import densify_delta_bytes, fetch_delta_any
+        from .lora_train import fetch_delta_any, fetch_delta_any_broadcast
         if not self._multi():
             return fetch_delta_any(self.transport, hotkey, self.base_params,
                                    self.lora_cfg,
                                    lora_template=self._adapter_template())
-        from ..parallel import multihost
-        from .train import broadcast_optional_bytes, broadcast_optional_tree
-
-        template = self._host_template()
-        fetch_bytes = getattr(self.transport, "fetch_delta_bytes", None)
-        if fetch_bytes is None:
-            # transport without a raw path: fall back to broadcasting the
-            # densified tree (full-model-sized — the bytes path below is
-            # why transports should implement fetch_delta_bytes)
-            return broadcast_optional_tree(
-                template,
-                lambda: fetch_delta_any(self.transport, hotkey, template,
-                                        self.lora_cfg,
-                                        lora_template=self._adapter_template()))
-        # broadcast the RAW artifact bytes (a LoRA submission stays ~MB on
-        # the interconnect instead of a densified full-model tree), then
-        # every process validates/densifies the identical bytes
-        data = broadcast_optional_bytes(
-            fetch_bytes(hotkey) if multihost.is_coordinator() else None)
-        if data is None:
-            return None
-        return densify_delta_bytes(data, template, self.lora_cfg,
-                                   lora_template=self._adapter_template())
+        return fetch_delta_any_broadcast(
+            self.transport, hotkey, self._host_template(), self.lora_cfg,
+            lora_template=self._adapter_template())
 
     def score_miner(self, hotkey: str) -> MinerScore:
         d = self._fetch_delta(hotkey)
@@ -213,28 +193,12 @@ class Validator:
         return MinerScore(hotkey, score, loss=loss, perplexity=ppl)
 
     def _synced_metagraph(self):
-        """Round-start metagraph. On a pod the coordinator's snapshot is
-        broadcast: the hotkey list orders the per-miner scoring loop, whose
-        evals are collectives — processes syncing at different blocks could
-        iterate different sets and desynchronize the pod."""
+        """Round-start metagraph: coordinator's snapshot broadcast on a pod
+        (train.broadcast_metagraph), plain sync otherwise."""
         if not self._multi():
             return self.chain.sync()
-        import json
-
-        from ..chain.base import Metagraph
-        from ..parallel import multihost
-        from .train import broadcast_optional_bytes
-
-        data = None
-        if multihost.is_coordinator():
-            m = self.chain.sync()
-            data = json.dumps({"hotkeys": list(m.hotkeys),
-                               "uids": list(m.uids),
-                               "stakes": list(m.stakes),
-                               "block": m.block}).encode()
-        data = broadcast_optional_bytes(data)
-        assert data is not None, "coordinator metagraph sync cannot be empty"
-        return Metagraph(**json.loads(data))
+        from .train import broadcast_metagraph
+        return broadcast_metagraph(self.chain)
 
     def validate_and_score(self) -> list[MinerScore]:
         """One validation round (validate_and_score,

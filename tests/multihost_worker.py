@@ -116,6 +116,31 @@ def main() -> int:
     missing = v.score_miner("m_absent")
     assert missing.reason == "no_delta", (pid, missing)
 
+    # -- averager on the pod: gather (coordinator reads, bytes broadcast),
+    # -- psum merge over the cross-process mesh, coordinator-gated publish
+    from distributedtraining_tpu.engine import AveragerLoop, WeightedAverage
+
+    class OneMinerChain:
+        my_hotkey = "avg"
+
+        def sync(self):
+            from distributedtraining_tpu.chain.base import Metagraph
+            return Metagraph(hotkeys=["avg", "m1", "m_absent"],
+                             uids=[0, 1, 2], stakes=[10000.0, 10.0, 10.0],
+                             block=1)
+
+        def consensus_scores(self):
+            return {"m1": 1.0}
+
+    gated_t, gated_c = multihost.gate_io(transport, OneMinerChain())
+    avg = AveragerLoop(veng, gated_t, gated_c, WeightedAverage(),
+                       val_batches=lambda: iter([eval_batch]))
+    assert avg.run_round(), f"pid {pid}: averager merged nothing"
+    assert avg.report.last_accepted == 1, (pid, avg.report)
+    ref = np.asarray(mhu.broadcast_one_to_all(
+        np.asarray([avg.report.last_loss], np.float64)))
+    np.testing.assert_allclose([avg.report.last_loss], ref, rtol=1e-6)
+
     print(f"RESULT {pid} {loss:.6f} {int(multihost.is_coordinator())}",
           flush=True)
     return 0
