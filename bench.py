@@ -58,11 +58,12 @@ def _peak_flops() -> float | None:
     return None
 
 
-def _time_train(model, cfg, *, iters: int = ITERS) -> float:
+def _time_train(model, cfg, *, iters: int = ITERS,
+                fused_loss: bool = False) -> float:
     """tokens/sec of the jitted train step (fwd+bwd+adamw) on one chip."""
     from distributedtraining_tpu.engine import TrainEngine
 
-    engine = TrainEngine(model, seq_len=SEQ)
+    engine = TrainEngine(model, seq_len=SEQ, fused_loss=fused_loss)
     state = engine.init_state(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     batch = {
@@ -150,6 +151,15 @@ def main() -> None:
         extras["flash_speedup"] = round(tokens_per_sec / dense_tps, 3)
     except Exception as e:  # a failed sub-bench must not sink the headline
         extras["dense_error"] = repr(e)
+
+    try:
+        # tiled-head CE that never materializes [B, T, V] logits — candidate
+        # default if it beats the standard path on-chip (docs/perf.md)
+        fused_tps = _time_train(model, cfg, fused_loss=True)
+        extras["fused_loss_tokens_per_sec"] = round(fused_tps, 1)
+        extras["fused_loss_speedup"] = round(fused_tps / tokens_per_sec, 3)
+    except Exception as e:
+        extras["fused_loss_error"] = repr(e)
 
     peak = _peak_flops()
     if peak:

@@ -131,6 +131,21 @@ class BittensorChain:
         m = self.metagraph
         return [i for i, s in enumerate(m.S) if float(s) >= limit]
 
+    def serve_axon(self, ip: str, port: int) -> bool:
+        """Advertise a serving endpoint on chain (serve_extrinsic/serve_axon,
+        btt_connector.py:99-260). This framework's artifact plane is HF/
+        LocalFS rather than axon RPC, but participants that also expose an
+        endpoint (e.g. the peer registry) can publish it the reference way."""
+        def op():
+            axon = self.bt.axon(wallet=self.wallet, ip=ip, port=port)
+            return bool(self.subtensor.serve_axon(netuid=self.netuid,
+                                                  axon=axon))
+        try:
+            return bool(run_with_timeout(op, CHAIN_OP_TIMEOUT,
+                                         name="serve_axon"))
+        except ChainTimeout:
+            return False
+
     def set_weights(self, scores: dict[str, float]) -> bool:
         """EMA -> MAD anomaly screen -> normalize -> u16 -> chain extrinsic
         (same pipeline as LocalChain.set_weights; anomalously high scores

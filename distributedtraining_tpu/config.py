@@ -76,6 +76,7 @@ class RunConfig:
     lora_alpha: float = 16.0
     dataset: str = "auto"                    # auto | wikitext | synthetic
     tokenizer: str = "auto"                  # auto | byte | <hf name>
+    fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
 
     # -- mesh ---------------------------------------------------------------
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
@@ -203,6 +204,10 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--dataset", choices=("auto", "wikitext", "synthetic"),
                    default=d.dataset)
     g.add_argument("--tokenizer", default=d.tokenizer)
+    g.add_argument("--fused-loss", dest="fused_loss", action="store_true",
+                   help="compute the LM loss with a tiled head matmul that "
+                        "never materializes the [batch, seq, vocab] logits "
+                        "(HBM saver; GPT-2 models only)")
 
     g = p.add_argument_group("mesh")
     g.add_argument("--dp", type=int, default=d.mesh.dp,

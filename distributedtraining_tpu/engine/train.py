@@ -65,23 +65,53 @@ def _default_lm_loss(model, params, batch):
     return causal_lm_loss(logits, batch["input_ids"], batch.get("loss_mask"))
 
 
+def _fused_lm_loss(model, params, batch):
+    """Same contract as _default_lm_loss but the [B, T, V] logits never
+    materialize: the model returns hidden states and the tied-head matmul
+    runs tile-by-tile inside fused_linear_cross_entropy. Requires a model
+    with a tied ``wte`` head exposing ``return_hidden`` (GPT-2)."""
+    from ..ops.losses import fused_linear_cross_entropy
+
+    hidden = model.apply(
+        {"params": params}, batch["input_ids"],
+        attention_mask=batch.get("attention_mask"),
+        segment_ids=batch.get("segment_ids"),
+        position_ids=batch.get("position_ids"),
+        return_hidden=True)
+    mask = batch.get("loss_mask")
+    return fused_linear_cross_entropy(
+        hidden[:, :-1, :], params["wte"], batch["input_ids"][:, 1:],
+        None if mask is None else mask[:, 1:])
+
+
 class TrainEngine:
     """Owns the jitted step functions for one model + optimizer."""
 
     def __init__(self, model, *, optimizer: optax.GradientTransformation | None = None,
                  mesh=None, seq_len: int = 8,
-                 loss_fn: Callable | None = None):
+                 loss_fn: Callable | None = None,
+                 fused_loss: bool = False):
         """``loss_fn(model, params, batch) -> (mean_loss, count)`` overrides
         the causal-LM default — the toy classification harnesses
         (models/toy.py + ops.losses.classification_loss) plug in here. The
         jit/delta/transport facilities are task-agnostic; the *sharding*
         rules are not (they assume [B, T] token batches and LM parameter
-        axes), so a mesh cannot be combined with a custom loss_fn."""
+        axes), so a mesh cannot be combined with a custom loss_fn.
+
+        ``fused_loss=True`` swaps the built-in LM loss for the
+        tiled-head variant (_fused_lm_loss) that never materializes the
+        [B, T, V] logits — still the same LM task, so meshes remain
+        allowed."""
         if mesh is not None and loss_fn is not None:
             raise ValueError(
                 "mesh sharding assumes causal-LM batches ([B, T] input_ids) "
                 "and LM parameter axis names; run custom-loss models "
                 "unsharded (mesh=None)")
+        if fused_loss:
+            if loss_fn is not None:
+                raise ValueError("fused_loss and a custom loss_fn are "
+                                 "mutually exclusive")
+            loss_fn = _fused_lm_loss
         self.model = model
         self.tx = optimizer or default_optimizer()
         self.mesh = mesh

@@ -143,7 +143,12 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, attention_mask=None, segment_ids=None,
-                 position_ids=None, deterministic: bool = True):
+                 position_ids=None, deterministic: bool = True,
+                 return_hidden: bool = False):
+        """``return_hidden=True`` skips the LM head and returns the final
+        normed hidden states [B, T, E] — the fused cross-entropy path
+        (ops.losses.fused_linear_cross_entropy) computes the head matmul
+        tile-by-tile inside the loss instead of materializing logits."""
         cfg = self.cfg
         B, T = input_ids.shape
 
@@ -179,6 +184,8 @@ class GPT2(nn.Module):
                          bias_init=nn.with_logical_partitioning(
                              nn.initializers.zeros_init(), ("embed",)),
                          name="ln_f")(x)
+        if return_hidden:
+            return x
         # tied lm head: logits accumulate fp32 on the MXU
         logits = jnp.einsum("bte,ve->btv", x, wte.astype(cfg.compute_dtype()),
                             preferred_element_type=jnp.float32)

@@ -53,6 +53,73 @@ def perplexity(mean_loss: jax.Array) -> jax.Array:
     return jnp.exp(mean_loss)
 
 
+def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
+                               labels: jax.Array,
+                               loss_mask: Optional[jax.Array] = None,
+                               *, chunk: int = 4096
+                               ) -> tuple[jax.Array, jax.Array]:
+    """Shifted-label CE of ``logits = hidden @ head_kernel.T`` WITHOUT ever
+    materializing the [N, V] logits tensor.
+
+    The standard path materializes f32 logits (GPT-2-124M at B8/T1024:
+    ~1.6 GB per traversal, several traversals per step — the single largest
+    non-matmul HBM cost, docs/perf.md). Here the vocabulary is scanned in
+    ``chunk``-column tiles with a running (max, sumexp, label-logit) online
+    softmax — the same trick flash attention plays on the sequence axis,
+    applied to the vocab axis — and the backward pass recomputes each tile
+    (jax.checkpoint), trading one extra head-matmul of FLOPs for the logits
+    round-trips.
+
+    hidden: [..., E] activations ALREADY shifted/aligned to ``labels``
+    [...]; head_kernel: [V, E] (the tied wte); loss_mask like labels.
+    Returns (mean_loss, token_count), the causal_lm_loss contract.
+    """
+    E = hidden.shape[-1]
+    V = head_kernel.shape[0]
+    n_chunks = -(-V // chunk)
+    v_pad = n_chunks * chunk
+
+    h = hidden.reshape(-1, E)
+    y = labels.reshape(-1)
+    N = h.shape[0]
+    wt = head_kernel
+    if v_pad > V:
+        wt = jnp.concatenate(
+            [wt, jnp.zeros((v_pad - V, E), wt.dtype)], axis=0)
+    wt = wt.reshape(n_chunks, chunk, E).astype(hidden.dtype)
+
+    neg = jnp.float32(-1e30)  # effectively -inf without nan hazards
+
+    def tile(carry, xs):
+        m, s, ll = carry
+        idx, w_c = xs
+        logits = jnp.einsum("ne,ce->nc", h, w_c,
+                            preferred_element_type=jnp.float32)
+        col = idx * chunk + jnp.arange(chunk)
+        logits = jnp.where(col[None, :] < V, logits, neg)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        ll = ll + jnp.sum(
+            jnp.where(col[None, :] == y[:, None], logits, 0.0), axis=-1)
+        return (m_new, s, ll), None
+
+    init = (jnp.full((N,), neg, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32))
+    (m, s, ll), _ = jax.lax.scan(
+        jax.checkpoint(tile), init, (jnp.arange(n_chunks), wt))
+
+    per_tok = (m + jnp.log(s) - ll).reshape(labels.shape)
+    if loss_mask is not None:
+        msk = loss_mask.astype(per_tok.dtype)
+    else:
+        msk = jnp.ones_like(per_tok)
+    total = jnp.sum(per_tok * msk)
+    count = jnp.maximum(jnp.sum(msk), 1.0)
+    return total / count, count
+
+
 def classification_loss(logits: jax.Array, labels: jax.Array
                         ) -> tuple[jax.Array, jax.Array]:
     """Mean CE for the toy classification harnesses (the reference's MNIST

@@ -131,6 +131,9 @@ class TraceCapture:
                 self._done = True
 
 
+_NET_BASELINE = None  # (bytes_sent, bytes_recv) at this process's first sample
+
+
 def device_metrics() -> dict[str, float]:
     """TPU-side system metrics (replaces torch.cuda.utilization,
     utils/mlflow_utils.py:15-29): per-device HBM in use, via JAX
@@ -149,6 +152,17 @@ def device_metrics() -> dict[str, float]:
         import psutil
         out["cpu_percent"] = psutil.cpu_percent()
         out["rss_mb"] = psutil.Process().memory_info().rss / 1e6
+        # net bytes parity (utils/mlflow_utils.py:15-69): on this framework
+        # the network IS the artifact plane, so transfer volume matters.
+        # psutil's counters are machine-wide since boot; report the delta
+        # from this process's first sample so runs are comparable (still
+        # host-wide — co-located traffic is included, as in the reference)
+        global _NET_BASELINE
+        net = psutil.net_io_counters()
+        if _NET_BASELINE is None:
+            _NET_BASELINE = (net.bytes_sent, net.bytes_recv)
+        out["net_sent_mb"] = (net.bytes_sent - _NET_BASELINE[0]) / 1e6
+        out["net_recv_mb"] = (net.bytes_recv - _NET_BASELINE[1]) / 1e6
     except Exception:
         pass
     return out

@@ -363,3 +363,26 @@ def test_bittensor_chain_hung_rpc_times_out():
             c.sync()
     finally:
         bc.CHAIN_OP_TIMEOUT = old
+
+
+def test_bittensor_chain_serve_axon_stub():
+    """serve_axon passthrough (serve_extrinsic parity) with timeout hygiene."""
+    c = _stub_chain()
+
+    class FakeAxon:
+        def __init__(self, wallet=None, ip=None, port=None):
+            self.ip, self.port = ip, port
+
+    class FakeBT:
+        axon = FakeAxon
+
+    served = {}
+
+    def fake_serve_axon(netuid, axon):
+        served["args"] = (netuid, axon.ip, axon.port)
+        return True
+
+    c.bt = FakeBT()
+    c.subtensor.serve_axon = fake_serve_axon
+    assert c.serve_axon("10.0.0.1", 8091)
+    assert served["args"] == (7, "10.0.0.1", 8091)
