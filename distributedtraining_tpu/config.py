@@ -207,7 +207,7 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--fused-loss", dest="fused_loss", action="store_true",
                    help="compute the LM loss with a tiled head matmul that "
                         "never materializes the [batch, seq, vocab] logits "
-                        "(HBM saver; GPT-2 models only)")
+                        "(HBM saver; GPT-2 and Llama, not LoRA)")
 
     g = p.add_argument_group("mesh")
     g.add_argument("--dp", type=int, default=d.mesh.dp,

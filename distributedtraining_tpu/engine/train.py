@@ -67,9 +67,10 @@ def _default_lm_loss(model, params, batch):
 
 def _fused_lm_loss(model, params, batch):
     """Same contract as _default_lm_loss but the [B, T, V] logits never
-    materialize: the model returns hidden states and the tied-head matmul
-    runs tile-by-tile inside fused_linear_cross_entropy. Requires a model
-    with a tied ``wte`` head exposing ``return_hidden`` (GPT-2)."""
+    materialize: the model returns hidden states and the head matmul runs
+    tile-by-tile inside fused_linear_cross_entropy. Requires a model
+    exposing ``return_hidden`` with a [V, E] head param — ``lm_head``
+    (Llama) or the tied ``wte`` (GPT-2)."""
     from ..ops.losses import fused_linear_cross_entropy
 
     hidden = model.apply(
@@ -78,9 +79,10 @@ def _fused_lm_loss(model, params, batch):
         segment_ids=batch.get("segment_ids"),
         position_ids=batch.get("position_ids"),
         return_hidden=True)
+    head = params["lm_head"] if "lm_head" in params else params["wte"]
     mask = batch.get("loss_mask")
     return fused_linear_cross_entropy(
-        hidden[:, :-1, :], params["wte"], batch["input_ids"][:, 1:],
+        hidden[:, :-1, :], head, batch["input_ids"][:, 1:],
         None if mask is None else mask[:, 1:])
 
 

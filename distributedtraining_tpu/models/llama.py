@@ -139,7 +139,12 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, attention_mask=None, segment_ids=None,
-                 position_ids=None, deterministic: bool = True):
+                 position_ids=None, deterministic: bool = True,
+                 return_hidden: bool = False):
+        """``return_hidden=True`` skips the LM head and returns the final
+        normed hidden states (fused-CE path, ops.losses) — at Llama vocab
+        sizes (32k/128k padded) the [B, T, V] logits this avoids are the
+        single largest activation tensor in the step."""
         cfg = self.cfg
         B, T = input_ids.shape
         wte = self.param(
@@ -158,6 +163,8 @@ class Llama(nn.Module):
             x = block(cfg, name=f"layer_{i}")(x, attention_mask, segment_ids,
                                               position_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
         lm_head = self.param(
             "lm_head",
             nn.with_logical_partitioning(nn.initializers.normal(0.02),

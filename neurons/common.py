@@ -125,8 +125,6 @@ def build(cfg: RunConfig) -> Components:
             mesh = make_mesh(mcfg)
 
     seq = cfg.seq_len if cfg.role == "miner" else cfg.eval_seq_len
-    if cfg.fused_loss and cfg.model in llama.PRESETS:
-        raise SystemExit("--fused-loss requires a tied-wte GPT-2 model")
     if cfg.fused_loss and cfg.lora_rank > 0:
         # the LoRA engine has no fused-head plumbing; silently dropping the
         # flag would surprise exactly the memory-constrained configs that

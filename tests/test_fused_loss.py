@@ -123,3 +123,24 @@ def test_fused_engine_on_mesh():
     with pytest.raises(ValueError):
         TrainEngine(model, seq_len=16, fused_loss=True,
                     loss_fn=lambda *a: None)
+
+
+def test_fused_engine_llama():
+    """The fused path picks up Llama's untied lm_head automatically — at
+    Llama vocab widths the avoided logits tensor is the whole point."""
+    from distributedtraining_tpu.models import llama
+
+    model, cfg = llama.make_model("tiny-llama")
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=16)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)}
+    l0, n0 = _default_lm_loss(model, params, batch)
+    l1, n1 = _fused_lm_loss(model, params, batch)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-4)
+    assert float(n0) == float(n1)
+
+    fus = TrainEngine(model, seq_len=16, fused_loss=True)
+    state = fus.init_state(params=params)
+    state, m = fus.train_step(state, batch)
+    assert np.isfinite(float(m["loss"]))

@@ -54,13 +54,18 @@ def main() -> int:
     assert 6.5e9 < n_params < 7.5e9, n_params
     assert n_sharded > len(leaves) * 0.8, (n_sharded, len(leaves))
 
-    # 2. full-param engine: state skeleton + one traced train step
-    engine = TrainEngine(model, mesh=mesh, seq_len=seq)
-    state_abs = engine.abstract_state()
+    # 2. full-param engine: state skeleton + one traced train step, BOTH
+    #    loss paths (the default [B,T,V]-logits loss and the fused
+    #    no-logits loss config 4/5 would actually run) — eval_shape is
+    #    allocation-free, so validating both costs nothing
     batch_abs = {"input_ids": jax.ShapeDtypeStruct((4, seq), np.int32)}
-    out_state, metrics = jax.eval_shape(engine.train_step, state_abs,
-                                        batch_abs)
-    assert metrics["loss"].shape == ()
+    for fused in (False, True):
+        engine = TrainEngine(model, mesh=mesh, seq_len=seq,
+                             fused_loss=fused)
+        state_abs = engine.abstract_state()
+        out_state, metrics = jax.eval_shape(engine.train_step, state_abs,
+                                            batch_abs)
+        assert metrics["loss"].shape == (), fused
 
     # 3. LoRA engine (config 4): sharded frozen base, replicated adapters,
     #    adapter-only step traces end to end
