@@ -11,6 +11,8 @@
 #         MIN_UPTIME_S (default 300) uptime that resets the crash counter
 #         UPDATE_CHECK_S (default 1800) seconds between version polls
 #         NO_AUTO_UPDATE=1           disable the git version poll
+#         POLL_S / RESTART_DELAY_S (default 5) watchdog + restart cadences
+#         SUPERVISE_CMD              override the launched command (tests)
 set -u
 
 ROLE="${1:?usage: supervise.sh <miner|validator|averager> [args...]}"
@@ -19,6 +21,8 @@ REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
 MAX_RESTARTS="${MAX_RESTARTS:-5}"
 MIN_UPTIME_S="${MIN_UPTIME_S:-300}"
 UPDATE_CHECK_S="${UPDATE_CHECK_S:-1800}"
+POLL_S="${POLL_S:-5}"
+RESTART_DELAY_S="${RESTART_DELAY_S:-5}"
 
 log() { echo "[supervise $(date -u +%FT%TZ)] $*"; }
 
@@ -46,10 +50,22 @@ maybe_update() {
 }
 
 crashes=0
+pid=""
+# supervisor death must take the role down with it: an orphaned child would
+# keep the TPU/hotkey busy and fight the next service start
+trap '[ -n "$pid" ] && kill -TERM "$pid" 2>/dev/null; exit 143' TERM INT
+
 while :; do
   start=$(date +%s)
   log "starting $ROLE (crash count $crashes/$MAX_RESTARTS)"
-  python "$REPO_DIR/neurons/$ROLE.py" "$@" &
+  if [ -n "${SUPERVISE_CMD:-}" ]; then
+    # test hook — loud, so a value leaked into a production environment is
+    # visible in the first log line instead of silently replacing the role
+    log "SUPERVISE_CMD override active: '$SUPERVISE_CMD' (not $ROLE)"
+    $SUPERVISE_CMD "$@" &
+  else
+    python "$REPO_DIR/neurons/$ROLE.py" "$@" &
+  fi
   pid=$!
 
   # Watchdog: check the role every 5s so a crash restarts promptly (not
@@ -79,7 +95,7 @@ while :; do
         break
       fi
     fi
-    sleep 5
+    sleep "$POLL_S"
   done
   uptime=$(( died - start ))
 
@@ -92,6 +108,6 @@ while :; do
     log "$ROLE crashed $crashes times under ${MIN_UPTIME_S}s uptime; giving up"
     exit 1
   fi
-  log "$ROLE exited code=$code uptime=${uptime}s; restarting in 5s"
-  sleep 5
+  log "$ROLE exited code=$code uptime=${uptime}s; restarting in ${RESTART_DELAY_S}s"
+  sleep "$RESTART_DELAY_S"
 done

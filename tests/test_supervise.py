@@ -1,0 +1,103 @@
+"""supervise.sh bounded-restart semantics (pm2 parity, run_miner.sh:215-224).
+
+Exercises the real bash supervisor with second-scale cadences and a
+SUPERVISE_CMD stand-in for the role process — the crash-loop give-up path
+and the min-uptime crash-counter reset are exactly the semantics a round-1
+advisor finding showed can silently break.
+"""
+
+import os
+import subprocess
+import time
+
+SCRIPT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "supervise.sh")
+
+
+def _env(**kw):
+    env = dict(os.environ, NO_AUTO_UPDATE="1", POLL_S="1",
+               RESTART_DELAY_S="0", UPDATE_CHECK_S="9999")
+    env.update({k: str(v) for k, v in kw.items()})
+    return env
+
+
+def test_crash_loop_gives_up_after_max_restarts():
+    """A role dying instantly (< MIN_UPTIME) trips the bounded-restart
+    counter: MAX_RESTARTS=2 means 3 fast crashes, then exit 1."""
+    proc = subprocess.run(
+        ["bash", SCRIPT, "miner"],
+        env=_env(SUPERVISE_CMD="false", MAX_RESTARTS="2", MIN_UPTIME_S="300"),
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert proc.stdout.count("starting miner") == 3
+    assert "giving up" in proc.stdout
+
+
+def test_long_uptime_resets_crash_counter():
+    """pm2 min_uptime semantics: a child that outlives MIN_UPTIME_S resets
+    the counter, so occasional slow crashes never accumulate into a
+    give-up."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r") as logf:
+        proc = subprocess.Popen(
+            ["bash", SCRIPT, "miner"],
+            env=_env(SUPERVISE_CMD="sleep 2", MAX_RESTARTS="1",
+                     MIN_UPTIME_S="1"),
+            stdout=open(logf.name, "w"), stderr=subprocess.STDOUT, text=True)
+        # each child lives 2s (>= MIN_UPTIME 1s): crashes reset every cycle;
+        # poll with a deadline (not a fixed sleep) so CI load can't flake it
+        try:
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                out = logf.read()
+                logf.seek(0)
+                if out.count("starting miner") >= 3:
+                    break
+                assert proc.poll() is None, out
+                time.sleep(0.5)
+            out = logf.read()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    assert out.count("starting miner") >= 3
+    assert "giving up" not in out
+
+
+def test_crash_detected_promptly_not_after_update_poll():
+    """Advisor regression: the watchdog must notice a dead child on the
+    POLL_S cadence, not after the (here 9999 s) update-poll sleep."""
+    t0 = time.time()
+    proc = subprocess.run(
+        ["bash", SCRIPT, "miner"],
+        env=_env(SUPERVISE_CMD="false", MAX_RESTARTS="0", MIN_UPTIME_S="300"),
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert time.time() - t0 < 30, "crash detection waited on the update poll"
+
+
+def test_term_kills_role_child_too():
+    """Supervisor TERM must take the role down with it — an orphaned child
+    would hold the TPU/hotkey against the next service start."""
+    marker = "31257"
+    proc = subprocess.Popen(
+        ["bash", SCRIPT, "miner"],
+        env=_env(SUPERVISE_CMD=f"sleep {marker}", MAX_RESTARTS="5",
+                 MIN_UPTIME_S="1"),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            r = subprocess.run(["pgrep", "-f", f"sleep {marker}"],
+                               capture_output=True, text=True)
+            if r.stdout.strip():
+                break
+            time.sleep(0.2)
+        assert r.stdout.strip(), "role child never started"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    time.sleep(1.0)
+    r = subprocess.run(["pgrep", "-f", f"sleep {marker}"],
+                       capture_output=True, text=True)
+    assert not r.stdout.strip(), "role child orphaned after supervisor TERM"
