@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import Any, Callable, Iterable, Optional
 
 import jax
@@ -32,6 +33,7 @@ import numpy as np
 import optax
 
 from .. import delta as delta_lib
+from .. import serialization as ser
 from ..ops.losses import causal_lm_loss
 from .scheduler import Clock, PeriodicAction, RealClock
 
@@ -92,11 +94,17 @@ class OuterOptMerge:
     """
 
     def __init__(self, inner, *, outer_lr: float = 0.7,
-                 momentum: float = 0.9, nesterov: bool = True):
+                 momentum: float = 0.9, nesterov: bool = True,
+                 state_path: str | None = None):
+        """``state_path``: optional msgpack file persisting the velocity
+        across restarts — without it a supervised averager restart silently
+        drops the momentum the merge quality depends on (several rounds of
+        re-warmup at this protocol's ~20 min cadence)."""
         self.inner = inner
         self.outer_lr = outer_lr
         self.momentum = momentum
         self.nesterov = nesterov
+        self.state_path = state_path
         self.velocity: Params | None = None
         self._pending_velocity: Params | None = None
 
@@ -120,7 +128,7 @@ class OuterOptMerge:
                                      val_batches=val_batches,
                                      consensus=consensus)
         if self.velocity is None:
-            self.velocity = delta_lib.zeros_like(base)
+            self.velocity = self._restore_velocity(base)
         # velocity is committed only when the round publishes: a failed
         # round retries against the UNCHANGED base, and double-accumulating
         # momentum for a base that never moved would overshoot the next
@@ -129,10 +137,39 @@ class OuterOptMerge:
             base, merged, self.velocity)
         return new_base, w
 
+    def _restore_velocity(self, base: Params) -> Params:
+        if self.state_path is not None and os.path.exists(self.state_path):
+            try:
+                host = jax.tree_util.tree_map(
+                    lambda x: np.zeros(x.shape, x.dtype),
+                    jax.eval_shape(lambda: base))
+                v = ser.load_file(self.state_path, host)
+                logger.info("outer-opt velocity restored from %s",
+                            self.state_path)
+                # inherit the base's shardings (a mesh averager's base is
+                # sharded; an unsharded restore would park the full tree on
+                # one device exactly where sharding exists to avoid that)
+                return jax.tree_util.tree_map(
+                    lambda b, x: jax.device_put(x, b.sharding)
+                    if hasattr(b, "sharding") else jnp.asarray(x), base, v)
+            except Exception:
+                logger.exception("outer-opt velocity restore failed; "
+                                 "starting from zero momentum")
+        return delta_lib.zeros_like(base)
+
     def commit(self) -> None:
         """Called by the loop after the merged base is published."""
         if self._pending_velocity is not None:
             self.velocity = self._pending_velocity
+            if self.state_path is not None:
+                try:
+                    # cross-process-sharded leaves can't be fetched on one
+                    # host; pod averagers skip persistence (restart re-warms)
+                    if all(getattr(l, "is_fully_addressable", True)
+                           for l in jax.tree_util.tree_leaves(self.velocity)):
+                        ser.save_file(self.velocity, self.state_path)
+                except Exception:
+                    logger.exception("outer-opt velocity save failed")
             self._pending_velocity = None
 
 

@@ -42,8 +42,11 @@ def make_strategy(cfg: RunConfig, model):
         strategy = ParameterizedMerge(model, meta_epochs=cfg.meta_epochs,
                                       meta_lr=cfg.meta_lr)
     if cfg.outer_momentum > 0:
-        strategy = OuterOptMerge(strategy, outer_lr=cfg.outer_lr,
-                                 momentum=cfg.outer_momentum)
+        strategy = OuterOptMerge(
+            strategy, outer_lr=cfg.outer_lr, momentum=cfg.outer_momentum,
+            # persist the DiLoCo velocity across supervised restarts
+            state_path=os.path.join(cfg.work_dir, "averager_state",
+                                    f"velocity_{cfg.hotkey}.msgpack"))
     return strategy
 
 

@@ -1,0 +1,95 @@
+"""One-shot on-chip perf decomposition (companion to docs/perf.md).
+
+Runs the measurements the perf analysis calls for, in one process so the
+compile cache is shared, and prints one JSON object:
+
+  std_tps          the bench.py headline config (flash, AdamW, CE)
+  fused_tps        same step with the tiled-head fused CE (--fused-loss)
+  sumloss_tps      CE replaced by a trivial sum loss  -> isolates loss cost
+  sgd_tps          AdamW replaced by SGD              -> isolates opt cost
+  b16_tps          batch 16 (skipped if compile exceeds the timeout)
+
+Usage (on a machine where jax sees a TPU):  python scripts/perf_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SEQ = 1024
+WARMUP, ITERS = 3, 15
+
+
+def _time(engine, cfg, batch_size):
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (batch_size, SEQ)), jnp.int32)}
+    state = engine.init_state(jax.random.PRNGKey(0))
+    for _ in range(WARMUP):
+        state, m = engine.train_step(state, batch)
+    float(m["loss"])  # axon's block_until_ready doesn't block; sync by fetch
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, m = engine.train_step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    return batch_size * SEQ * ITERS / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    import optax
+
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.models import gpt2
+
+    model, cfg = gpt2.make_model("gpt2-124m")
+    out = {"device": str(jax.devices()[0])}
+
+    def sum_loss(model_, params, batch):
+        logits = model_.apply({"params": params}, batch["input_ids"])
+        return (jnp.sum(logits.astype(jnp.float32)) * 1e-9,
+                jnp.float32(batch["input_ids"].size))
+
+    probes = {
+        "std_tps": lambda: TrainEngine(model, seq_len=SEQ),
+        "fused_tps": lambda: TrainEngine(model, seq_len=SEQ,
+                                         fused_loss=True),
+        "sumloss_tps": lambda: TrainEngine(model, seq_len=SEQ,
+                                           loss_fn=sum_loss),
+        "sgd_tps": lambda: TrainEngine(model, seq_len=SEQ,
+                                       optimizer=optax.sgd(1e-3)),
+    }
+    for name, make in probes.items():
+        try:
+            out[name] = round(_time(make(), cfg, 8), 1)
+            print(f"# {name}: {out[name]}", file=sys.stderr, flush=True)
+        except Exception as e:
+            out[name] = f"error: {e!r}"
+    try:
+        out["b16_tps"] = round(_time(TrainEngine(model, seq_len=SEQ), cfg,
+                                     16), 1)
+    except Exception as e:
+        out["b16_tps"] = f"error: {e!r}"
+
+    if isinstance(out.get("std_tps"), float):
+        if isinstance(out.get("fused_tps"), float):
+            out["fused_speedup"] = round(out["fused_tps"] / out["std_tps"], 3)
+        if isinstance(out.get("sumloss_tps"), float):
+            out["loss_cost_frac"] = round(
+                1 - out["std_tps"] / out["sumloss_tps"], 3)
+        if isinstance(out.get("sgd_tps"), float):
+            out["opt_cost_frac"] = round(
+                1 - out["std_tps"] / out["sgd_tps"], 3)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
