@@ -144,3 +144,27 @@ def test_fused_engine_llama():
     state = fus.init_state(params=params)
     state, m = fus.train_step(state, batch)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_fused_engine_on_sp_mesh():
+    """Fused CE composes with sequence parallelism: the hidden states enter
+    the loss sharded over sp, and the off-by-one label shift forces a
+    reshard GSPMD must handle."""
+    from distributedtraining_tpu.ops import ring_attention as ring
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = gpt2.GPT2Config(vocab_size=512, n_positions=32, n_embd=64,
+                          n_layer=2, n_head=4, vocab_multiple=128,
+                          attention_impl="ring")
+    model, cfg = gpt2.make_model(cfg)
+    mesh = make_mesh(MeshConfig(dp=2, sp=4))
+    try:
+        engine = TrainEngine(model, mesh=mesh, seq_len=32, fused_loss=True)
+        state = engine.init_state(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        batch = engine.place_batch({"input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)})
+        state, m = engine.train_step(state, batch)
+        assert np.isfinite(float(m["loss"]))
+    finally:
+        ring.set_ring_mesh(None)
