@@ -72,6 +72,7 @@ class RunConfig:
     eval_batches: int = 12                   # ~100 texts / batch 8 (ref :49,98)
     learning_rate: float = 5e-4              # neurons/miner.py:121-128
     grad_clip: Optional[float] = None
+    mu_dtype: Optional[str] = None           # "bfloat16": half-size Adam mu
     lora_rank: int = 0                       # >0: LoRA-delta mode (config 4)
     lora_alpha: float = 16.0
     dataset: str = "auto"                    # auto | wikitext | synthetic
@@ -195,6 +196,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--learning-rate", dest="learning_rate", type=float,
                    default=d.learning_rate)
     g.add_argument("--grad-clip", dest="grad_clip", type=float, default=None)
+    g.add_argument("--mu-dtype", dest="mu_dtype",
+                   choices=("float32", "bfloat16"), default=d.mu_dtype,
+                   help="AdamW first-moment storage dtype; bfloat16 halves "
+                        "its HBM footprint (7B/8B configs) at ~no "
+                        "throughput cost (scripts/opt_dtype_probe.py)")
     g.add_argument("--lora-rank", dest="lora_rank", type=int,
                    default=d.lora_rank,
                    help=">0 switches the miner to LoRA-delta training; "

@@ -133,7 +133,8 @@ def build(cfg: RunConfig) -> Components:
     engine = TrainEngine(
         model,
         optimizer=default_optimizer(cfg.learning_rate,
-                                    grad_clip=cfg.grad_clip),
+                                    grad_clip=cfg.grad_clip,
+                                    mu_dtype=cfg.mu_dtype),
         mesh=mesh, seq_len=seq, fused_loss=cfg.fused_loss)
 
     if cfg.backend == "memory":

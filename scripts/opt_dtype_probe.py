@@ -1,0 +1,59 @@
+"""One-off probe: AdamW moment dtype vs train throughput (docs/perf.md).
+
+Times the standard miner step (GPT-2-124M, B8xT1024, flash, bf16 acts)
+with f32 vs bf16 first-moment (mu) storage, interleaved A/B/A/B to control
+for tunnel throughput drift. Run on the real chip:
+  PYTHONPATH=/root/repo:/root/.axon_site python scripts/opt_dtype_probe.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributedtraining_tpu.engine import TrainEngine
+from distributedtraining_tpu.models import gpt2
+
+BATCH, SEQ, WARMUP, ITERS = 8, 1024, 3, 20
+
+
+def make(tag, tx):
+    model, cfg = gpt2.make_model("gpt2-124m")
+    engine = TrainEngine(model, optimizer=tx, seq_len=SEQ)
+    state = engine.init_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)}
+    for _ in range(WARMUP):
+        state, m = engine.train_step(state, batch)
+    float(m["loss"])
+    return tag, engine, state, batch
+
+
+def time_once(engine, state, batch):
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, m = engine.train_step(state, batch)
+    loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+    assert loss == loss
+    return BATCH * SEQ * ITERS / dt, state
+
+
+if __name__ == "__main__":
+    runs = [
+        make("f32 ", optax.adamw(5e-4, weight_decay=0.01)),
+        make("bf16", optax.adamw(5e-4, weight_decay=0.01,
+                                 mu_dtype=jnp.bfloat16)),
+    ]
+    tps = {tag: [] for tag, *_ in runs}
+    states = {tag: st for tag, _, st, _ in runs}
+    for trial in range(4):
+        for tag, engine, _, batch in runs:
+            t, states[tag] = time_once(engine, states[tag], batch)
+            tps[tag].append(t)
+            print(f"trial {trial} {tag}: {t:,.0f} tok/s", flush=True)
+    best = {tag: max(v) for tag, v in tps.items()}
+    print(f"best f32={best['f32 ']:,.0f}  best bf16={best['bf16']:,.0f}  "
+          f"ratio={best['bf16'] / best['f32 ']:.3f}")

@@ -56,6 +56,30 @@ def test_train_engine_loss_decreases(setup):
     assert int(state.step) == 30
 
 
+def test_bf16_mu_optimizer(setup):
+    """--mu-dtype bfloat16 stores Adam's first moment in bf16 (half the
+    HBM of the 7B/8B configs' largest optimizer buffer) and trains."""
+    import optax
+
+    from distributedtraining_tpu.engine.train import default_optimizer
+
+    model, cfg, _, train_batches, _ = setup
+    engine = TrainEngine(
+        model, optimizer=default_optimizer(mu_dtype="bfloat16"), seq_len=SEQ)
+    state = engine.init_state(jax.random.PRNGKey(0))
+    adam = [s for s in jax.tree_util.tree_leaves(
+        state.opt_state, is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState))
+        if isinstance(s, optax.ScaleByAdamState)]
+    assert adam, "no ScaleByAdamState found in opt_state"
+    mu_dtypes = {l.dtype for l in jax.tree_util.tree_leaves(adam[0].mu)}
+    nu_dtypes = {l.dtype for l in jax.tree_util.tree_leaves(adam[0].nu)}
+    assert mu_dtypes == {jnp.dtype(jnp.bfloat16)}
+    assert nu_dtypes == {jnp.dtype(jnp.float32)}  # nu stays full precision
+    batch = next(train_batches())
+    state, m = engine.train_step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_evaluate_token_weighted(setup):
     model, cfg, engine, _, val_batches = setup
     params = model.init_params(jax.random.PRNGKey(0))
