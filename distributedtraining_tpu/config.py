@@ -78,6 +78,7 @@ class RunConfig:
     dataset: str = "auto"                    # auto | wikitext | synthetic
     tokenizer: str = "auto"                  # auto | byte | <hf name>
     fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
+    scan_blocks: bool = False                # lax.scan the block stack
 
     # -- mesh ---------------------------------------------------------------
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
@@ -214,6 +215,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="compute the LM loss with a tiled head matmul that "
                         "never materializes the [batch, seq, vocab] logits "
                         "(HBM saver; GPT-2 and Llama, not LoRA)")
+    g.add_argument("--scan-blocks", dest="scan_blocks", action="store_true",
+                   help="trace the transformer stack as one lax.scan'd "
+                        "block (~n_layer-fold smaller program, much faster "
+                        "XLA compiles on deep models); identical math, "
+                        "stacked per-block param layout -- all roles of a "
+                        "deployment must agree on this flag")
 
     g = p.add_argument_group("mesh")
     g.add_argument("--dp", type=int, default=d.mesh.dp,

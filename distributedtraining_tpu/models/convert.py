@@ -296,11 +296,17 @@ def load_params(spec: str, model_cfg) -> Params:
     """Resolve a miner's ``--init-from`` spec against the model config in
     use. Dispatches on the config type, so the one flag serves every model
     family."""
-    from .gpt2 import GPT2Config
-    from .llama import LlamaConfig
+    from . import gpt2 as gpt2_mod
+    from . import llama as llama_mod
 
-    if isinstance(model_cfg, GPT2Config):
-        return gpt2_from_hf(spec, model_cfg)
-    if isinstance(model_cfg, LlamaConfig):
-        return llama_from_hf(spec, model_cfg)
+    if isinstance(model_cfg, gpt2_mod.GPT2Config):
+        params = gpt2_from_hf(spec, model_cfg)
+        if model_cfg.scan_blocks:
+            params = gpt2_mod.stack_blocks(params, model_cfg.n_layer)
+        return params
+    if isinstance(model_cfg, llama_mod.LlamaConfig):
+        params = llama_from_hf(spec, model_cfg)
+        if model_cfg.scan_blocks:
+            params = llama_mod.stack_blocks(params, model_cfg.n_layer)
+        return params
     raise TypeError(f"no converter for {type(model_cfg).__name__}")

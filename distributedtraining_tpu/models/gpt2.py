@@ -53,6 +53,11 @@ class GPT2Config:
     # +45% at 2048, 3.1x at 4096 vs dense (see ops/flash_attention.py).
     attention_impl: str = "flash"  # "dense" | "flash" | "ring"
     vocab_multiple: int = 128      # pad vocab to a lane-aligned multiple
+    # lax.scan over the block stack: one block traced/compiled once instead
+    # of n_layer inlined copies. Changes the param-tree layout (per-block
+    # leaves gain a leading [n_layer] axis under "h"/"block" instead of
+    # h_0..h_{L-1}); stack_blocks/unstack_blocks convert. Same math.
+    scan_blocks: bool = False
 
     @property
     def padded_vocab(self) -> int:
@@ -137,6 +142,18 @@ class Block(nn.Module):
         return x + h
 
 
+class _BlockScan(nn.Module):
+    """nn.scan target: Block with the (carry, out) contract scan requires."""
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, deterministic):
+        blk = nn.remat(Block, static_argnums=(4,)) if self.cfg.remat else Block
+        x = blk(self.cfg, name="block")(x, attention_mask, segment_ids,
+                                        deterministic)
+        return x, None
+
+
 class GPT2(nn.Module):
     """Decoder-only transformer; ``__call__`` returns [B, T, padded_vocab] logits."""
     cfg: GPT2Config
@@ -170,12 +187,27 @@ class GPT2(nn.Module):
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, static_argnums=(4,))
-        for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x, attention_mask, segment_ids,
-                                          deterministic)
+        if cfg.scan_blocks:
+            # one Block program, lax.scan'd n_layer times: ~L-fold smaller
+            # HLO (compile time) at identical step math. "layers" has no
+            # mesh rule -> per-layer leaves replicate exactly like the
+            # unrolled layout's.
+            scan = nn.scan(
+                _BlockScan,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.n_layer,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            x, _ = scan(cfg, name="h")(x, attention_mask, segment_ids,
+                                       deterministic)
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(Block, static_argnums=(4,))
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"h_{i}")(x, attention_mask, segment_ids,
+                                              deterministic)
 
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.compute_dtype(),
                          param_dtype=cfg.storage_dtype(),
@@ -201,3 +233,28 @@ class GPT2(nn.Module):
 def make_model(preset_or_cfg) -> tuple[GPT2, GPT2Config]:
     cfg = PRESETS[preset_or_cfg] if isinstance(preset_or_cfg, str) else preset_or_cfg
     return GPT2(cfg), cfg
+
+
+def stack_blocks(params, n_layer: int, *, prefix: str = "h_",
+                 scan_key: str = "h"):
+    """Unrolled layout (``h_0..h_{L-1}``) -> scan layout (``h/block`` with a
+    leading [L] axis on every per-block leaf). The wire format, HF converters
+    (models/convert.py) and unrolled peers all speak the unrolled layout;
+    these two functions are the boundary adapters for ``scan_blocks`` runs."""
+    blocks = [params[f"{prefix}{i}"] for i in range(n_layer)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    out = {k: v for k, v in params.items()
+           if not (k.startswith(prefix) and k[len(prefix):].isdigit())}
+    out[scan_key] = {"block": stacked}
+    return out
+
+
+def unstack_blocks(params, n_layer: int, *, prefix: str = "h_",
+                   scan_key: str = "h"):
+    """Scan layout -> unrolled layout (inverse of stack_blocks)."""
+    stacked = params[scan_key]["block"]
+    out = {k: v for k, v in params.items() if k != scan_key}
+    for i in range(n_layer):
+        out[f"{prefix}{i}"] = jax.tree_util.tree_map(
+            lambda x, i=i: x[i], stacked)
+    return out

@@ -39,6 +39,10 @@ class LlamaConfig:
     remat: bool = True
     attention_impl: str = "dense"
     vocab_multiple: int = 128
+    # lax.scan over the block stack (see gpt2.GPT2Config.scan_blocks): at
+    # 32-80 layers this is the difference between minutes and seconds of
+    # XLA compile. stack_blocks/unstack_blocks convert layouts.
+    scan_blocks: bool = False
 
     @property
     def padded_vocab(self) -> int:
@@ -134,6 +138,18 @@ class LlamaBlock(nn.Module):
         return x + down
 
 
+class _BlockScan(nn.Module):
+    """nn.scan target: LlamaBlock with scan's (carry, out) contract."""
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, segment_ids, position_ids):
+        blk = nn.remat(LlamaBlock) if self.cfg.remat else LlamaBlock
+        x = blk(self.cfg, name="block")(x, attention_mask, segment_ids,
+                                        position_ids)
+        return x, None
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
 
@@ -156,12 +172,23 @@ class Llama(nn.Module):
             position_ids = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = wte[input_ids].astype(cfg.compute_dtype())
 
-        block = LlamaBlock
-        if cfg.remat:
-            block = nn.remat(LlamaBlock)
-        for i in range(cfg.n_layer):
-            x = block(cfg, name=f"layer_{i}")(x, attention_mask, segment_ids,
-                                              position_ids)
+        if cfg.scan_blocks:
+            scan = nn.scan(
+                _BlockScan,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.n_layer,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"})
+            x, _ = scan(cfg, name="layers")(x, attention_mask, segment_ids,
+                                            position_ids)
+        else:
+            block = LlamaBlock
+            if cfg.remat:
+                block = nn.remat(LlamaBlock)
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"layer_{i}")(x, attention_mask,
+                                                  segment_ids, position_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
         if return_hidden:
             return x
@@ -184,3 +211,15 @@ class Llama(nn.Module):
 def make_model(preset_or_cfg) -> tuple[Llama, LlamaConfig]:
     cfg = PRESETS[preset_or_cfg] if isinstance(preset_or_cfg, str) else preset_or_cfg
     return Llama(cfg), cfg
+
+
+def stack_blocks(params, n_layer: int):
+    """Unrolled ``layer_0..layer_{L-1}`` -> scan layout (``layers/block``)."""
+    from .gpt2 import stack_blocks as _stack
+    return _stack(params, n_layer, prefix="layer_", scan_key="layers")
+
+
+def unstack_blocks(params, n_layer: int):
+    """Scan layout -> unrolled layout (inverse of stack_blocks)."""
+    from .gpt2 import unstack_blocks as _unstack
+    return _unstack(params, n_layer, prefix="layer_", scan_key="layers")

@@ -56,7 +56,9 @@ def is_target(path, leaf, cfg: LoRAConfig) -> bool:
     comps = path_components(path)
     if comps and comps[-1] != "kernel":
         return False
-    if jnp.ndim(leaf) != 2:
+    # 2-D: unrolled layout [in, out]; 3-D: scan_blocks layout [L, in, out]
+    # (per-layer factors with a leading layer axis, batched matmul applies)
+    if jnp.ndim(leaf) not in (2, 3):
         return False
     return any(pat in comp for comp in comps for pat in cfg.target_patterns)
 
@@ -74,9 +76,10 @@ def init_lora(rng: jax.Array, base_params: Params, cfg: LoRAConfig) -> Params:
     leaves = []
     for k, (path, leaf) in zip(keys, flat):
         if is_target(path, leaf, cfg):
-            fan_in, fan_out = leaf.shape
-            a = jax.random.normal(k, (fan_in, cfg.rank), jnp.float32) * 0.02
-            b = jnp.zeros((cfg.rank, fan_out), jnp.float32)
+            *lead, fan_in, fan_out = leaf.shape
+            a = jax.random.normal(
+                k, (*lead, fan_in, cfg.rank), jnp.float32) * 0.02
+            b = jnp.zeros((*lead, cfg.rank, fan_out), jnp.float32)
             leaves.append(LoRAPair(a=a, b=b))
         else:
             leaves.append(None)

@@ -107,10 +107,13 @@ def build(cfg: RunConfig) -> Components:
                          num_processes=cfg.multihost_processes,
                          process_id=cfg.multihost_id)
 
-    if cfg.model in llama.PRESETS:
-        model, model_cfg = llama.make_model(cfg.model)
-    else:
-        model, model_cfg = gpt2.make_model(cfg.model)
+    import dataclasses as _dc
+
+    family = llama if cfg.model in llama.PRESETS else gpt2
+    model_cfg = family.PRESETS[cfg.model]
+    if cfg.scan_blocks:
+        model_cfg = _dc.replace(model_cfg, scan_blocks=True)
+    model, model_cfg = family.make_model(model_cfg)
 
     mesh = None
     spec = cfg.mesh

@@ -81,6 +81,27 @@ def main() -> int:
                                     batch_abs)
     assert lmetrics["loss"].shape == ()
 
+    # 4. scan_blocks layout (what a real 32-layer deployment runs for
+    #    compile time): shardings resolve with the leading "layers" axis
+    #    replicated, step traces, param count unchanged
+    import dataclasses
+
+    scan_model, scan_cfg = llama.make_model(
+        dataclasses.replace(cfg, scan_blocks=True))
+    scan_shardings = mesh_shardings(scan_model, mesh, seq_len=seq)
+    scan_abs = jax.eval_shape(
+        lambda: scan_model.init_params(jax.random.PRNGKey(0)))
+    n_scan = 0
+    for leaf, s in zip(jax.tree_util.tree_leaves(scan_abs),
+                       jax.tree_util.tree_leaves(scan_shardings)):
+        s.shard_shape(leaf.shape)
+        n_scan += int(np.prod(leaf.shape))
+    assert n_scan == n_params, (n_scan, n_params)
+    scan_engine = TrainEngine(scan_model, mesh=mesh, seq_len=seq)
+    _, scan_metrics = jax.eval_shape(
+        scan_engine.train_step, scan_engine.abstract_state(), batch_abs)
+    assert scan_metrics["loss"].shape == ()
+
     print(f"OK {n_params}")
     return 0
 
