@@ -79,6 +79,7 @@ class RunConfig:
     tokenizer: str = "auto"                  # auto | byte | <hf name>
     fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
     scan_blocks: bool = False                # lax.scan the block stack
+    prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
 
     # -- mesh ---------------------------------------------------------------
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
@@ -215,6 +216,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="compute the LM loss with a tiled head matmul that "
                         "never materializes the [batch, seq, vocab] logits "
                         "(HBM saver; GPT-2 and Llama, not LoRA)")
+    g.add_argument("--prefetch-depth", dest="prefetch_depth", type=int,
+                   default=d.prefetch_depth,
+                   help="batches the background input thread keeps ready "
+                        "(tokenize+pack ahead of the device; 0 disables, "
+                        "the reference's DataLoader-workers equivalent)")
     g.add_argument("--scan-blocks", dest="scan_blocks", action="store_true",
                    help="trace the transformer stack as one lax.scan'd "
                         "block (~n_layer-fold smaller program, much faster "

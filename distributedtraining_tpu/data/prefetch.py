@@ -1,0 +1,109 @@
+"""Background input pipeline: overlap host work with device steps.
+
+The reference overlaps tokenization with training via DataLoader worker
+processes (miner DataLoader at neurons/miner.py:101-106; tokenize happens in
+workers per SURVEY §3.1). The TPU-native equivalent is a bounded background
+thread that runs the host side of the pipeline — tokenize → pack → stack →
+(optionally) ``device_put`` — ahead of the training loop, so the accelerator
+never waits on Python between steps even when a single host step is slower
+than a device step.
+
+Threads, not processes: the hot path (native packer, numpy stacking,
+jax.device_put) releases the GIL, and staying in-process means device
+placement can happen inside the worker — the one thing a DataLoader worker
+process can never do.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+_SENTINEL = object()
+
+
+class PrefetchIterator:
+    """Iterate ``source`` on a daemon thread, ``depth`` items ahead.
+
+    ``transform`` runs inside the worker (use it for TrainEngine.place_batch
+    so H2D transfer overlaps compute). Exceptions in the source/transform
+    surface on the consuming thread at the next ``__next__``; ``close()``
+    stops the worker promptly and is idempotent (also called by ``__del__``
+    and on exhaustion).
+    """
+
+    def __init__(self, source: Iterable, *, depth: int = 2,
+                 transform: Optional[Callable] = None):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, args=(iter(source), transform), daemon=True)
+        self._worker.start()
+
+    def _run(self, it: Iterator, transform: Optional[Callable]) -> None:
+        try:
+            for item in it:
+                if transform is not None:
+                    item = transform(item)
+                # bounded put that stays responsive to close()
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            self._put_forever(_SENTINEL)
+        except BaseException as e:  # noqa: BLE001 - re-raised on consumer
+            self._put_forever(e)
+
+    def _put_forever(self, item) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is _SENTINEL:
+            self.close()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self.close()
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def __del__(self):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def prefetch(source: Iterable, *, depth: int = 2,
+             transform: Optional[Callable] = None) -> PrefetchIterator:
+    """Wrap any batch iterable (e.g. ``batch_iterator``) with background
+    prefetch. Typical miner wiring::
+
+        batches = prefetch(batch_iterator(...), transform=engine.place_batch)
+        loop.run(batches, ...)
+    """
+    return PrefetchIterator(source, depth=depth, transform=transform)

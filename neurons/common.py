@@ -59,9 +59,13 @@ class Components:
                     f"process count {jax.process_count()}")
             docs = list(multihost.shard_documents(docs))
             bs //= jax.process_count()
-        return batch_iterator(docs, self.tokenizer, batch_size=bs,
-                              seq_len=self.cfg.seq_len, repeat=repeat,
-                              max_vocab=self.model_cfg.vocab_size)
+        it = batch_iterator(docs, self.tokenizer, batch_size=bs,
+                            seq_len=self.cfg.seq_len, repeat=repeat,
+                            max_vocab=self.model_cfg.vocab_size)
+        if self.cfg.prefetch_depth > 0:
+            from distributedtraining_tpu.data import prefetch
+            it = prefetch(it, depth=self.cfg.prefetch_depth)
+        return it
 
     def initial_params(self):
         """Pretrained starting point per --init-from (None without the flag).
