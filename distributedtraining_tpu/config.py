@@ -28,11 +28,14 @@ from typing import Optional, Sequence
 
 @dataclasses.dataclass
 class MeshSpec:
-    """dp×fsdp×sp×tp axis sizes; 0 for dp means "all visible devices"."""
+    """dp×fsdp×sp×tp axis sizes; 0 for dp means "all visible devices".
+    dcn_dp > 1 lays the outermost dp groups across the slow network
+    (multi-slice DCN) — see parallel.multihost.pod_mesh."""
     dp: int = 0
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    dcn_dp: int = 1
 
 
 @dataclasses.dataclass
@@ -118,7 +121,8 @@ class RunConfig:
     def from_args(cls, role: str, argv: Sequence[str] | None = None
                   ) -> "RunConfig":
         ns = build_parser(role).parse_args(argv)
-        mesh = MeshSpec(dp=ns.dp, fsdp=ns.fsdp, sp=ns.sp, tp=ns.tp)
+        mesh = MeshSpec(dp=ns.dp, fsdp=ns.fsdp, sp=ns.sp, tp=ns.tp,
+                        dcn_dp=ns.dcn_dp)
         fields = {f.name for f in dataclasses.fields(cls)}
         kw = {k: v for k, v in vars(ns).items() if k in fields}
         kw.pop("mesh", None)
@@ -234,6 +238,10 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--fsdp", type=int, default=d.mesh.fsdp)
     g.add_argument("--sp", type=int, default=d.mesh.sp)
     g.add_argument("--tp", type=int, default=d.mesh.tp)
+    g.add_argument("--dcn-dp", dest="dcn_dp", type=int, default=d.mesh.dcn_dp,
+                   help="outermost dp groups that cross the slow network "
+                        "(multi-slice DCN); keeps fsdp/sp/tp and the rest "
+                        "of dp on ICI")
     g.add_argument("--multihost-coordinator", dest="multihost_coordinator",
                    default=None, metavar="HOST:PORT",
                    help="explicit jax.distributed coordinator for manual "

@@ -129,12 +129,21 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
-def pod_mesh(*, dp: int = 0, fsdp: int = 1, sp: int = 1, tp: int = 1):
+def pod_mesh(*, dp: int = 0, fsdp: int = 1, sp: int = 1, tp: int = 1,
+             dcn_dp: int = 1):
     """Global mesh over every chip in the pod slice (all processes).
 
     dp=0 means "whatever is left": dp = n_global_devices / (fsdp*sp*tp).
     The mesh uses jax.devices() (global), so the same jitted step on every
     host forms one SPMD program with XLA collectives riding ICI.
+
+    ``dcn_dp > 1`` declares that the outermost ``dcn_dp`` groups of the dp
+    axis cross a slower network (multi-slice DCN, or plain ethernet between
+    CPU hosts): the device mesh is laid out so that ONLY that slice of the
+    dp axis crosses granule boundaries, keeping fsdp/sp/tp collectives —
+    and the intra-granule part of dp — on ICI. Granules are TPU slices when
+    the platform exposes ``slice_index``, else processes. dp must be
+    divisible by dcn_dp; the fsdp/sp/tp axes must fit inside one granule.
     """
     n = len(jax.devices())
     rest = fsdp * sp * tp
@@ -146,6 +155,25 @@ def pod_mesh(*, dp: int = 0, fsdp: int = 1, sp: int = 1, tp: int = 1):
     if cfg.n_devices != n:
         raise ValueError(f"mesh {cfg} wants {cfg.n_devices} devices, "
                          f"pod has {n}")
+    if dcn_dp > 1:
+        if dp % dcn_dp:
+            raise ValueError(f"dp={dp} not divisible by dcn_dp={dcn_dp}")
+        from jax.experimental import mesh_utils
+        from jax.sharding import Mesh
+
+        from .mesh import AXES
+        inner = (dp // dcn_dp, fsdp, sp, tp)
+        outer = (dcn_dp, 1, 1, 1)
+        # granule = TPU slice when the platform actually has dcn_dp of
+        # them; otherwise processes (CPU hosts report slice_index 0 for
+        # every device, so attribute presence alone is not the signal)
+        devs = jax.devices()
+        slice_ids = {getattr(d, "slice_index", None) for d in devs}
+        use_slices = None not in slice_ids and len(slice_ids) == dcn_dp
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            inner, outer, devices=devs,
+            process_is_granule=not use_slices)
+        return Mesh(dev_array, AXES)
     return make_mesh(cfg, devices=jax.devices())
 
 

@@ -123,7 +123,7 @@ def build(cfg: RunConfig) -> Components:
     spec = cfg.mesh
     if jax.process_count() > 1:
         mesh = multihost.pod_mesh(dp=spec.dp, fsdp=spec.fsdp, sp=spec.sp,
-                                  tp=spec.tp)
+                                  tp=spec.tp, dcn_dp=spec.dcn_dp)
     else:
         n_visible = len(jax.devices())
         dp = spec.dp or max(1, n_visible // (spec.fsdp * spec.sp * spec.tp))

@@ -36,6 +36,17 @@ def main() -> int:
     mesh = multihost.pod_mesh()  # dp=4 over both processes
     assert mesh.shape["dp"] == 4
 
+    # hybrid (DCN-aware) layout: with dcn_dp=2 the dp axis must cross the
+    # slow network only at its outermost split — each outer-dp group is one
+    # granule (process here; TPU slice on multislice hardware)
+    hybrid = multihost.pod_mesh(dcn_dp=2)
+    assert hybrid.shape["dp"] == 4
+    dev_grid = hybrid.devices  # [dp=4, fsdp=1, sp=1, tp=1]
+    outer_groups = dev_grid.reshape(2, 2, 1, 1, 1)
+    for g in range(2):
+        procs = {d.process_index for d in outer_groups[g].flat}
+        assert len(procs) == 1, (g, procs)  # inner dp stays on one granule
+
     from distributedtraining_tpu.engine import TrainEngine
     from distributedtraining_tpu.models import gpt2
 
