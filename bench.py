@@ -91,12 +91,14 @@ def _param_count(model) -> int:
                for l in jax.tree_util.tree_leaves(abstract))
 
 
-def _time_merge(model) -> tuple[float, float]:
-    """(mean seconds, GB/s of delta bytes) for the averager's jitted
-    weighted merge of MERGE_M full-parameter GPT-2-124M deltas — the second
-    half of the north-star metric. Single-chip here; the mesh path
-    (ingest-sharded stack + psum all-reduce, parallel/collectives.py) is
-    exercised by dryrun_multichip and tests/test_parallel.py."""
+def _time_merge(model) -> dict:
+    """Averager merge wall-clock for MERGE_M full-parameter GPT-2-124M
+    deltas — the second half of the north-star metric. Times BOTH
+    spellings: the leafwise tree merge (one small kernel per tensor) and
+    the raveled single-contraction form (delta.weighted_merge_flat).
+    Single-chip here; the mesh path (ingest-sharded stack + psum
+    all-reduce, parallel/collectives.py) is exercised by dryrun_multichip
+    and tests/test_parallel.py."""
     from distributedtraining_tpu import delta as delta_lib
 
     params = model.init_params(jax.random.PRNGKey(0))
@@ -111,29 +113,39 @@ def _time_merge(model) -> tuple[float, float]:
                       for kk, l in zip(ks, leaves)]))
     stacked = delta_lib.stack_deltas(deltas)
     w = jnp.full((MERGE_M,), 1.0 / MERGE_M)
-
-    @jax.jit
-    def merge(params, stacked, w):
-        merged = delta_lib.weighted_merge(params, stacked, w)
-        # scalar probe depending on EVERY leaf: fetching one leaf would end
-        # timing with the other ~150 tensor merges still in flight (the
-        # axon backend's block_until_ready does not actually block)
-        probe = sum(l.reshape(-1)[0]
-                    for l in jax.tree_util.tree_leaves(merged))
-        return merged, probe
-
-    merged, probe = merge(params, stacked, w)
-    float(probe)  # warm + full sync
-
-    t0 = time.perf_counter()
-    for _ in range(MERGE_ITERS):
-        out, probe = merge(params, stacked, w)
-    float(probe)
-    dt = (time.perf_counter() - t0) / MERGE_ITERS
-
     n_bytes = sum(l.size * l.dtype.itemsize
                   for l in jax.tree_util.tree_leaves(stacked))
-    return dt, n_bytes / dt / 1e9
+
+    def timed(merge_fn):
+        @jax.jit
+        def merge(params, stacked, w):
+            merged = merge_fn(params, stacked, w)
+            # scalar probe depending on EVERY leaf: fetching one leaf would
+            # end timing with other tensor merges still in flight (the axon
+            # backend's block_until_ready does not actually block)
+            probe = sum(l.reshape(-1)[0]
+                        for l in jax.tree_util.tree_leaves(merged))
+            return merged, probe
+
+        _, probe = merge(params, stacked, w)
+        float(probe)  # warm + full sync
+        t0 = time.perf_counter()
+        for _ in range(MERGE_ITERS):
+            _, probe = merge(params, stacked, w)
+        float(probe)
+        return (time.perf_counter() - t0) / MERGE_ITERS
+
+    out = {"merge_m": MERGE_M}
+    dt = timed(delta_lib.weighted_merge)
+    out["merge_wallclock_s"] = round(dt, 4)
+    out["merge_gbps"] = round(n_bytes / dt / 1e9, 1)
+    try:
+        dt_flat = timed(delta_lib.weighted_merge_flat)
+        out["merge_flat_wallclock_s"] = round(dt_flat, 4)
+        out["merge_flat_gbps"] = round(n_bytes / dt_flat / 1e9, 1)
+    except Exception as e:
+        out["merge_flat_error"] = repr(e)
+    return out
 
 
 def main() -> None:
@@ -171,10 +183,7 @@ def main() -> None:
         extras["peak_flops"] = peak
 
     try:
-        merge_s, merge_gbps = _time_merge(model)
-        extras["merge_wallclock_s"] = round(merge_s, 4)
-        extras["merge_gbps"] = round(merge_gbps, 1)
-        extras["merge_m"] = MERGE_M
+        extras.update(_time_merge(model))
     except Exception as e:
         extras["merge_error"] = repr(e)
 

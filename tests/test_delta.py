@@ -90,6 +90,33 @@ def test_stack_and_weighted_merge():
         np.testing.assert_allclose(a, b, rtol=1e-5)
 
 
+def test_flat_merge_matches_leafwise():
+    """weighted_merge_flat is the single-kernel spelling of weighted_merge:
+    identical values AND identical meta-gradient w.r.t. the weights."""
+    base = small_tree(0)
+    deltas = [delta.compute_delta(small_tree(i), base) for i in range(1, 5)]
+    stacked = delta.stack_deltas(deltas)
+    w = jnp.asarray([0.4, 0.3, 0.2, 0.1])
+
+    a = delta.weighted_merge(base, stacked, w)
+    b = delta.weighted_merge_flat(base, stacked, w)
+    assert jax.tree_util.tree_structure(a) == jax.tree_util.tree_structure(b)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        assert x.shape == y.shape and x.dtype == y.dtype
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-6, atol=1e-6)
+
+    def probe(merge_fn, w):
+        merged = merge_fn(base, stacked, w)
+        return sum(jnp.sum(l * l) for l in jax.tree_util.tree_leaves(merged))
+
+    g1 = jax.grad(lambda w: probe(delta.weighted_merge, w))(w)
+    g2 = jax.grad(lambda w: probe(delta.weighted_merge_flat, w))(w)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_merge_weight_gradient_matches_finite_difference():
     """jax.grad through the merge must equal numeric meta-gradient — this is
     the correctness core of the parameterized averager."""
