@@ -58,6 +58,12 @@ class GPT2Config:
     # leaves gain a leading [n_layer] axis under "h"/"block" instead of
     # h_0..h_{L-1}); stack_blocks/unstack_blocks convert. Same math.
     scan_blocks: bool = False
+    # storage dtype of the [B, T, V] logits buffer. MXU accumulation stays
+    # f32 either way (preferred_element_type); "bfloat16" halves the single
+    # largest activation tensor's HBM round-trips at a small CE-input
+    # precision cost (the loss still reduces in f32). Opt-in pending an
+    # on-chip measurement (docs/perf.md).
+    logits_dtype: str = "float32"
 
     @property
     def padded_vocab(self) -> int:
@@ -221,7 +227,9 @@ class GPT2(nn.Module):
         # tied lm head: logits accumulate fp32 on the MXU
         logits = jnp.einsum("bte,ve->btv", x, wte.astype(cfg.compute_dtype()),
                             preferred_element_type=jnp.float32)
-        return logits
+        # the astype fuses into the matmul epilogue, so "bfloat16" means the
+        # stored buffer (not the accumulation) shrinks
+        return logits.astype(jnp.dtype(cfg.logits_dtype))
 
     def init_params(self, rng, *, seq_len: int = 8):
         """Raw (unboxed) param pytree; logical axis metadata is recovered

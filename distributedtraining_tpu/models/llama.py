@@ -43,6 +43,9 @@ class LlamaConfig:
     # 32-80 layers this is the difference between minutes and seconds of
     # XLA compile. stack_blocks/unstack_blocks convert layouts.
     scan_blocks: bool = False
+    # logits storage dtype (see gpt2.GPT2Config.logits_dtype); at Llama-3's
+    # 128k padded vocab the f32 logits are by far the largest activation
+    logits_dtype: str = "float32"
 
     @property
     def padded_vocab(self) -> int:
@@ -199,7 +202,7 @@ class Llama(nn.Module):
             (cfg.padded_vocab, cfg.n_embd), cfg.storage_dtype())
         logits = jnp.einsum("bte,ve->btv", x, lm_head.astype(cfg.compute_dtype()),
                             preferred_element_type=jnp.float32)
-        return logits
+        return logits.astype(jnp.dtype(cfg.logits_dtype))
 
     def init_params(self, rng, *, seq_len: int = 8):
         """Raw (unboxed) param pytree; logical axis metadata is recovered

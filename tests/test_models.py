@@ -26,6 +26,26 @@ def test_gpt2_forward_shape(tiny_gpt2):
     assert logits.dtype == jnp.float32
 
 
+def test_bf16_logits_storage(tiny_gpt2):
+    """logits_dtype='bfloat16' halves the logit buffer while the loss stays
+    within bf16 rounding of the f32-logits loss (accumulation is f32 either
+    way — only storage precision changes)."""
+    import dataclasses
+
+    model, cfg, params = tiny_gpt2
+    bf_model, bf_cfg = gpt2_mod.make_model(
+        dataclasses.replace(cfg, logits_dtype="bfloat16"))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 16)), jnp.int32)
+    lf = model.apply({"params": params}, ids)
+    lb = bf_model.apply({"params": params}, ids)
+    assert lb.dtype == jnp.bfloat16 and lf.dtype == jnp.float32
+    loss_f, _ = causal_lm_loss(lf, ids)
+    loss_b, _ = causal_lm_loss(lb, ids)
+    np.testing.assert_allclose(float(loss_b), float(loss_f),
+                               rtol=1e-2)  # bf16 has ~3 significant digits
+
+
 def test_gpt2_causality(tiny_gpt2):
     """Changing a future token must not change past logits."""
     model, cfg, params = tiny_gpt2
