@@ -1,0 +1,23 @@
+"""JAX platform override for role subprocesses and harnesses.
+
+Some environments force-select a platform from sitecustomize, ignoring the
+``JAX_PLATFORMS`` env var — only a ``jax.config`` update wins (the same
+mechanism tests/conftest.py uses). Every entry point calls this ONCE,
+before its first backend touch.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_platform_from_env(var: str = "DT_FORCE_PLATFORM") -> str | None:
+    """Apply ``$DT_FORCE_PLATFORM`` (e.g. "cpu") via jax.config; returns the
+    applied platform or None. Must run before any JAX backend
+    initialization — importing jax here is safe, initializing it is not."""
+    val = os.environ.get(var)
+    if val:
+        import jax
+
+        jax.config.update("jax_platforms", val)
+    return val

@@ -17,11 +17,10 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("DT_FORCE_PLATFORM"):
-    import jax
-    jax.config.update("jax_platforms", os.environ["DT_FORCE_PLATFORM"])
+from distributedtraining_tpu.utils.platform import (  # noqa: E402
+    force_platform_from_env)
 
-import jax  # noqa: E402
+force_platform_from_env()
 
 from distributedtraining_tpu.chain import LocalChain  # noqa: E402
 from distributedtraining_tpu.data import (ByteTokenizer,  # noqa: E402
@@ -80,8 +79,8 @@ def main() -> None:
         print(f"averager: accepted {averager.report.last_accepted}, "
               f"merged-base loss {averager.report.last_loss:.4f}")
 
-        template = model.init_params(jax.random.PRNGKey(0))
-        fetched = transport.fetch_base(template)
+        from distributedtraining_tpu.engine.train import host_zeros_template
+        fetched = transport.fetch_base(host_zeros_template(engine))
         assert fetched is not None
         print(f"round complete: new base published (revision "
               f"{fetched[1][:12]}...)")

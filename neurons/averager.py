@@ -17,14 +17,11 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
-# Platform override BEFORE any project/jax import: some environments
-# force-select a platform from sitecustomize (ignoring JAX_PLATFORMS), so
-# tests and multi-process harnesses route role subprocesses via this env
-# var + jax.config, exactly like tests/conftest.py does.
-if os.environ.get("DT_FORCE_PLATFORM"):
-    import jax as _jax
+# platform override BEFORE any backend touch (see utils/platform.py)
+from distributedtraining_tpu.utils.platform import (  # noqa: E402
+    force_platform_from_env)
 
-    _jax.config.update("jax_platforms", os.environ["DT_FORCE_PLATFORM"])
+force_platform_from_env()
 
 from distributedtraining_tpu.config import RunConfig           # noqa: E402
 from distributedtraining_tpu.engine import (                   # noqa: E402
