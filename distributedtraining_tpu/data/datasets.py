@@ -94,15 +94,25 @@ def text_corpus(*, split: str = "train", n_docs: int = 256,
 
 def batch_iterator(docs: Iterable[str], tokenizer, *, batch_size: int,
                    seq_len: int, repeat: bool = False,
-                   max_vocab: int | None = None) -> Iterator[dict]:
+                   max_vocab: int | None = None,
+                   shuffle: bool = False, seed: int = 0) -> Iterator[dict]:
     """Tokenize -> pack -> batch. Yields dicts of [B, T] numpy arrays ready
-    for TrainEngine.place_batch."""
+    for TrainEngine.place_batch.
+
+    ``shuffle=True`` permutes the document order with a fresh permutation
+    per epoch (deterministic from ``seed``) — the reference trains through
+    a shuffling DataLoader (neurons/miner.py:101-106); eval paths keep the
+    default fixed order so scores stay comparable across rounds."""
     docs = list(docs)  # materialize: a one-shot iterator + repeat=True would
     # otherwise busy-loop forever on the exhausted iterator
+    rng = np.random.default_rng(seed) if shuffle else None
 
     def rows():
         while True:
-            token_docs = (tokenizer.encode(d) for d in docs)
+            epoch_docs = docs
+            if rng is not None:
+                epoch_docs = [docs[i] for i in rng.permutation(len(docs))]
+            token_docs = (tokenizer.encode(d) for d in epoch_docs)
             if max_vocab is not None:
                 token_docs = ([t % max_vocab for t in d] for d in token_docs)
             yield from pack_documents(token_docs, seq_len)

@@ -61,7 +61,9 @@ class Components:
             bs //= jax.process_count()
         it = batch_iterator(docs, self.tokenizer, batch_size=bs,
                             seq_len=self.cfg.seq_len, repeat=repeat,
-                            max_vocab=self.model_cfg.vocab_size)
+                            max_vocab=self.model_cfg.vocab_size,
+                            shuffle=True)  # ref trains via a shuffling
+        # DataLoader (neurons/miner.py:101-106); eval stays ordered
         if self.cfg.prefetch_depth > 0:
             from distributedtraining_tpu.data import prefetch
             it = prefetch(it, depth=self.cfg.prefetch_depth)

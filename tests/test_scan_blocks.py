@@ -86,6 +86,28 @@ def test_scan_train_step_on_mesh(devices):
     assert kern.sharding.spec[0] is None
 
 
+def test_scan_with_ring_attention_on_sp_mesh(devices):
+    """scan_blocks composes with sequence parallelism: ring attention's
+    shard_map runs inside the lax.scan'd block on an sp mesh."""
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.ops import ring_attention as ring
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, sp=4), devices=devices[:8])
+    cfg = dataclasses.replace(gpt2.PRESETS["tiny"], n_positions=32,
+                              attention_impl="ring", scan_blocks=True)
+    model, _ = gpt2.make_model(cfg)
+    try:
+        engine = TrainEngine(model, mesh=mesh, seq_len=32)
+        state = engine.init_state(jax.random.PRNGKey(0))
+        batch = {"input_ids": jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (4, 32)), jnp.int32)}
+        state, m = engine.train_step(state, engine.place_batch(batch))
+        assert np.isfinite(float(m["loss"]))
+    finally:
+        ring.set_ring_mesh(None)
+
+
 def test_lora_adapts_scan_layout():
     """LoRA on a scan-layout base: 3-D [L, in, out] kernels get per-layer
     factors and the effective params equal the unrolled equivalent."""
