@@ -83,6 +83,7 @@ class RunConfig:
     fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
     scan_blocks: bool = False                # lax.scan the block stack
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
+    accum_steps: int = 1                     # microbatches per optimizer step
 
     # -- mesh ---------------------------------------------------------------
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
@@ -220,6 +221,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="compute the LM loss with a tiled head matmul that "
                         "never materializes the [batch, seq, vocab] logits "
                         "(HBM saver; GPT-2 and Llama, not LoRA)")
+    g.add_argument("--accum-steps", dest="accum_steps", type=int,
+                   default=d.accum_steps,
+                   help="gradient-accumulation microbatches per optimizer "
+                        "step (activation memory of batch/N at the same "
+                        "effective batch; 7B/8B configs)")
     g.add_argument("--prefetch-depth", dest="prefetch_depth", type=int,
                    default=d.prefetch_depth,
                    help="batches the background input thread keeps ready "

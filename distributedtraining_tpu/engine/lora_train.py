@@ -25,7 +25,8 @@ import optax
 
 from .. import delta as delta_lib
 from ..models import lora as lora_lib
-from .train import MinerLoop, TrainEngine, TrainState, _default_lm_loss
+from .train import (MinerLoop, TrainEngine, TrainState, _default_lm_loss,
+                    accumulated_grads)
 
 logger = logging.getLogger(__name__)
 
@@ -46,11 +47,12 @@ class LoRAEngine(TrainEngine):
 
     def __init__(self, model, lora_cfg: lora_lib.LoRAConfig, *,
                  optimizer: optax.GradientTransformation | None = None,
-                 loss_fn=None, mesh=None, seq_len: int = 8):
+                 loss_fn=None, mesh=None, seq_len: int = 8,
+                 accum_steps: int = 1):
         # sets up tx, mesh, base param shardings, batch sharding, placement
         # helpers; the full-param step closures it defines are shadowed below
         super().__init__(model, optimizer=optimizer, mesh=mesh,
-                         seq_len=seq_len)
+                         seq_len=seq_len, accum_steps=accum_steps)
         self.lora_cfg = lora_cfg
         task_loss = loss_fn or _default_lm_loss
 
@@ -59,8 +61,9 @@ class LoRAEngine(TrainEngine):
             return task_loss(model, eff, batch)
 
         def train_step(state: TrainState, base, batch):
-            (l, count), grads = jax.value_and_grad(
-                lambda p: loss(p, base, batch), has_aux=True)(state.params)
+            l, count, grads = accumulated_grads(
+                lambda p, mb: loss(p, base, mb), state.params, batch,
+                accum_steps)
             updates, opt_state = self.tx.update(grads, state.opt_state,
                                                 state.params)
             params = optax.apply_updates(state.params, updates)

@@ -63,6 +63,47 @@ def default_optimizer(learning_rate: float = 5e-4,
     return tx
 
 
+def accumulated_grads(loss_fn, params, batch, accum_steps: int):
+    """(loss, tokens, grads) of ``loss_fn(params, batch) -> (mean, count)``,
+    gradient-accumulated over ``accum_steps`` microbatches (lax.scan).
+
+    Token-weighted across microbatches, so the result equals the full-batch
+    token-mean exactly (up to float summation order): activation memory of
+    batch/N at the same effective batch. With ``accum_steps == 1`` this is a
+    plain value_and_grad. The batch's leading dim must divide by N."""
+    if accum_steps == 1:
+        (loss, tokens), grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch), has_aux=True)(params)
+        return loss, tokens, grads
+
+    def to_micro(x):
+        b = x.shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"batch dim {b} not divisible by accum_steps={accum_steps}")
+        return x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
+
+    micro = jax.tree_util.tree_map(to_micro, batch)
+
+    def weighted(p, mb):
+        l, t = loss_fn(p, mb)
+        return l * t, t
+
+    def body(carry, mb):
+        g_acc, ls, ts = carry
+        (wl, t), g = jax.value_and_grad(weighted, has_aux=True)(params, mb)
+        g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+        return (g_acc, ls + wl, ts + t), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (g_sum, loss_sum, tok_sum), _ = jax.lax.scan(
+        body, (zeros, jnp.float32(0.0), jnp.float32(0.0)), micro)
+    denom = jnp.maximum(tok_sum, 1.0)
+    grads = jax.tree_util.tree_map(
+        lambda g: (g / denom).astype(g.dtype), g_sum)
+    return loss_sum / denom, tok_sum, grads
+
+
 def _default_lm_loss(model, params, batch):
     logits = model.apply(
         {"params": params}, batch["input_ids"],
@@ -99,7 +140,8 @@ class TrainEngine:
     def __init__(self, model, *, optimizer: optax.GradientTransformation | None = None,
                  mesh=None, seq_len: int = 8,
                  loss_fn: Callable | None = None,
-                 fused_loss: bool = False):
+                 fused_loss: bool = False,
+                 accum_steps: int = 1):
         """``loss_fn(model, params, batch) -> (mean_loss, count)`` overrides
         the causal-LM default — the toy classification harnesses
         (models/toy.py + ops.losses.classification_loss) plug in here. The
@@ -110,7 +152,14 @@ class TrainEngine:
         ``fused_loss=True`` swaps the built-in LM loss for the
         tiled-head variant (_fused_lm_loss) that never materializes the
         [B, T, V] logits — still the same LM task, so meshes remain
-        allowed."""
+        allowed.
+
+        ``accum_steps=N`` splits each batch into N microbatches inside the
+        jitted step (lax.scan) and applies ONE token-weighted optimizer
+        update — activation memory of batch/N at the same effective batch.
+        The batch's leading dim must divide by N (and the microbatch by the
+        mesh's dp*fsdp). The step math is identical to the unaccumulated
+        step up to summation order."""
         if mesh is not None and loss_fn is not None:
             raise ValueError(
                 "mesh sharding assumes causal-LM batches ([B, T] input_ids) "
@@ -141,13 +190,16 @@ class TrainEngine:
                 set_ring_mesh(mesh)
 
         task_loss = loss_fn or _default_lm_loss
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = accum_steps
 
         def loss_fn(params, batch):
             return task_loss(model, params, batch)
 
         def train_step(state: TrainState, batch):
-            (loss, tokens), grads = jax.value_and_grad(
-                lambda p: loss_fn(p, batch), has_aux=True)(state.params)
+            loss, tokens, grads = accumulated_grads(
+                loss_fn, state.params, batch, accum_steps)
             updates, opt_state = self.tx.update(grads, state.opt_state,
                                                 state.params)
             params = optax.apply_updates(state.params, updates)

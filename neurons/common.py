@@ -144,7 +144,8 @@ def build(cfg: RunConfig) -> Components:
         optimizer=default_optimizer(cfg.learning_rate,
                                     grad_clip=cfg.grad_clip,
                                     mu_dtype=cfg.mu_dtype),
-        mesh=mesh, seq_len=seq, fused_loss=cfg.fused_loss)
+        mesh=mesh, seq_len=seq, fused_loss=cfg.fused_loss,
+        accum_steps=cfg.accum_steps)
 
     if cfg.backend == "memory":
         transport = InMemoryTransport()

@@ -51,7 +51,8 @@ def main(argv=None) -> int:
         # base (fsdp/tp) while adapters replicate.
         from distributedtraining_tpu.engine import LoRAEngine, LoRAMinerLoop
         engine = LoRAEngine(c.model, c.lora_cfg, optimizer=c.engine.tx,
-                            mesh=c.engine.mesh, seq_len=cfg.seq_len)
+                            mesh=c.engine.mesh, seq_len=cfg.seq_len,
+                            accum_steps=cfg.accum_steps)
         loop = LoRAMinerLoop(engine, c.transport, cfg.hotkey,
                              send_interval=cfg.send_interval,
                              check_update_interval=cfg.check_update_interval,

@@ -144,6 +144,31 @@ def test_fetch_delta_any_decodes_adapters(setup):
 
 # -- LoRA on a mesh (config 4: sharded frozen base, replicated adapters) -----
 
+def test_lora_grad_accumulation_matches_full_batch(setup):
+    """accum_steps on the adapter step reproduces the full-batch update."""
+    import dataclasses
+
+    cfg, train_batches = setup[1], setup[2]
+    f32_model, _ = gpt2.make_model(dataclasses.replace(cfg, dtype="float32"))
+    batch = next(train_batches())
+    base = f32_model.init_params(jax.random.PRNGKey(0))
+
+    e1 = LoRAEngine(f32_model, LCFG, seq_len=SEQ)
+    e2 = LoRAEngine(f32_model, LCFG, seq_len=SEQ, accum_steps=2)
+    b1 = e1.place_params(base)
+    s1 = e1.init_state(jax.random.PRNGKey(1), b1)
+    s2 = e2.init_state(jax.random.PRNGKey(1), b1)
+    for _ in range(2):
+        s1, m1 = e1.train_step(s1, b1, batch)
+        s2, m2 = e2.train_step(s2, b1, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
 def test_lora_engine_on_mesh_fsdp(setup):
     """tiny-llama adapters train on a dp=2 x fsdp=2 mesh: the frozen base is
     sharded by the logical rules, adapters/opt-state replicate, and the loss
