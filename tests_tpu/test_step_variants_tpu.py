@@ -1,0 +1,82 @@
+"""On-chip checks for the step/merge variants added in round 2.
+
+Small configs (compile time, and large programs can wedge this rig's TPU
+tunnel — see docs/perf.md): each case pins on-device agreement between a
+variant and its reference spelling, not throughput.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributedtraining_tpu import delta as delta_lib
+from distributedtraining_tpu.engine import TrainEngine
+from distributedtraining_tpu.models import gpt2
+
+SEQ = 128
+
+
+def _batch(cfg, b=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (b, SEQ)), jnp.int32)}
+
+
+def test_scan_blocks_loss_matches_unrolled_on_chip():
+    cfg = dataclasses.replace(gpt2.PRESETS["tiny"], n_positions=SEQ)
+    m1, _ = gpt2.make_model(cfg)
+    m2, _ = gpt2.make_model(dataclasses.replace(cfg, scan_blocks=True))
+    p1 = m1.init_params(jax.random.PRNGKey(0))
+    e1 = TrainEngine(m1, seq_len=SEQ)
+    e2 = TrainEngine(m2, seq_len=SEQ)
+    s1 = e1.init_state(params=p1)
+    s2 = e2.init_state(params=gpt2.stack_blocks(p1, cfg.n_layer))
+    batch = _batch(cfg)
+    _, l1 = e1.train_step(s1, batch)
+    _, l2 = e2.train_step(s2, batch)
+    np.testing.assert_allclose(float(l1["loss"]), float(l2["loss"]),
+                               rtol=5e-3)  # bf16 compute
+
+
+def test_accumulated_step_matches_full_batch_on_chip():
+    cfg = dataclasses.replace(gpt2.PRESETS["tiny"], n_positions=SEQ,
+                              dtype="float32")
+    model, _ = gpt2.make_model(cfg)
+    p = model.init_params(jax.random.PRNGKey(0))
+    e1 = TrainEngine(model, seq_len=SEQ)
+    e2 = TrainEngine(model, seq_len=SEQ, accum_steps=2)
+    s1 = e1.init_state(params=p)
+    s2 = e2.init_state(params=p)
+    batch = _batch(cfg, b=4)
+    s1, m1 = e1.train_step(s1, batch)
+    s2, m2 = e2.train_step(s2, batch)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(s1.params),
+                    jax.tree_util.tree_leaves(s2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
+def test_flat_merge_matches_leafwise_on_chip():
+    model, cfg = gpt2.make_model("tiny")
+    base = model.init_params(jax.random.PRNGKey(0))
+    key = jax.random.PRNGKey(1)
+    leaves, treedef = jax.tree_util.tree_flatten(base)
+    deltas = []
+    for _ in range(4):
+        key, k = jax.random.split(key)
+        ks = jax.random.split(k, len(leaves))
+        deltas.append(jax.tree_util.tree_unflatten(
+            treedef, [0.01 * jax.random.normal(kk, l.shape, l.dtype)
+                      for kk, l in zip(ks, leaves)]))
+    stacked = delta_lib.stack_deltas(deltas)
+    w = jnp.asarray([0.4, 0.3, 0.2, 0.1])
+    a = jax.jit(delta_lib.weighted_merge)(base, stacked, w)
+    b = jax.jit(delta_lib.weighted_merge_flat)(base, stacked, w)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-6)
