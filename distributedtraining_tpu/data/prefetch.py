@@ -48,20 +48,15 @@ class PrefetchIterator:
             for item in it:
                 if transform is not None:
                     item = transform(item)
-                # bounded put that stays responsive to close()
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                self._put(item)
                 if self._stop.is_set():
                     return
-            self._put_forever(_SENTINEL)
+            self._put(_SENTINEL)
         except BaseException as e:  # noqa: BLE001 - re-raised on consumer
-            self._put_forever(e)
+            self._put(e)
 
-    def _put_forever(self, item) -> None:
+    def _put(self, item) -> None:
+        """Bounded put that stays responsive to close()."""
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)

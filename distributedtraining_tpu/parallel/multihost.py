@@ -145,7 +145,8 @@ def pod_mesh(*, dp: int = 0, fsdp: int = 1, sp: int = 1, tp: int = 1,
     the platform exposes ``slice_index``, else processes. dp must be
     divisible by dcn_dp; the fsdp/sp/tp axes must fit inside one granule.
     """
-    n = len(jax.devices())
+    devs = jax.devices()
+    n = len(devs)
     rest = fsdp * sp * tp
     if dp == 0:
         if n % rest:
@@ -167,14 +168,13 @@ def pod_mesh(*, dp: int = 0, fsdp: int = 1, sp: int = 1, tp: int = 1,
         # granule = TPU slice when the platform actually has dcn_dp of
         # them; otherwise processes (CPU hosts report slice_index 0 for
         # every device, so attribute presence alone is not the signal)
-        devs = jax.devices()
         slice_ids = {getattr(d, "slice_index", None) for d in devs}
         use_slices = None not in slice_ids and len(slice_ids) == dcn_dp
         dev_array = mesh_utils.create_hybrid_device_mesh(
             inner, outer, devices=devs,
             process_is_granule=not use_slices)
         return Mesh(dev_array, AXES)
-    return make_mesh(cfg, devices=jax.devices())
+    return make_mesh(cfg, devices=devs)
 
 
 def shard_documents(docs, *, process_index: Optional[int] = None,

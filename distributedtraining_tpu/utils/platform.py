@@ -11,11 +11,20 @@ from __future__ import annotations
 import os
 
 
-def force_platform_from_env(var: str = "DT_FORCE_PLATFORM") -> str | None:
+def force_platform_from_env(var: str = "DT_FORCE_PLATFORM",
+                            *, honor_jax_platforms: bool = False
+                            ) -> str | None:
     """Apply ``$DT_FORCE_PLATFORM`` (e.g. "cpu") via jax.config; returns the
     applied platform or None. Must run before any JAX backend
-    initialization — importing jax here is safe, initializing it is not."""
+    initialization — importing jax here is safe, initializing it is not.
+
+    ``honor_jax_platforms=True`` additionally treats ``JAX_PLATFORMS=cpu``
+    as a CPU request (harness contract: the driver sets that env var, which
+    the sitecustomize would otherwise override)."""
     val = os.environ.get(var)
+    if not val and honor_jax_platforms \
+            and os.environ.get("JAX_PLATFORMS") == "cpu":
+        val = "cpu"
     if val:
         import jax
 
