@@ -220,7 +220,7 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--fused-loss", dest="fused_loss", action="store_true",
                    help="compute the LM loss with a tiled head matmul that "
                         "never materializes the [batch, seq, vocab] logits "
-                        "(HBM saver; GPT-2 and Llama, not LoRA)")
+                        "(HBM saver; GPT-2, Llama, and LoRA-delta mode)")
     g.add_argument("--accum-steps", dest="accum_steps", type=int,
                    default=d.accum_steps,
                    help="gradient-accumulation microbatches per optimizer "

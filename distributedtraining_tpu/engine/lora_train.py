@@ -26,7 +26,7 @@ import optax
 from .. import delta as delta_lib
 from ..models import lora as lora_lib
 from .train import (MinerLoop, TrainEngine, TrainState, _default_lm_loss,
-                    accumulated_grads)
+                    _fused_lm_loss, accumulated_grads)
 
 logger = logging.getLogger(__name__)
 
@@ -48,12 +48,21 @@ class LoRAEngine(TrainEngine):
     def __init__(self, model, lora_cfg: lora_lib.LoRAConfig, *,
                  optimizer: optax.GradientTransformation | None = None,
                  loss_fn=None, mesh=None, seq_len: int = 8,
-                 accum_steps: int = 1):
+                 accum_steps: int = 1, fused_loss: bool = False):
         # sets up tx, mesh, base param shardings, batch sharding, placement
         # helpers; the full-param step closures it defines are shadowed below
         super().__init__(model, optimizer=optimizer, mesh=mesh,
                          seq_len=seq_len, accum_steps=accum_steps)
         self.lora_cfg = lora_cfg
+        if fused_loss:
+            if loss_fn is not None:
+                raise ValueError("fused_loss and a custom loss_fn are "
+                                 "mutually exclusive")
+            # works on the EFFECTIVE params (a full tree): the adapters
+            # never touch the head (wte/lm_head is not a LoRA target), so
+            # the tiled head matmul reads the frozen base head — exactly
+            # the memory-constrained config-4 combination
+            loss_fn = _fused_lm_loss
         task_loss = loss_fn or _default_lm_loss
 
         def loss(lora_params, base, batch):

@@ -134,11 +134,6 @@ def build(cfg: RunConfig) -> Components:
             mesh = make_mesh(mcfg)
 
     seq = cfg.seq_len if cfg.role == "miner" else cfg.eval_seq_len
-    if cfg.fused_loss and cfg.lora_rank > 0:
-        # the LoRA engine has no fused-head plumbing; silently dropping the
-        # flag would surprise exactly the memory-constrained configs that
-        # asked for it
-        raise SystemExit("--fused-loss is not supported with --lora-rank")
     engine = TrainEngine(
         model,
         optimizer=default_optimizer(cfg.learning_rate,

@@ -52,7 +52,8 @@ def main(argv=None) -> int:
         from distributedtraining_tpu.engine import LoRAEngine, LoRAMinerLoop
         engine = LoRAEngine(c.model, c.lora_cfg, optimizer=c.engine.tx,
                             mesh=c.engine.mesh, seq_len=cfg.seq_len,
-                            accum_steps=cfg.accum_steps)
+                            accum_steps=cfg.accum_steps,
+                            fused_loss=cfg.fused_loss)
         loop = LoRAMinerLoop(engine, c.transport, cfg.hotkey,
                              send_interval=cfg.send_interval,
                              check_update_interval=cfg.check_update_interval,

@@ -168,3 +168,32 @@ def test_fused_engine_on_sp_mesh():
         assert np.isfinite(float(m["loss"]))
     finally:
         ring.set_ring_mesh(None)
+
+
+def test_fused_loss_on_lora_engine():
+    """config-4 combination: adapter-only training with the tiled-head CE.
+    Values match the dense-logits LoRA step (same init, same batch)."""
+    from distributedtraining_tpu.engine import LoRAEngine
+    from distributedtraining_tpu.models.lora import LoRAConfig
+
+    model, cfg = gpt2.make_model("tiny")
+    base = model.init_params(jax.random.PRNGKey(0), seq_len=16)
+    lcfg = LoRAConfig(rank=2)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)}
+
+    dense = LoRAEngine(model, lcfg, seq_len=16)
+    fused = LoRAEngine(model, lcfg, seq_len=16, fused_loss=True)
+    b = dense.place_params(base)
+    sd = dense.init_state(jax.random.PRNGKey(1), b)
+    sf = fused.init_state(jax.random.PRNGKey(1), b)
+    for _ in range(3):
+        sd, md = dense.train_step(sd, b, batch)
+        sf, mf = fused.train_step(sf, b, batch)
+    np.testing.assert_allclose(float(mf["loss"]), float(md["loss"]),
+                               rtol=1e-3)
+    for a, c in zip(jax.tree_util.tree_leaves(sd.params),
+                    jax.tree_util.tree_leaves(sf.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=5e-3, atol=5e-5)
