@@ -25,8 +25,7 @@ import optax
 
 from .. import delta as delta_lib
 from ..models import lora as lora_lib
-from .train import (MinerLoop, TrainEngine, TrainState, _default_lm_loss,
-                    _fused_lm_loss, accumulated_grads)
+from .train import MinerLoop, TrainEngine, TrainState, accumulated_grads
 
 logger = logging.getLogger(__name__)
 
@@ -50,20 +49,17 @@ class LoRAEngine(TrainEngine):
                  loss_fn=None, mesh=None, seq_len: int = 8,
                  accum_steps: int = 1, fused_loss: bool = False):
         # sets up tx, mesh, base param shardings, batch sharding, placement
-        # helpers; the full-param step closures it defines are shadowed below
+        # helpers, and resolves fused/custom loss into _task_loss (the
+        # fused path works on the EFFECTIVE params: the head is never a
+        # LoRA target, so the tiled head matmul reads the frozen base head
+        # — exactly the memory-constrained config-4 combination); the
+        # full-param step closures it defines are shadowed below. A mesh +
+        # custom loss_fn is rejected there, same as full-param training.
         super().__init__(model, optimizer=optimizer, mesh=mesh,
-                         seq_len=seq_len, accum_steps=accum_steps)
+                         seq_len=seq_len, accum_steps=accum_steps,
+                         loss_fn=loss_fn, fused_loss=fused_loss)
         self.lora_cfg = lora_cfg
-        if fused_loss:
-            if loss_fn is not None:
-                raise ValueError("fused_loss and a custom loss_fn are "
-                                 "mutually exclusive")
-            # works on the EFFECTIVE params (a full tree): the adapters
-            # never touch the head (wte/lm_head is not a LoRA target), so
-            # the tiled head matmul reads the frozen base head — exactly
-            # the memory-constrained config-4 combination
-            loss_fn = _fused_lm_loss
-        task_loss = loss_fn or _default_lm_loss
+        task_loss = self._task_loss
 
         def loss(lora_params, base, batch):
             eff = lora_lib.apply_lora(base, lora_params, lora_cfg)

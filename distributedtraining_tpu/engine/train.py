@@ -190,6 +190,9 @@ class TrainEngine:
                 set_ring_mesh(mesh)
 
         task_loss = loss_fn or _default_lm_loss
+        # resolved model-level loss — subclasses (LoRAEngine) reuse this so
+        # fused/custom-loss resolution lives in exactly one place
+        self._task_loss = task_loss
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = accum_steps

@@ -59,11 +59,17 @@ class Components:
                     f"process count {jax.process_count()}")
             docs = list(multihost.shard_documents(docs))
             bs //= jax.process_count()
+        # ref trains via a shuffling DataLoader (neurons/miner.py:101-106);
+        # eval stays ordered. Seed per hotkey: miners sharing a corpus must
+        # see DIFFERENT batch orders or their deltas correlate and the
+        # averaging round degenerates toward a single-miner update.
+        import hashlib
+        seed = int.from_bytes(
+            hashlib.sha256(self.cfg.hotkey.encode()).digest()[:4], "little")
         it = batch_iterator(docs, self.tokenizer, batch_size=bs,
                             seq_len=self.cfg.seq_len, repeat=repeat,
                             max_vocab=self.model_cfg.vocab_size,
-                            shuffle=True)  # ref trains via a shuffling
-        # DataLoader (neurons/miner.py:101-106); eval stays ordered
+                            shuffle=True, seed=seed)
         if self.cfg.prefetch_depth > 0:
             from distributedtraining_tpu.data import prefetch
             it = prefetch(it, depth=self.cfg.prefetch_depth)
