@@ -36,6 +36,7 @@ class MeshSpec:
     sp: int = 1
     tp: int = 1
     dcn_dp: int = 1
+    auto: bool = False   # pick axes from model size (best_mesh_shape)
 
 
 @dataclasses.dataclass
@@ -123,7 +124,7 @@ class RunConfig:
                   ) -> "RunConfig":
         ns = build_parser(role).parse_args(argv)
         mesh = MeshSpec(dp=ns.dp, fsdp=ns.fsdp, sp=ns.sp, tp=ns.tp,
-                        dcn_dp=ns.dcn_dp)
+                        dcn_dp=ns.dcn_dp, auto=ns.mesh_auto)
         fields = {f.name for f in dataclasses.fields(cls)}
         kw = {k: v for k, v in vars(ns).items() if k in fields}
         kw.pop("mesh", None)
@@ -244,6 +245,10 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--fsdp", type=int, default=d.mesh.fsdp)
     g.add_argument("--sp", type=int, default=d.mesh.sp)
     g.add_argument("--tp", type=int, default=d.mesh.tp)
+    g.add_argument("--mesh-auto", dest="mesh_auto", action="store_true",
+                   help="ignore --dp/--fsdp/--sp/--tp and pick the mesh "
+                        "from the model size (dp while the Adam state fits "
+                        "replicated, fsdp/tp as it grows)")
     g.add_argument("--dcn-dp", dest="dcn_dp", type=int, default=d.mesh.dcn_dp,
                    help="outermost dp groups that cross the slow network "
                         "(multi-slice DCN); keeps fsdp/sp/tp and the rest "

@@ -8,7 +8,8 @@ shardings come from logical-axis rules resolved against a
 """
 
 from . import multihost
-from .mesh import MeshConfig, make_mesh, best_mesh_shape
+from .mesh import (MeshConfig, make_mesh, best_mesh_shape,
+                   resolve_mesh_config)
 from .sharding import (
     DEFAULT_RULES,
     logical_param_specs,
@@ -19,7 +20,7 @@ from .sharding import (
 
 __all__ = [
     "multihost",
-    "MeshConfig", "make_mesh", "best_mesh_shape",
+    "MeshConfig", "make_mesh", "best_mesh_shape", "resolve_mesh_config",
     "DEFAULT_RULES", "logical_param_specs", "mesh_shardings",
     "shard_batch_spec", "shard_params",
 ]

@@ -81,6 +81,24 @@ def best_mesh_shape(n_devices: int, *, model_params: int = 0,
     return MeshConfig(dp=n_devices)
 
 
+def resolve_mesh_config(*, n_devices: int, dp: int = 0, fsdp: int = 1,
+                        sp: int = 1, tp: int = 1, auto: bool = False,
+                        model_params: int = 0) -> MeshConfig:
+    """CLI mesh spec -> concrete MeshConfig (pure; role composition calls
+    this with the visible device count).
+
+    ``auto=True`` ignores the axis arguments and picks via
+    ``best_mesh_shape`` from the model size — dp while the training state
+    fits replicated, fsdp/tp as it grows. Otherwise dp=0 means "whatever
+    is left" after fsdp*sp*tp."""
+    if auto:
+        return best_mesh_shape(n_devices, model_params=model_params)
+    rest = fsdp * sp * tp
+    if dp == 0:
+        dp = max(1, n_devices // rest)
+    return MeshConfig(dp=dp, fsdp=fsdp, sp=sp, tp=tp)
+
+
 def _largest_pow2_divisor(n: int) -> int:
     p = 1
     while n % (p * 2) == 0:

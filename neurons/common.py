@@ -20,7 +20,7 @@ from distributedtraining_tpu.data import (ByteTokenizer, batch_iterator,
                                           load_tokenizer, text_corpus)
 from distributedtraining_tpu.engine import TrainEngine, default_optimizer
 from distributedtraining_tpu.models import gpt2, llama
-from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+from distributedtraining_tpu.parallel import make_mesh, resolve_mesh_config
 from distributedtraining_tpu.transport import (InMemoryTransport,
                                                LocalFSTransport)
 from distributedtraining_tpu.utils import JSONLSink, multi_sink
@@ -129,13 +129,23 @@ def build(cfg: RunConfig) -> Components:
 
     mesh = None
     spec = cfg.mesh
+    n_params = 0
+    if spec.auto:
+        import numpy as _np
+        abstract = jax.eval_shape(
+            lambda: model.init_params(jax.random.PRNGKey(0)))
+        n_params = sum(int(_np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(abstract))
     if jax.process_count() > 1:
-        mesh = multihost.pod_mesh(dp=spec.dp, fsdp=spec.fsdp, sp=spec.sp,
-                                  tp=spec.tp, dcn_dp=spec.dcn_dp)
+        rcfg = resolve_mesh_config(
+            n_devices=len(jax.devices()), dp=spec.dp, fsdp=spec.fsdp,
+            sp=spec.sp, tp=spec.tp, auto=spec.auto, model_params=n_params)
+        mesh = multihost.pod_mesh(dp=rcfg.dp, fsdp=rcfg.fsdp, sp=rcfg.sp,
+                                  tp=rcfg.tp, dcn_dp=spec.dcn_dp)
     else:
-        n_visible = len(jax.devices())
-        dp = spec.dp or max(1, n_visible // (spec.fsdp * spec.sp * spec.tp))
-        mcfg = MeshConfig(dp=dp, fsdp=spec.fsdp, sp=spec.sp, tp=spec.tp)
+        mcfg = resolve_mesh_config(
+            n_devices=len(jax.devices()), dp=spec.dp, fsdp=spec.fsdp,
+            sp=spec.sp, tp=spec.tp, auto=spec.auto, model_params=n_params)
         if mcfg.n_devices > 1:
             mesh = make_mesh(mcfg)
 

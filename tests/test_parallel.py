@@ -222,3 +222,21 @@ def test_multihost_env_detection(monkeypatch):
     monkeypatch.delenv("SLURM_NTASKS")
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
     assert multihost._multiprocess_env()
+
+
+def test_resolve_mesh_config():
+    from distributedtraining_tpu.parallel import resolve_mesh_config
+
+    # explicit axes: dp=0 fills the remainder
+    assert resolve_mesh_config(n_devices=8, fsdp=2, tp=2) == \
+        MeshConfig(dp=2, fsdp=2, sp=1, tp=2)
+    assert resolve_mesh_config(n_devices=8, dp=4) == MeshConfig(dp=4)
+    # auto: small model -> pure dp; 8B params -> sharded axes
+    assert resolve_mesh_config(n_devices=8, auto=True,
+                               model_params=124_000_000) == MeshConfig(dp=8)
+    big = resolve_mesh_config(n_devices=32, auto=True,
+                              model_params=8_000_000_000)
+    assert big.n_devices == 32 and (big.fsdp > 1 or big.tp > 1)
+    # auto overrides explicit axes (documented contract of --mesh-auto)
+    assert resolve_mesh_config(n_devices=8, dp=1, fsdp=8, auto=True,
+                               model_params=1_000) == MeshConfig(dp=8)
