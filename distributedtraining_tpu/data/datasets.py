@@ -92,6 +92,14 @@ def text_corpus(*, split: str = "train", n_docs: int = 256,
     return docs
 
 
+def shuffle_seed_for(identity: str) -> int:
+    """Stable per-identity shuffle seed. Miners sharing a corpus must see
+    DIFFERENT batch orders (same-seed shuffles correlate their deltas and
+    the averaging round degenerates toward a single-miner update)."""
+    digest = hashlib.sha256(identity.encode()).digest()
+    return int.from_bytes(digest[:4], "little")
+
+
 def batch_iterator(docs: Iterable[str], tokenizer, *, batch_size: int,
                    seq_len: int, repeat: bool = False,
                    max_vocab: int | None = None,

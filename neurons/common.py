@@ -18,6 +18,7 @@ from distributedtraining_tpu.chain import LocalAddressStore, LocalChain
 from distributedtraining_tpu.config import RunConfig
 from distributedtraining_tpu.data import (ByteTokenizer, batch_iterator,
                                           load_tokenizer, text_corpus)
+from distributedtraining_tpu.data.datasets import shuffle_seed_for
 from distributedtraining_tpu.engine import TrainEngine, default_optimizer
 from distributedtraining_tpu.models import gpt2, llama
 from distributedtraining_tpu.parallel import make_mesh, resolve_mesh_config
@@ -60,16 +61,12 @@ class Components:
             docs = list(multihost.shard_documents(docs))
             bs //= jax.process_count()
         # ref trains via a shuffling DataLoader (neurons/miner.py:101-106);
-        # eval stays ordered. Seed per hotkey: miners sharing a corpus must
-        # see DIFFERENT batch orders or their deltas correlate and the
-        # averaging round degenerates toward a single-miner update.
-        import hashlib
-        seed = int.from_bytes(
-            hashlib.sha256(self.cfg.hotkey.encode()).digest()[:4], "little")
+        # eval stays ordered; per-hotkey seed decorrelates the miners
         it = batch_iterator(docs, self.tokenizer, batch_size=bs,
                             seq_len=self.cfg.seq_len, repeat=repeat,
                             max_vocab=self.model_cfg.vocab_size,
-                            shuffle=True, seed=seed)
+                            shuffle=True,
+                            seed=shuffle_seed_for(self.cfg.hotkey))
         if self.cfg.prefetch_depth > 0:
             from distributedtraining_tpu.data import prefetch
             it = prefetch(it, depth=self.cfg.prefetch_depth)

@@ -44,6 +44,15 @@ def test_shuffle_permutes_per_epoch_deterministically():
     assert shuf != epochs(True, seed=1)       # seed actually steers it
 
 
+def test_shuffle_seed_per_identity():
+    from distributedtraining_tpu.data.datasets import shuffle_seed_for
+
+    a, b = shuffle_seed_for("hotkey_0"), shuffle_seed_for("hotkey_1")
+    assert a != b                       # distinct miners, distinct streams
+    assert a == shuffle_seed_for("hotkey_0")  # stable across restarts
+    assert 0 <= a < 2**32
+
+
 def test_transform_runs_in_worker():
     main = threading.get_ident()
     seen = []
