@@ -148,9 +148,35 @@ def _time_merge(model) -> dict:
     return out
 
 
+def _require_backend(timeout_s: float = 180.0) -> None:
+    """First backend touch with a deadline. This rig's TPU tunnel can wedge
+    so hard that jax.devices() blocks forever (docs/perf.md); a bench that
+    hangs silently eats the whole driver budget, so emit a parseable error
+    line and exit instead."""
+    import sys
+    import threading
+
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            print(json.dumps({
+                "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
+                "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+                "error": f"TPU backend unreachable after {timeout_s:.0f}s "
+                         "(tunnel wedged; see docs/perf.md)"}))
+            sys.stdout.flush()
+            os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+    jax.devices()
+    done.set()
+
+
 def main() -> None:
     from distributedtraining_tpu.models import gpt2
 
+    _require_backend()
     model, cfg = gpt2.make_model("gpt2-124m")
     tokens_per_sec = _time_train(model, cfg)
 
