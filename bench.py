@@ -152,25 +152,22 @@ def _require_backend(timeout_s: float = 180.0) -> None:
     """First backend touch with a deadline. This rig's TPU tunnel can wedge
     so hard that jax.devices() blocks forever (docs/perf.md); a bench that
     hangs silently eats the whole driver budget, so emit a parseable error
-    line and exit instead."""
+    line and exit instead. The stuck worker thread is daemon — abandoned,
+    exactly like every other wedge-prone call under run_with_timeout."""
     import sys
-    import threading
 
-    done = threading.Event()
+    from distributedtraining_tpu.utils import ChainTimeout, run_with_timeout
 
-    def watch():
-        if not done.wait(timeout_s):
-            print(json.dumps({
-                "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
-                "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
-                "error": f"TPU backend unreachable after {timeout_s:.0f}s "
-                         "(tunnel wedged; see docs/perf.md)"}))
-            sys.stdout.flush()
-            os._exit(3)
-
-    threading.Thread(target=watch, daemon=True).start()
-    jax.devices()
-    done.set()
+    try:
+        run_with_timeout(jax.devices, timeout_s, name="tpu-backend")
+    except ChainTimeout:
+        print(json.dumps({
+            "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
+            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "error": f"TPU backend unreachable after {timeout_s:.0f}s "
+                     "(tunnel wedged; see docs/perf.md)"}))
+        sys.stdout.flush()
+        sys.exit(3)
 
 
 def main() -> None:

@@ -83,15 +83,26 @@ def best_mesh_shape(n_devices: int, *, model_params: int = 0,
 
 def resolve_mesh_config(*, n_devices: int, dp: int = 0, fsdp: int = 1,
                         sp: int = 1, tp: int = 1, auto: bool = False,
-                        model_params: int = 0) -> MeshConfig:
+                        model_params: int = 0,
+                        dcn_dp: int = 1) -> MeshConfig:
     """CLI mesh spec -> concrete MeshConfig (pure; role composition calls
     this with the visible device count).
 
     ``auto=True`` ignores the axis arguments and picks via
     ``best_mesh_shape`` from the model size — dp while the training state
-    fits replicated, fsdp/tp as it grows. Otherwise dp=0 means "whatever
-    is left" after fsdp*sp*tp."""
+    fits replicated, fsdp/tp as it grows. With ``dcn_dp > 1`` (multi-slice)
+    the auto pick is made PER GRANULE and its dp multiplied by ``dcn_dp``,
+    so fsdp/sp/tp always fit inside one granule and only dp crosses DCN
+    (pod_mesh's hybrid-layout contract). Otherwise dp=0 means "whatever is
+    left" after fsdp*sp*tp."""
     if auto:
+        if dcn_dp > 1:
+            if n_devices % dcn_dp:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by dcn_dp={dcn_dp}")
+            per = best_mesh_shape(n_devices // dcn_dp,
+                                  model_params=model_params)
+            return dataclasses.replace(per, dp=per.dp * dcn_dp)
         return best_mesh_shape(n_devices, model_params=model_params)
     rest = fsdp * sp * tp
     if dp == 0:

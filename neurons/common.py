@@ -136,13 +136,15 @@ def build(cfg: RunConfig) -> Components:
     if jax.process_count() > 1:
         rcfg = resolve_mesh_config(
             n_devices=len(jax.devices()), dp=spec.dp, fsdp=spec.fsdp,
-            sp=spec.sp, tp=spec.tp, auto=spec.auto, model_params=n_params)
+            sp=spec.sp, tp=spec.tp, auto=spec.auto, model_params=n_params,
+            dcn_dp=spec.dcn_dp)
         mesh = multihost.pod_mesh(dp=rcfg.dp, fsdp=rcfg.fsdp, sp=rcfg.sp,
                                   tp=rcfg.tp, dcn_dp=spec.dcn_dp)
     else:
         mcfg = resolve_mesh_config(
             n_devices=len(jax.devices()), dp=spec.dp, fsdp=spec.fsdp,
-            sp=spec.sp, tp=spec.tp, auto=spec.auto, model_params=n_params)
+            sp=spec.sp, tp=spec.tp, auto=spec.auto, model_params=n_params,
+            dcn_dp=spec.dcn_dp)
         if mcfg.n_devices > 1:
             mesh = make_mesh(mcfg)
 

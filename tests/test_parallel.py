@@ -240,3 +240,20 @@ def test_resolve_mesh_config():
     # auto overrides explicit axes (documented contract of --mesh-auto)
     assert resolve_mesh_config(n_devices=8, dp=1, fsdp=8, auto=True,
                                model_params=1_000) == MeshConfig(dp=8)
+
+
+def test_resolve_mesh_config_auto_with_dcn():
+    from distributedtraining_tpu.parallel import resolve_mesh_config
+
+    # auto + multi-slice: pick per granule, multiply dp — fsdp/sp/tp never
+    # span a granule, so hybrid layout keeps them on ICI
+    small = resolve_mesh_config(n_devices=16, auto=True, dcn_dp=2,
+                                model_params=124_000_000)
+    assert small == MeshConfig(dp=16)
+    big = resolve_mesh_config(n_devices=32, auto=True, dcn_dp=2,
+                              model_params=8_000_000_000)
+    assert big.n_devices == 32
+    assert big.dp % 2 == 0                 # dcn factor lives in dp
+    assert big.fsdp * big.sp * big.tp <= 16  # inside one granule
+    with pytest.raises(ValueError):
+        resolve_mesh_config(n_devices=9, auto=True, dcn_dp=2)
