@@ -136,6 +136,30 @@ def test_config_defaults_match_reference():
     assert cfg.seq_len == 64 and cfg.eval_seq_len == 512
 
 
+def test_round2_flags_parse_into_config():
+    """Every round-2 CLI knob lands in RunConfig (regression guard for the
+    from_args field filter silently dropping a renamed dest)."""
+    from distributedtraining_tpu.config import RunConfig
+    cfg = RunConfig.from_args("miner", [
+        "--mu-dtype", "bfloat16", "--accum-steps", "4",
+        "--prefetch-depth", "0", "--scan-blocks", "--fused-loss",
+        "--mesh-auto", "--dcn-dp", "2", "--grad-clip", "1.0",
+    ])
+    assert cfg.mu_dtype == "bfloat16"
+    assert cfg.accum_steps == 4
+    assert cfg.prefetch_depth == 0
+    assert cfg.scan_blocks is True
+    assert cfg.fused_loss is True
+    assert cfg.mesh.auto is True
+    assert cfg.mesh.dcn_dp == 2
+    assert cfg.grad_clip == 1.0
+    # defaults stay conservative
+    d = RunConfig.from_args("miner", [])
+    assert d.mu_dtype is None and d.accum_steps == 1
+    assert d.scan_blocks is False and d.mesh.auto is False
+    assert d.prefetch_depth == 2
+
+
 def test_validator_entry_refuses_without_vpermit(tmp_path):
     """hotkey_0 has miner stake (10 < vpermit limit 1000): the entry point
     must refuse up front unless --allow-no-vpermit is passed."""
