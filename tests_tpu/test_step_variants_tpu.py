@@ -60,6 +60,23 @@ def test_accumulated_step_matches_full_batch_on_chip():
                                    rtol=2e-3, atol=2e-5)
 
 
+def test_bf16_logits_loss_close_on_chip():
+    """logits_dtype='bfloat16' on the real chip: same train-step loss to
+    bf16 rounding (the MXU accumulation stays f32 either way)."""
+    cfg = dataclasses.replace(gpt2.PRESETS["tiny"], n_positions=SEQ)
+    m32, _ = gpt2.make_model(cfg)
+    m16, _ = gpt2.make_model(
+        dataclasses.replace(cfg, logits_dtype="bfloat16"))
+    p = m32.init_params(jax.random.PRNGKey(0))
+    e32 = TrainEngine(m32, seq_len=SEQ)
+    e16 = TrainEngine(m16, seq_len=SEQ)
+    batch = _batch(cfg)
+    _, l32 = e32.train_step(e32.init_state(params=p), batch)
+    _, l16 = e16.train_step(e16.init_state(params=p), batch)
+    np.testing.assert_allclose(float(l16["loss"]), float(l32["loss"]),
+                               rtol=1e-2)
+
+
 def test_flat_merge_matches_leafwise_on_chip():
     model, cfg = gpt2.make_model("tiny")
     base = model.init_params(jax.random.PRNGKey(0))
