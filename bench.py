@@ -84,6 +84,37 @@ def _time_train(model, cfg, *, iters: int = ITERS,
     return BATCH * SEQ * iters / dt
 
 
+def _time_loop(model, cfg, *, iters: int = ITERS) -> float:
+    """tokens/sec of the PRODUCTION loop (MinerLoop.run): same jitted step,
+    plus the loop's bookkeeping (periodic-action polls, host batch feed,
+    device-resident loss). The gap between this and _time_train is pure
+    loop overhead — the round-2 verdict flagged a per-step float() sync
+    here; this sub-bench keeps it measured."""
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.engine.train import MinerLoop
+    from distributedtraining_tpu.transport import InMemoryTransport
+
+    engine = TrainEngine(model, seq_len=SEQ)
+    loop = MinerLoop(engine, InMemoryTransport(), "bench",
+                     send_interval=1e9, check_update_interval=1e9,
+                     log_every=10**9)
+    loop.bootstrap(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (BATCH, SEQ),
+                                       dtype=np.int32)}
+
+    def batches(n):
+        for _ in range(n):
+            yield batch
+
+    loop.run(batches(WARMUP), max_steps=WARMUP)   # warm (report syncs at exit)
+    t0 = time.perf_counter()
+    loop.run(batches(iters), max_steps=iters)     # exit fetch ends the timing
+    dt = time.perf_counter() - t0
+    assert loop.report.last_loss == loop.report.last_loss, "loss is NaN"
+    return BATCH * SEQ * iters / dt
+
+
 def _param_count(model) -> int:
     abstract = jax.eval_shape(
         lambda: model.init_params(jax.random.PRNGKey(0)))
@@ -195,6 +226,15 @@ def main() -> None:
         extras["fused_loss_speedup"] = round(fused_tps / tokens_per_sec, 3)
     except Exception as e:
         extras["fused_loss_error"] = repr(e)
+
+    try:
+        # production MinerLoop.run vs the bare engine step — loop overhead
+        # should be ≲2% (round-2 verdict item 4)
+        loop_tps = _time_loop(model, cfg)
+        extras["loop_tokens_per_sec"] = round(loop_tps, 1)
+        extras["loop_vs_engine"] = round(loop_tps / tokens_per_sec, 3)
+    except Exception as e:
+        extras["loop_error"] = repr(e)
 
     peak = _peak_flops()
     if peak:

@@ -252,14 +252,17 @@ class ParameterizedMerge:
             w = delta_lib.init_merge_weights(base, m, per_tensor=self.per_tensor)
         mixture, meta_step, tx = self._build_step(base, stacked)
         opt_state = tx.init(w)
-        last = float("nan")
+        last = None
         for epoch in range(self.meta_epochs):
             for batch in val_batches():
                 batch = engine.place_batch(batch)
-                w, opt_state, loss = meta_step(w, opt_state, batch)
-                last = float(loss)
+                # `last` stays a device array inside the batch loop so the
+                # host never blocks on an individual meta-step; one float()
+                # per epoch (the log line) is the only sync point.
+                w, opt_state, last = meta_step(w, opt_state, batch)
             logger.info("meta-learning epoch %d/%d loss=%.4f",
-                        epoch + 1, self.meta_epochs, last)
+                        epoch + 1, self.meta_epochs,
+                        float("nan") if last is None else float(last))
         merged = jax.jit(mixture)(w)
         return merged, w
 

@@ -537,6 +537,11 @@ class MinerLoop:
         self.nan_guard = nan_guard
         self.checkpoint_store = checkpoint_store
         self.report = MinerReport()
+        # device-resident copy of the newest step's loss; fetched to
+        # report.last_loss only at log boundaries and loop exit (a per-step
+        # float() would block the host on every step's completion and
+        # serialize batch prep behind device compute)
+        self._last_loss_dev = None
 
         self.state: TrainState | None = None
         self.base_params: Params | None = None
@@ -804,23 +809,40 @@ class MinerLoop:
         if self.state is None:
             self.bootstrap()
         start_steps = self.report.steps  # max_steps bounds *this* call
-        for batch in batches:
-            if max_steps is not None and self.report.steps - start_steps >= max_steps:
-                break
-            self._pull_action.poll()
-            m = self._train_one(batch)
-            if self.trace is not None:
-                self.trace.tick()
-            self.report.steps += 1
-            self.report.last_loss = float(m["loss"])
-            if self.metrics and self.report.steps % self.log_every == 0:
-                self.metrics.log(
-                    {"train_loss": self.report.last_loss,
-                     "staleness_s": self.clock.now() - self._last_base_time},
-                    step=self.report.steps)
-            self._push_action.poll()
-            if self._ckpt_action is not None:
-                self._ckpt_action.poll()
+        try:
+            for batch in batches:
+                if max_steps is not None and self.report.steps - start_steps >= max_steps:
+                    break
+                self._pull_action.poll()
+                m = self._train_one(batch)
+                if self.trace is not None:
+                    self.trace.tick()
+                self.report.steps += 1
+                # keep the loss on-device: train_step dispatches
+                # asynchronously, so the host can prep the next batch while
+                # the chip runs. The loss is a non-donated output buffer, so
+                # holding the newest one across steps is safe (and only the
+                # newest is retained).
+                self._last_loss_dev = m["loss"]
+                if self.metrics and self.report.steps % self.log_every == 0:
+                    self.report.last_loss = float(self._last_loss_dev)
+                    self.metrics.log(
+                        {"train_loss": self.report.last_loss,
+                         "staleness_s": self.clock.now() - self._last_base_time},
+                        step=self.report.steps)
+                self._push_action.poll()
+                if self._ckpt_action is not None:
+                    self._ckpt_action.poll()
+        finally:
+            # finally: the KeyboardInterrupt shutdown path (neurons/miner.py)
+            # reads report.last_loss after an exceptional exit too.
+            # Best-effort: a failed/wedged backend must not replace the
+            # in-flight exception (that would skip the miner's flush()).
+            if self._last_loss_dev is not None:
+                try:
+                    self.report.last_loss = float(self._last_loss_dev)
+                except Exception:
+                    pass
         return self.report
 
     def flush(self) -> None:
