@@ -84,35 +84,59 @@ def _time_train(model, cfg, *, iters: int = ITERS,
     return BATCH * SEQ * iters / dt
 
 
-def _time_loop(model, cfg, *, iters: int = ITERS) -> float:
-    """tokens/sec of the PRODUCTION loop (MinerLoop.run): same jitted step,
-    plus the loop's bookkeeping (periodic-action polls, host batch feed,
-    device-resident loss). The gap between this and _time_train is pure
-    loop overhead — the round-2 verdict flagged a per-step float() sync
-    here; this sub-bench keeps it measured."""
+def _time_loop_vs_engine(model, cfg, *, trials: int = 2,
+                         iters: int = 10) -> dict:
+    """PRODUCTION loop (MinerLoop.run) vs the bare jitted step, measured as
+    INTERLEAVED engine/loop burst pairs: this rig's throughput drifts ~15%
+    run-to-run, so only the within-pair ratio is meaningful
+    (scripts/measure.sh rule 4). The gap is pure loop overhead — the
+    round-2 verdict flagged a per-step float() sync here; this sub-bench
+    keeps it measured."""
     from distributedtraining_tpu.engine import TrainEngine
     from distributedtraining_tpu.engine.train import MinerLoop
     from distributedtraining_tpu.transport import InMemoryTransport
 
     engine = TrainEngine(model, seq_len=SEQ)
+    state = engine.init_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    host_batch = {"input_ids": rng.integers(0, cfg.vocab_size, (BATCH, SEQ),
+                                            dtype=np.int32)}
+    dev_batch = {"input_ids": jnp.asarray(host_batch["input_ids"])}
+
     loop = MinerLoop(engine, InMemoryTransport(), "bench",
                      send_interval=1e9, check_update_interval=1e9,
                      log_every=10**9)
     loop.bootstrap(jax.random.PRNGKey(0))
-    rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (BATCH, SEQ),
-                                       dtype=np.int32)}
 
     def batches(n):
         for _ in range(n):
-            yield batch
+            yield host_batch
 
-    loop.run(batches(WARMUP), max_steps=WARMUP)   # warm (report syncs at exit)
-    t0 = time.perf_counter()
-    loop.run(batches(iters), max_steps=iters)     # exit fetch ends the timing
-    dt = time.perf_counter() - t0
+    def engine_burst() -> float:
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = engine.train_step(state, dev_batch)
+        float(m["loss"])  # see _time_train: only a fetch really blocks
+        return BATCH * SEQ * iters / (time.perf_counter() - t0)
+
+    def loop_burst() -> float:
+        t0 = time.perf_counter()
+        loop.run(batches(iters), max_steps=iters)  # exit fetch ends timing
+        return BATCH * SEQ * iters / (time.perf_counter() - t0)
+
+    # warm both programs (same HLO, but the loop path also warms bootstrap)
+    engine_burst()
+    loop_burst()
+    ratios, loop_tps = [], []
+    for _ in range(trials):
+        e = engine_burst()
+        lp = loop_burst()
+        ratios.append(lp / e)
+        loop_tps.append(lp)
     assert loop.report.last_loss == loop.report.last_loss, "loss is NaN"
-    return BATCH * SEQ * iters / dt
+    return {"loop_tokens_per_sec": round(float(np.mean(loop_tps)), 1),
+            "loop_vs_engine": round(float(np.mean(ratios)), 3)}
 
 
 def _param_count(model) -> int:
@@ -228,11 +252,9 @@ def main() -> None:
         extras["fused_loss_error"] = repr(e)
 
     try:
-        # production MinerLoop.run vs the bare engine step — loop overhead
-        # should be ≲2% (round-2 verdict item 4)
-        loop_tps = _time_loop(model, cfg)
-        extras["loop_tokens_per_sec"] = round(loop_tps, 1)
-        extras["loop_vs_engine"] = round(loop_tps / tokens_per_sec, 3)
+        # production MinerLoop.run vs the bare engine step, interleaved —
+        # loop overhead should be ≲2% (round-2 verdict item 4)
+        extras.update(_time_loop_vs_engine(model, cfg))
     except Exception as e:
         extras["loop_error"] = repr(e)
 

@@ -83,6 +83,7 @@ class RunConfig:
     tokenizer: str = "auto"                  # auto | byte | <hf name>
     fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
     scan_blocks: bool = False                # lax.scan the block stack
+    logits_dtype: Optional[str] = None       # "bfloat16": half-size logits buf
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
     accum_steps: int = 1                     # microbatches per optimizer step
 
@@ -232,6 +233,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="batches the background input thread keeps ready "
                         "(tokenize+pack ahead of the device; 0 disables, "
                         "the reference's DataLoader-workers equivalent)")
+    g.add_argument("--logits-dtype", dest="logits_dtype",
+                   choices=("float32", "bfloat16"), default=d.logits_dtype,
+                   help="storage dtype of the [batch, seq, vocab] logits "
+                        "buffer (the step's largest activation); MXU "
+                        "accumulation stays f32 either way, the loss still "
+                        "reduces in f32. bfloat16 halves its HBM round-trips")
     g.add_argument("--scan-blocks", dest="scan_blocks", action="store_true",
                    help="trace the transformer stack as one lax.scan'd "
                         "block (~n_layer-fold smaller program, much faster "

@@ -53,6 +53,15 @@ class PrefetchIterator:
                     return
             self._put(_SENTINEL)
         except BaseException as e:  # noqa: BLE001 - re-raised on consumer
+            if isinstance(e, StopIteration):
+                # PEP 479: a StopIteration leaking from the transform would
+                # masquerade as clean exhaustion on the consumer — surface
+                # it as the bug it is instead (cause-chained so the
+                # offending transform frame survives)
+                wrapped = RuntimeError(
+                    "prefetch source/transform raised StopIteration")
+                wrapped.__cause__ = e
+                e = wrapped
             self._put(e)
 
     def _put(self, item) -> None:
@@ -68,9 +77,17 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
-        if self._stop.is_set():
-            raise StopIteration
-        item = self._q.get()
+        # bounded get + stop re-check: a cross-thread close() can land after
+        # this thread committed to a get() — the worker's pending _put then
+        # drops its item and an unbounded get would never return
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                continue
         if item is _SENTINEL:
             self.close()
             raise StopIteration

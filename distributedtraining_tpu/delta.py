@@ -203,6 +203,12 @@ def weighted_merge_flat(base: Params, stacked_deltas: Params,
     add — a single kernel XLA tiles at near peak — and the unravel back to
     the tree is slice+reshape views fused into the same program. Same
     result, same differentiability w.r.t. ``weights``.
+
+    Transient-memory cost: the ``jnp.concatenate`` materializes a second
+    full [M, N] buffer (plus the f32 upcast of each row), roughly DOUBLING
+    peak HBM during the merge versus the leafwise spelling. Fine at the
+    124M bench scale it serves; do not promote it into the averager for
+    7B/8B full-delta merges without a per-leaf-group variant.
     """
     from jax.flatten_util import ravel_pytree
 

@@ -835,14 +835,20 @@ class MinerLoop:
                     self._ckpt_action.poll()
         finally:
             # finally: the KeyboardInterrupt shutdown path (neurons/miner.py)
-            # reads report.last_loss after an exceptional exit too.
-            # Best-effort: a failed/wedged backend must not replace the
-            # in-flight exception (that would skip the miner's flush()).
+            # reads report.last_loss after an exceptional exit too. On THAT
+            # path a failed fetch must not replace the in-flight exception
+            # (that would skip the miner's flush()); on a normal exit a
+            # fetch failure is a real error and propagates.
             if self._last_loss_dev is not None:
                 try:
                     self.report.last_loss = float(self._last_loss_dev)
                 except Exception:
-                    pass
+                    import sys
+                    if sys.exc_info()[0] is None:
+                        raise
+                    logger.warning(
+                        "miner %s: final loss fetch failed during "
+                        "exceptional shutdown", self.miner_id, exc_info=True)
         return self.report
 
     def flush(self) -> None:

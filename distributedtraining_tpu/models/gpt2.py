@@ -246,9 +246,12 @@ def make_model(preset_or_cfg) -> tuple[GPT2, GPT2Config]:
 def stack_blocks(params, n_layer: int, *, prefix: str = "h_",
                  scan_key: str = "h"):
     """Unrolled layout (``h_0..h_{L-1}``) -> scan layout (``h/block`` with a
-    leading [L] axis on every per-block leaf). The wire format, HF converters
-    (models/convert.py) and unrolled peers all speak the unrolled layout;
-    these two functions are the boundary adapters for ``scan_blocks`` runs."""
+    leading [L] axis on every per-block leaf). HF converters
+    (models/convert.py) and checkpoints adapt through these two functions;
+    live wire artifacts (deltas/bases) travel in whichever layout the
+    publishing role runs, so ALL roles of a deployment must agree on
+    ``--scan-blocks`` — a mismatch is diagnosed by name at the loader
+    (serialization._diagnose_block_layout_mismatch)."""
     blocks = [params[f"{prefix}{i}"] for i in range(n_layer)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
     out = {k: v for k, v in params.items()

@@ -90,9 +90,41 @@ def from_msgpack(data: bytes, template: Params | None = None,
     try:
         tree = flax_ser.from_state_dict(template, raw)
     except Exception as e:
-        raise PayloadError(f"structure mismatch: {e}") from e
+        hint = _diagnose_block_layout_mismatch(raw, template)
+        raise PayloadError(
+            f"structure mismatch: {e}" + (f" [{hint}]" if hint else "")) from e
     _check_leaf_shapes(tree, template)
     return tree
+
+
+def _diagnose_block_layout_mismatch(raw, template) -> str | None:
+    """Name the ONE structure mismatch with a config-flag cause: a
+    ``scan_blocks`` run's param tree stacks the transformer blocks under
+    ``h/block`` while unrolled runs carry ``h_0..h_{L-1}`` (models/gpt2.py
+    stack_blocks). A flag-mismatched peer's submission would otherwise be
+    rejected as an anonymous structure error (scored zero / dropped) with
+    nothing pointing at the mis-set flag."""
+    def layout(d):
+        if not isinstance(d, dict):
+            return None
+        if any(isinstance(k, str) and k.startswith("h_")
+               and k[2:].isdigit() for k in d):
+            return "unrolled (h_0..h_{L-1})"
+        h = d.get("h")
+        if isinstance(h, dict) and "block" in h:
+            return "stacked (h/block, scan_blocks)"
+        return None
+
+    try:
+        got = layout(raw)
+        want = layout(flax_ser.to_state_dict(template))
+    except Exception:
+        return None
+    if got and want and got != want:
+        return (f"payload uses the {got} block layout but this role expects "
+                f"{want} — the deployment's --scan-blocks settings disagree; "
+                f"all roles must run with the same flag")
+    return None
 
 
 # ---------------------------------------------------------------------------

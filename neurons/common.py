@@ -122,6 +122,8 @@ def build(cfg: RunConfig) -> Components:
     model_cfg = family.PRESETS[cfg.model]
     if cfg.scan_blocks:
         model_cfg = _dc.replace(model_cfg, scan_blocks=True)
+    if cfg.logits_dtype:
+        model_cfg = _dc.replace(model_cfg, logits_dtype=cfg.logits_dtype)
     model, model_cfg = family.make_model(model_cfg)
 
     mesh = None

@@ -160,6 +160,25 @@ def test_round2_flags_parse_into_config():
     assert d.prefetch_depth == 2
 
 
+def test_logits_dtype_flag_reaches_model_config(tmp_path):
+    """--logits-dtype parses into RunConfig AND lands on the model config
+    through neurons/common.build, like its siblings --scan-blocks and
+    --fused-loss (round-2 verdict: the knob existed but was unreachable
+    from the CLI)."""
+    from distributedtraining_tpu.config import RunConfig
+    from neurons import common
+
+    cfg = RunConfig.from_args("miner", _common(
+        tmp_path, "hotkey_0", ["--logits-dtype", "bfloat16"]))
+    assert cfg.logits_dtype == "bfloat16"
+    comps = common.build(cfg)
+    assert comps.model_cfg.logits_dtype == "bfloat16"
+    # default: the model preset's own dtype is left untouched
+    d = RunConfig.from_args("miner", _common(tmp_path, "hotkey_0"))
+    assert d.logits_dtype is None
+    assert common.build(d).model_cfg.logits_dtype == "float32"
+
+
 def test_validator_entry_refuses_without_vpermit(tmp_path):
     """hotkey_0 has miner stake (10 < vpermit limit 1000): the entry point
     must refuse up front unless --allow-no-vpermit is passed."""

@@ -120,3 +120,46 @@ def test_context_manager():
         assert next(it) == 0
     with pytest.raises(StopIteration):
         next(it)
+
+
+def test_cross_thread_close_unblocks_consumer():
+    """A consumer blocked in __next__ (empty queue, slow producer) must
+    return promptly when another thread calls close() — the single
+    unbounded get() used to sleep forever once the worker dropped its
+    pending put."""
+    gate = threading.Event()
+
+    def slow():
+        yield 0
+        gate.wait(10)  # park the producer so the consumer blocks
+        yield 1
+
+    it = prefetch(slow(), depth=1)
+    assert next(it) == 0
+    got = []
+
+    def consume():
+        try:
+            next(it)
+        except StopIteration:
+            got.append("stop")
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.2)        # consumer is now blocked in __next__
+    it.close()             # cross-thread close
+    t.join(timeout=5)
+    gate.set()
+    assert not t.is_alive(), "consumer stayed blocked after close()"
+    assert got == ["stop"]
+
+
+def test_transform_stopiteration_is_a_bug_not_exhaustion():
+    """PEP 479: StopIteration escaping the transform must surface as an
+    error, not masquerade as a clean end-of-stream."""
+    def tf(x):
+        raise StopIteration
+
+    it = prefetch(range(3), transform=tf)
+    with pytest.raises(RuntimeError, match="StopIteration"):
+        next(it)

@@ -151,3 +151,28 @@ def test_fuzz_mutated_payloads_never_crash():
     assert ser.from_safetensors(seeds[1], template) is not None
     assert signing.unwrap(seeds[2], signing.delta_context("hk"),
                           expected_pub=ident.public_bytes) is not None
+
+
+def test_scan_blocks_layout_mismatch_is_diagnosed():
+    """A payload in the scan (stacked h/block) layout loaded against an
+    unrolled template (or vice versa) must fail with a message naming the
+    --scan-blocks flag disagreement — not an anonymous structure error
+    (it used to be scored zero with nothing pointing at the mis-set flag)."""
+    import numpy as np
+
+    from distributedtraining_tpu import serialization as ser
+
+    unrolled = {"wte": np.zeros((4, 2), np.float32),
+                "h_0": {"w": np.ones((2, 2), np.float32)},
+                "h_1": {"w": np.ones((2, 2), np.float32)}}
+    stacked = {"wte": np.zeros((4, 2), np.float32),
+               "h": {"block": {"w": np.ones((2, 2, 2), np.float32)}}}
+
+    with pytest.raises(ser.PayloadError, match="scan-blocks"):
+        ser.from_msgpack(ser.to_msgpack(stacked), unrolled)
+    with pytest.raises(ser.PayloadError, match="scan-blocks"):
+        ser.from_msgpack(ser.to_msgpack(unrolled), stacked)
+    # an unrelated structure mismatch stays an anonymous structure error
+    with pytest.raises(ser.PayloadError) as ei:
+        ser.from_msgpack(ser.to_msgpack({"other": np.zeros(2)}), unrolled)
+    assert "scan-blocks" not in str(ei.value)
