@@ -59,7 +59,7 @@ def _peak_flops() -> float | None:
 
 
 def _time_train(model, cfg, *, iters: int = ITERS,
-                fused_loss: bool = False) -> float:
+                fused_loss: bool | str = False) -> float:
     """tokens/sec of the jitted train step (fwd+bwd+adamw) on one chip."""
     from distributedtraining_tpu.engine import TrainEngine
 
@@ -82,6 +82,56 @@ def _time_train(model, cfg, *, iters: int = ITERS,
     dt = time.perf_counter() - t0
     assert final_loss == final_loss, "loss is NaN"
     return BATCH * SEQ * iters / dt
+
+
+def _step_burst(model, cfg, *, fused_loss: bool | str = False):
+    """Build a reusable timed-burst closure over a fresh engine+state.
+    Used by the interleaved A/B comparisons: this rig drifts ~15%
+    run-to-run, so only within-pair ratios are meaningful
+    (scripts/measure.sh rule 4)."""
+    from distributedtraining_tpu.engine import TrainEngine
+
+    engine = TrainEngine(model, seq_len=SEQ, fused_loss=fused_loss)
+    box = {"state": engine.init_state(jax.random.PRNGKey(0))}
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)}
+
+    def burst(iters: int) -> float:
+        state = box["state"]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = engine.train_step(state, batch)
+        final = float(m["loss"])  # the only fetch that really blocks here
+        dt = time.perf_counter() - t0
+        box["state"] = state
+        assert final == final, "loss is NaN"
+        return BATCH * SEQ * iters / dt
+
+    return burst
+
+
+def _ab_pairs(burst_a, burst_b, *, trials: int = 2, iters: int = 10):
+    """Warm both, then alternate A/B bursts; returns the list of
+    (a_tps, b_tps) pairs."""
+    burst_a(WARMUP)
+    burst_b(WARMUP)
+    pairs = []
+    for _ in range(trials):
+        a = burst_a(iters)
+        b = burst_b(iters)
+        pairs.append((a, b))
+    return pairs
+
+
+def _ab_speedup(model_a, cfg_a, model_b, *, fused_b: bool | str = False
+                ) -> tuple[float, float]:
+    """Interleaved (b_tokens_per_sec_mean, b_over_a_speedup_mean)."""
+    burst_a = _step_burst(model_a, cfg_a)
+    burst_b = _step_burst(model_b, cfg_a, fused_loss=fused_b)
+    pairs = _ab_pairs(burst_a, burst_b)
+    return (float(np.mean([b for _, b in pairs])),
+            float(np.mean([b / a for a, b in pairs])))
 
 
 def _time_loop_vs_engine(model, cfg, *, trials: int = 2,
@@ -234,22 +284,36 @@ def main() -> None:
 
     extras = {}
     try:
-        dense_model, dense_cfg = gpt2.make_model(
+        # interleaved flash-vs-dense (variant = dense, so the headline
+        # flash_speedup is 1/ratio)
+        dense_model, _ = gpt2.make_model(
             gpt2.GPT2Config(attention_impl="dense"))
-        dense_tps = _time_train(dense_model, dense_cfg)
+        dense_tps, dense_ratio = _ab_speedup(model, cfg, dense_model)
         extras["dense_tokens_per_sec"] = round(dense_tps, 1)
-        extras["flash_speedup"] = round(tokens_per_sec / dense_tps, 3)
+        extras["flash_speedup"] = round(1.0 / dense_ratio, 3)
     except Exception as e:  # a failed sub-bench must not sink the headline
         extras["dense_error"] = repr(e)
 
     try:
-        # tiled-head CE that never materializes [B, T, V] logits — candidate
-        # default if it beats the standard path on-chip (docs/perf.md)
-        fused_tps = _time_train(model, cfg, fused_loss=True)
+        # tiled-head CE that never materializes [B, T, V] logits (lax.scan
+        # spelling, measured 0.93x at 124M in r2 — kept for comparison)
+        fused_tps, fused_ratio = _ab_speedup(model, cfg, model,
+                                             fused_b="scan")
         extras["fused_loss_tokens_per_sec"] = round(fused_tps, 1)
-        extras["fused_loss_speedup"] = round(fused_tps / tokens_per_sec, 3)
+        extras["fused_loss_speedup"] = round(fused_ratio, 3)
     except Exception as e:
         extras["fused_loss_error"] = repr(e)
+
+    try:
+        # the Pallas fused-CE kernels (ops/pallas_ce.py) — candidate default
+        # if they beat the standard path on-chip (docs/perf.md ceiling
+        # analysis: the f32 logits are cost #1)
+        pallas_tps, pallas_ratio = _ab_speedup(model, cfg, model,
+                                               fused_b="pallas")
+        extras["pallas_ce_tokens_per_sec"] = round(pallas_tps, 1)
+        extras["pallas_ce_speedup"] = round(pallas_ratio, 3)
+    except Exception as e:
+        extras["pallas_ce_error"] = repr(e)
 
     try:
         # production MinerLoop.run vs the bare engine step, interleaved —

@@ -20,6 +20,7 @@ hivetrain/training_manager.py:28-168, 345-433):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 from typing import Any, Callable, Iterable, Optional
 
@@ -113,10 +114,11 @@ def _default_lm_loss(model, params, batch):
     return causal_lm_loss(logits, batch["input_ids"], batch.get("loss_mask"))
 
 
-def _fused_lm_loss(model, params, batch):
+def _fused_lm_loss(model, params, batch, impl: str = "auto"):
     """Same contract as _default_lm_loss but the [B, T, V] logits never
     materialize: the model returns hidden states and the head matmul runs
-    tile-by-tile inside fused_linear_cross_entropy. Requires a model
+    tile-by-tile inside fused_linear_cross_entropy (``impl`` selects the
+    Pallas kernels or the portable lax.scan spelling). Requires a model
     exposing ``return_hidden`` with a [V, E] head param — ``lm_head``
     (Llama) or the tied ``wte`` (GPT-2)."""
     from ..ops.losses import fused_linear_cross_entropy
@@ -131,7 +133,7 @@ def _fused_lm_loss(model, params, batch):
     mask = batch.get("loss_mask")
     return fused_linear_cross_entropy(
         hidden[:, :-1, :], head, batch["input_ids"][:, 1:],
-        None if mask is None else mask[:, 1:])
+        None if mask is None else mask[:, 1:], impl=impl)
 
 
 class TrainEngine:
@@ -140,7 +142,7 @@ class TrainEngine:
     def __init__(self, model, *, optimizer: optax.GradientTransformation | None = None,
                  mesh=None, seq_len: int = 8,
                  loss_fn: Callable | None = None,
-                 fused_loss: bool = False,
+                 fused_loss: bool | str = False,
                  accum_steps: int = 1):
         """``loss_fn(model, params, batch) -> (mean_loss, count)`` overrides
         the causal-LM default — the toy classification harnesses
@@ -152,7 +154,8 @@ class TrainEngine:
         ``fused_loss=True`` swaps the built-in LM loss for the
         tiled-head variant (_fused_lm_loss) that never materializes the
         [B, T, V] logits — still the same LM task, so meshes remain
-        allowed.
+        allowed. A string value picks the implementation explicitly
+        ("pallas" | "scan"; True means "auto").
 
         ``accum_steps=N`` splits each batch into N microbatches inside the
         jitted step (lax.scan) and applies ONE token-weighted optimizer
@@ -169,7 +172,20 @@ class TrainEngine:
             if loss_fn is not None:
                 raise ValueError("fused_loss and a custom loss_fn are "
                                  "mutually exclusive")
-            loss_fn = _fused_lm_loss
+            impl = fused_loss if isinstance(fused_loss, str) else "auto"
+            if mesh is not None:
+                # pallas_call is not auto-partitionable under pjit: on a
+                # mesh the sharded-logits-free path is the scan spelling
+                # (GSPMD partitions its tiles fine). Explicit "pallas" on a
+                # mesh would need a shard_map wrapper that doesn't exist
+                # yet — refuse rather than compile something degenerate.
+                if impl == "pallas":
+                    raise ValueError(
+                        "fused_loss='pallas' is single-device for now; on a "
+                        "mesh use fused_loss=True/'scan' (the lax.scan "
+                        "spelling partitions under GSPMD)")
+                impl = "scan"
+            loss_fn = functools.partial(_fused_lm_loss, impl=impl)
         self.model = model
         self.tx = optimizer or default_optimizer()
         self.mesh = mesh
@@ -838,13 +854,16 @@ class MinerLoop:
             # reads report.last_loss after an exceptional exit too. On THAT
             # path a failed fetch must not replace the in-flight exception
             # (that would skip the miner's flush()); on a normal exit a
-            # fetch failure is a real error and propagates.
+            # fetch failure is a real error and propagates. The in-flight
+            # check must happen BEFORE the inner try — inside its except
+            # handler, sys.exc_info() reports the fetch failure itself.
+            import sys
+            exiting_exceptionally = sys.exc_info()[0] is not None
             if self._last_loss_dev is not None:
                 try:
                     self.report.last_loss = float(self._last_loss_dev)
                 except Exception:
-                    import sys
-                    if sys.exc_info()[0] is None:
+                    if not exiting_exceptionally:
                         raise
                     logger.warning(
                         "miner %s: final loss fetch failed during "

@@ -56,24 +56,37 @@ def perplexity(mean_loss: jax.Array) -> jax.Array:
 def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
                                labels: jax.Array,
                                loss_mask: Optional[jax.Array] = None,
-                               *, chunk: int = 4096
+                               *, chunk: int = 4096, impl: str = "auto"
                                ) -> tuple[jax.Array, jax.Array]:
     """Shifted-label CE of ``logits = hidden @ head_kernel.T`` WITHOUT ever
     materializing the [N, V] logits tensor.
 
     The standard path materializes f32 logits (GPT-2-124M at B8/T1024:
     ~1.6 GB per traversal, several traversals per step — the single largest
-    non-matmul HBM cost, docs/perf.md). Here the vocabulary is scanned in
-    ``chunk``-column tiles with a running (max, sumexp, label-logit) online
-    softmax — the same trick flash attention plays on the sequence axis,
-    applied to the vocab axis — and the backward pass recomputes each tile
-    (jax.checkpoint), trading one extra head-matmul of FLOPs for the logits
-    round-trips.
+    non-matmul HBM cost, docs/perf.md). Two spellings of the fix:
 
-    hidden: [..., E] activations ALREADY shifted/aligned to ``labels``
-    [...]; head_kernel: [V, E] (the tied wte); loss_mask like labels.
-    Returns (mean_loss, token_count), the causal_lm_loss contract.
+    - ``impl="pallas"`` (ops/pallas_ce.py): hand-written forward/backward
+      kernels with the logits tiles living in VMEM only — the preferred
+      path on TPU.
+    - ``impl="scan"``: vocabulary scanned in ``chunk``-column tiles with a
+      running (max, sumexp, label-logit) online softmax — the same trick
+      flash attention plays on the sequence axis, applied to the vocab
+      axis — the backward recomputing each tile via jax.checkpoint.
+      Portable (any backend), but pays scan/checkpoint overhead.
+
+    ``impl="auto"`` picks pallas when the backend/shape supports it, else
+    scan. hidden: [..., E] activations ALREADY shifted/aligned to
+    ``labels`` [...]; head_kernel: [V, E] (the tied wte); loss_mask like
+    labels. Returns (mean_loss, token_count), the causal_lm_loss contract.
     """
+    if impl == "auto":
+        from .pallas_ce import pallas_ce_available
+        impl = "pallas" if pallas_ce_available(hidden, head_kernel) else "scan"
+    if impl == "pallas":
+        from .pallas_ce import fused_ce_loss
+        return fused_ce_loss(hidden, head_kernel, labels, loss_mask)
+    if impl != "scan":
+        raise ValueError(f"unknown fused-CE impl {impl!r}")
     E = hidden.shape[-1]
     V = head_kernel.shape[0]
     n_chunks = -(-V // chunk)

@@ -1,0 +1,315 @@
+"""Pallas fused linear-cross-entropy for TPU: loss AND grads without ever
+materializing the [N, V] logits.
+
+The workload this accelerates is the reference's hot loop — HF-style
+shifted CE over a 50k vocabulary every miner step
+(hivetrain/training_manager.py:380-392). The standard XLA path writes the
+f32 [B, T, V] logits to HBM (GPT-2-124M at B8/T1024: ~1.6 GB) and
+traverses them several times across loss + backward; docs/perf.md names
+this the step's #1 non-matmul HBM cost. The lax.scan variant in
+ops/losses.py already avoids the buffer but pays an extra head-matmul
+recompute *and* loses MXU efficiency to scan/checkpoint overhead
+(measured 0.93x at 124M).
+
+This module is the Pallas spelling, flash-attention's trick applied to
+the vocab axis:
+
+- forward: one (rows x vocab-tiles) grid keeping a running online-softmax
+  (max, sumexp, label-logit) in VMEM; per-token loss plus the (m, s)
+  stats come out, the logits never leave registers/VMEM.
+- backward: two kernels, exactly like the library flash-attention split
+  (dq vs dk/dv): a row-major kernel recomputes each logits tile, forms
+  dz = (softmax - onehot) * g in-register and accumulates dh = dz @ W in
+  VMEM; a vocab-major kernel does the same recompute and accumulates
+  dW = dz^T @ h per vocab tile in f32.
+
+FLOP accounting vs the standard path: +1 head-matmul equivalent in the
+backward (the recompute, amortized across both kernels) in exchange for
+~all the logits HBM traffic. At 124M the head matmul is ~27% of step
+FLOPs, so the trade is near break-even on a single chip and improves
+with model size (head share shrinks) and vocab (traffic grows) — the
+measured A/B lives in bench.py / docs/perf.md.
+
+Stats/labels ride (rows, 128)-lane buffers (value broadcast across
+lanes), the same layout the library flash kernel uses for its l/m stats
+— narrow 1-lane blocks are the classic Mosaic lowering trap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30   # -inf stand-in without nan hazards (python float: a jnp
+               # scalar here would be a captured constant inside the kernels)
+_LANES = 128                # stat-vector lane padding (Mosaic-safe blocks)
+
+
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() not in ("tpu", "axon")
+    except Exception:
+        return True
+
+
+def pallas_ce_available(hidden: jax.Array, head_kernel: jax.Array) -> bool:
+    """True when the kernel path is expected to lower well: a real TPU
+    backend and a lane-aligned embedding dim. Anything else routes to the
+    lax.scan fallback in ops/losses.py."""
+    return (not _interpret()) and hidden.shape[-1] % _LANES == 0
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(h_ref, w_ref, y_ref, loss_ref, m_ref, s_ref, ll_ref, *, v_real):
+    """Grid (n_tiles, v_tiles), vocab innermost: the (m, s, label-logit)
+    running stats live in the revisited output blocks / scratch and are
+    finalized into per-token loss on the last vocab tile."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        ll_ref[:] = jnp.zeros_like(ll_ref)
+
+    z = jax.lax.dot_general(h_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    bv = z.shape[1]
+    col = j * bv + jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
+    z = jnp.where(col < v_real, z, _NEG)
+
+    m_old = m_ref[:, :1]
+    m_new = jnp.maximum(m_old, jnp.max(z, axis=1, keepdims=True))
+    s_new = (s_ref[:, :1] * jnp.exp(m_old - m_new)
+             + jnp.sum(jnp.exp(z - m_new), axis=1, keepdims=True))
+    y = y_ref[:, :1]
+    ll_new = ll_ref[:, :1] + jnp.sum(
+        jnp.where(col == y, z, 0.0), axis=1, keepdims=True)
+
+    lanes = m_ref.shape[1]
+    m_ref[:] = jnp.broadcast_to(m_new, (m_new.shape[0], lanes))
+    s_ref[:] = jnp.broadcast_to(s_new, (s_new.shape[0], lanes))
+    ll_ref[:] = jnp.broadcast_to(ll_new, (ll_new.shape[0], lanes))
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        loss_ref[:] = m_ref[:] + jnp.log(s_ref[:]) - ll_ref[:]
+
+
+def _dz_tile(h_ref, w_ref, y_ref, m_ref, s_ref, g_ref, j_v, *, v_real):
+    """Recompute one logits tile and form dz = (softmax - onehot) * g.
+    Shared by both backward kernels; returns dz in the compute dtype so
+    the following matmul runs at full MXU rate."""
+    z = jax.lax.dot_general(h_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    bv = z.shape[1]
+    col = j_v * bv + jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
+    p = jnp.where(col < v_real,
+                  jnp.exp(z - m_ref[:, :1]) / s_ref[:, :1], 0.0)
+    onehot = (col == y_ref[:, :1]).astype(jnp.float32)
+    return ((p - onehot) * g_ref[:, :1]).astype(h_ref.dtype)
+
+
+def _dh_kernel(h_ref, w_ref, y_ref, m_ref, s_ref, g_ref, dh_ref, acc, *,
+               v_real):
+    """Grid (n_tiles, v_tiles), vocab innermost: dh accumulates in an f32
+    VMEM scratch across vocab tiles, written once per row tile."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    dz = _dz_tile(h_ref, w_ref, y_ref, m_ref, s_ref, g_ref, j, v_real=v_real)
+    acc[:] += jax.lax.dot_general(dz, w_ref[:], (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        dh_ref[:] = acc[:].astype(dh_ref.dtype)
+
+
+def _dw_kernel(h_ref, w_ref, y_ref, m_ref, s_ref, g_ref, dw_ref, acc, *,
+               v_real):
+    """Grid (v_tiles, n_tiles), rows innermost: dW accumulates per vocab
+    tile in f32 VMEM, written once per vocab tile (padded-row tokens
+    arrive with g = 0 so they contribute nothing)."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    j = pl.program_id(0)
+    dz = _dz_tile(h_ref, w_ref, y_ref, m_ref, s_ref, g_ref, j, v_real=v_real)
+    acc[:] += jax.lax.dot_general(dz, h_ref[:], (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _():
+        dw_ref[:] = acc[:]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+def _stat_spec(bn):
+    return pl.BlockSpec((bn, _LANES), lambda i, j: (i, 0))
+
+
+def _fwd_call(h, w, y2, *, bn, bv, v_real, interpret):
+    n, e = h.shape
+    vp = w.shape[0]
+    grid = (n // bn, vp // bv)
+    out = jax.ShapeDtypeStruct((n, _LANES), jnp.float32)
+    kernel = functools.partial(_fwd_kernel, v_real=v_real)
+    loss, m, s = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, e), lambda i, j: (i, 0)),
+            pl.BlockSpec((bv, e), lambda i, j: (j, 0)),
+            _stat_spec(bn),
+        ],
+        out_specs=[_stat_spec(bn), _stat_spec(bn), _stat_spec(bn)],
+        out_shape=[out, out, out],
+        scratch_shapes=[pltpu.VMEM((bn, _LANES), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(h, w, y2)
+    return loss, m, s
+
+
+def _bwd_calls(h, w, y2, m, s, g2, *, bn, bv, v_real, interpret):
+    n, e = h.shape
+    vp = w.shape[0]
+    stat = _stat_spec(bn)
+
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, v_real=v_real),
+        grid=(n // bn, vp // bv),
+        in_specs=[
+            pl.BlockSpec((bn, e), lambda i, j: (i, 0)),
+            pl.BlockSpec((bv, e), lambda i, j: (j, 0)),
+            stat, stat, stat, stat,
+        ],
+        out_specs=pl.BlockSpec((bn, e), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, e), h.dtype),
+        scratch_shapes=[pltpu.VMEM((bn, e), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(h, w, y2, m, s, g2)
+
+    # vocab-major: same tile recompute, dW side (note the swapped grid —
+    # index maps address (row_tile, vocab_tile) as (grid1, grid0))
+    dw = pl.pallas_call(
+        functools.partial(_dw_kernel, v_real=v_real),
+        grid=(vp // bv, n // bn),
+        in_specs=[
+            pl.BlockSpec((bn, e), lambda j, i: (i, 0)),
+            pl.BlockSpec((bv, e), lambda j, i: (j, 0)),
+            pl.BlockSpec((bn, _LANES), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn, _LANES), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn, _LANES), lambda j, i: (i, 0)),
+            pl.BlockSpec((bn, _LANES), lambda j, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bv, e), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((vp, e), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bv, e), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(h, w, y2, m, s, g2)
+    return dh, dw
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp + public wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _per_token_ce(bn, bv, v_real, interpret, h, w, y2):
+    loss, _, _ = _fwd_call(h, w, y2, bn=bn, bv=bv, v_real=v_real,
+                           interpret=interpret)
+    return loss[:, 0]
+
+
+def _per_token_ce_fwd(bn, bv, v_real, interpret, h, w, y2):
+    loss, m, s = _fwd_call(h, w, y2, bn=bn, bv=bv, v_real=v_real,
+                           interpret=interpret)
+    return loss[:, 0], (h, w, y2, m, s)
+
+
+def _per_token_ce_bwd(bn, bv, v_real, interpret, res, g):
+    h, w, y2, m, s = res
+    g2 = jnp.broadcast_to(g.astype(jnp.float32)[:, None],
+                          (g.shape[0], _LANES))
+    dh, dw = _bwd_calls(h, w, y2, m, s, g2, bn=bn, bv=bv, v_real=v_real,
+                        interpret=interpret)
+    return dh, dw.astype(w.dtype), np.zeros(y2.shape, jax.dtypes.float0)
+
+
+_per_token_ce.defvjp(_per_token_ce_fwd, _per_token_ce_bwd)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def fused_ce_loss(hidden: jax.Array, head_kernel: jax.Array,
+                  labels: jax.Array,
+                  loss_mask: Optional[jax.Array] = None,
+                  *, block_n: int = 1024, block_v: int = 512,
+                  interpret: Optional[bool] = None
+                  ) -> tuple[jax.Array, jax.Array]:
+    """Drop-in for ops.losses.fused_linear_cross_entropy, Pallas path.
+
+    hidden: [..., E] activations ALREADY shifted/aligned to ``labels``
+    [...]; head_kernel: [V, E]; loss_mask like labels. Returns
+    (mean_loss, token_count) — the causal_lm_loss contract. Differentiable
+    w.r.t. hidden and head_kernel (custom_vjp, two backward kernels).
+    """
+    if interpret is None:
+        interpret = _interpret()
+    e = hidden.shape[-1]
+    v = head_kernel.shape[0]
+    h = hidden.reshape(-1, e)
+    y = labels.reshape(-1).astype(jnp.int32)
+    n = h.shape[0]
+
+    bn = min(block_n, _round_up(n, 16))
+    bv = min(block_v, _round_up(v, _LANES))
+    n_pad = _round_up(n, bn)
+    v_pad = _round_up(v, bv)
+    if n_pad > n:
+        h = jnp.pad(h, ((0, n_pad - n), (0, 0)))
+        y = jnp.pad(y, (0, n_pad - n))
+    w = head_kernel
+    if v_pad > v:
+        w = jnp.pad(w, ((0, v_pad - v), (0, 0)))
+    # the kernel compares label lanes against vocab columns; broadcast to
+    # the stat-lane layout once here (4 bytes/token/lane, trivial next to
+    # the saved logits)
+    y2 = jnp.broadcast_to(y[:, None], (n_pad, _LANES))
+
+    per_tok = _per_token_ce(bn, bv, v, interpret, h, w, y2)[:n]
+    per_tok = per_tok.reshape(labels.shape)
+    if loss_mask is not None:
+        msk = loss_mask.astype(per_tok.dtype)
+    else:
+        msk = jnp.ones_like(per_tok)
+    total = jnp.sum(per_tok * msk)
+    count = jnp.maximum(jnp.sum(msk), 1.0)
+    return total / count, count

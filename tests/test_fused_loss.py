@@ -197,3 +197,107 @@ def test_fused_loss_on_lora_engine():
                     jax.tree_util.tree_leaves(sf.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=5e-3, atol=5e-5)
+
+
+# ---------------------------------------------------------------------------
+# Pallas spelling (ops/pallas_ce.py) — runs in interpret mode off-TPU, so
+# the same numerics pins apply here; the on-chip execution record lives in
+# tests_tpu/test_step_variants_tpu.py.
+# ---------------------------------------------------------------------------
+
+def test_pallas_ce_matches_dense_value_and_grads():
+    """Forward value and BOTH grads against the materialized-logits oracle,
+    with a non-dividing vocab (padding path) and a loss mask."""
+    hidden, wte, labels = _case(V=300, E=64, N=24)
+    mask = jnp.asarray((np.random.default_rng(1).random(24) > 0.3)
+                       .astype(np.float32))
+
+    def dense(h, w):
+        logits = jnp.einsum("ne,ve->nv", h, w).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[..., 0]
+        per = logz - ll
+        return jnp.sum(per * mask) / jnp.sum(mask)
+
+    def pallas(h, w):
+        loss, _ = fused_linear_cross_entropy(h[None], w, labels[None],
+                                             mask[None], impl="pallas")
+        return loss
+
+    v0 = dense(hidden, wte)
+    v1 = pallas(hidden, wte)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-5)
+    gd = jax.grad(dense, argnums=(0, 1))(hidden, wte)
+    gp = jax.grad(pallas, argnums=(0, 1))(hidden, wte)
+    for name, a, b in zip(("dhidden", "dwte"), gp, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6, err_msg=name)
+
+
+def test_pallas_ce_bf16_hidden_f32_head():
+    """The production dtype mix: bf16 activations against the f32 head
+    param — dW must come back f32 (accumulated in f32 inside the kernel),
+    dh in bf16."""
+    hidden, wte, labels = _case(V=256, E=64, N=32, dtype=jnp.bfloat16)
+    wte = wte.astype(jnp.float32)
+
+    def pallas(h, w):
+        loss, _ = fused_linear_cross_entropy(h[None], w, labels[None],
+                                             impl="pallas")
+        return loss
+
+    def dense(h, w):
+        logits = jnp.einsum("ne,ve->nv", h, w.astype(h.dtype),
+                            preferred_element_type=jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[..., 0]
+        return jnp.mean(logz - ll)
+
+    gp = jax.grad(pallas, argnums=(0, 1))(hidden, wte)
+    gd = jax.grad(dense, argnums=(0, 1))(hidden, wte)
+    assert gp[0].dtype == jnp.bfloat16
+    assert gp[1].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gd[1]),
+                               rtol=2e-2, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(gp[0], np.float32), np.asarray(gd[0], np.float32),
+        rtol=5e-2, atol=5e-4)
+
+
+def test_pallas_engine_step_matches_standard():
+    """Full train step with fused_loss='pallas' (interpret mode here)
+    tracks the standard engine's loss trajectory."""
+    model, cfg = gpt2.make_model("tiny")
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=16)
+    rng = np.random.default_rng(0)
+    std = TrainEngine(model, seq_len=16)
+    pal = TrainEngine(model, seq_len=16, fused_loss="pallas")
+    s_std = std.init_state(params=params)
+    s_pal = pal.init_state(params=params)
+    for _ in range(3):
+        batch = {"input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)}
+        s_std, m_std = std.train_step(s_std, batch)
+        s_pal, m_pal = pal.train_step(s_pal, batch)
+        np.testing.assert_allclose(float(m_pal["loss"]),
+                                   float(m_std["loss"]), rtol=5e-4)
+
+
+def test_pallas_explicit_on_mesh_refused():
+    """pallas_call is not auto-partitionable under pjit: explicit
+    fused_loss='pallas' on a mesh must refuse loudly (auto/True silently
+    takes the scan spelling instead — test_fused_engine_on_mesh)."""
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+    model, _ = gpt2.make_model("tiny")
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2))
+    with pytest.raises(ValueError, match="single-device"):
+        TrainEngine(model, mesh=mesh, seq_len=16, fused_loss="pallas")
+
+
+def test_fused_auto_selects_scan_off_tpu():
+    """impl='auto' must not route through the Pallas kernels on a CPU
+    backend (interpret mode is for tests; production fallback is scan)."""
+    from distributedtraining_tpu.ops.pallas_ce import pallas_ce_available
+    hidden, wte, _ = _case(V=256, E=128, N=16)
+    assert pallas_ce_available(hidden, wte) is False
