@@ -194,17 +194,20 @@ class ParameterizedMerge:
         # mixture normalized and is the default here (documented deviation)
         self.softmax_weights = softmax_weights
 
-    def _build_step(self, base, stacked):
+    def _build_step(self, m_pad: int):
+        """``base``/``stacked`` flow through every jitted function as
+        ARGUMENTS, never closures: a closed-over concrete array is embedded
+        into the program as a constant, and an ingest-sharded stack loses
+        its sharding that way — the merge then silently replicates the full
+        M x params stack per device instead of compiling to local partial
+        sums + an ICI all-reduce (checked at the HLO level by
+        tests/test_parallel.py::test_parameterized_mesh_merge_lowers_to_allreduce)."""
         model = self.model
+
         # the stack may be zero-padded for even mesh sharding; weights are
         # normalized over the REAL miner count, then zero-padded to match
-        # (padding a softmax input instead would leak mass onto zero deltas).
-        # With an ingest-sharded stack, GSPMD compiles the sum over the
-        # sharded miner axis into local partial sums + an ICI all-reduce —
-        # the same collective psum_weighted_merge spells out explicitly.
-        m_pad = delta_lib.miner_axis_size(stacked)
-
-        def mixture(w):
+        # (padding a softmax input instead would leak mass onto zero deltas)
+        def mixture(w, base, stacked):
             if self.softmax_weights:
                 norm = (jax.tree_util.tree_map(
                             lambda x: jax.nn.softmax(x), w)
@@ -218,8 +221,8 @@ class ParameterizedMerge:
             return delta_lib.weighted_merge(
                 base, stacked, delta_lib.pad_merge_weights(norm, m_pad))
 
-        def loss_fn(w, batch):
-            params = mixture(w)
+        def loss_fn(w, base, stacked, batch):
+            params = mixture(w, base, stacked)
             logits = model.apply(
                 {"params": params}, batch["input_ids"],
                 attention_mask=batch.get("attention_mask"),
@@ -232,8 +235,8 @@ class ParameterizedMerge:
         tx = optax.sgd(self.meta_lr)
 
         @jax.jit
-        def meta_step(w, opt_state, batch):
-            loss, g = jax.value_and_grad(loss_fn)(w, batch)
+        def meta_step(w, opt_state, base, stacked, batch):
+            loss, g = jax.value_and_grad(loss_fn)(w, base, stacked, batch)
             updates, opt_state = tx.update(g, opt_state)
             w = optax.apply_updates(w, updates)
             return w, opt_state, loss
@@ -250,7 +253,8 @@ class ParameterizedMerge:
                  if self.per_tensor else init)
         else:
             w = delta_lib.init_merge_weights(base, m, per_tensor=self.per_tensor)
-        mixture, meta_step, tx = self._build_step(base, stacked)
+        mixture, meta_step, tx = self._build_step(
+            delta_lib.miner_axis_size(stacked))
         opt_state = tx.init(w)
         last = None
         for epoch in range(self.meta_epochs):
@@ -259,11 +263,12 @@ class ParameterizedMerge:
                 # `last` stays a device array inside the batch loop so the
                 # host never blocks on an individual meta-step; one float()
                 # per epoch (the log line) is the only sync point.
-                w, opt_state, last = meta_step(w, opt_state, batch)
+                w, opt_state, last = meta_step(w, opt_state, base, stacked,
+                                               batch)
             logger.info("meta-learning epoch %d/%d loss=%.4f",
                         epoch + 1, self.meta_epochs,
                         float("nan") if last is None else float(last))
-        merged = jax.jit(mixture)(w)
+        merged = jax.jit(mixture)(w, base, stacked)
         return merged, w
 
 

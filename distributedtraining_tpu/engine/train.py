@@ -173,6 +173,11 @@ class TrainEngine:
                 raise ValueError("fused_loss and a custom loss_fn are "
                                  "mutually exclusive")
             impl = fused_loss if isinstance(fused_loss, str) else "auto"
+            if impl not in ("auto", "pallas", "scan"):
+                # fail at construction, not minutes later inside the first
+                # train_step trace
+                raise ValueError(f"unknown fused_loss impl {impl!r}; "
+                                 "expected True, 'auto', 'pallas' or 'scan'")
             if mesh is not None:
                 # pallas_call is not auto-partitionable under pjit: on a
                 # mesh the sharded-logits-free path is the scan spelling
