@@ -21,6 +21,7 @@ faster than its own first measurement.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import time
@@ -66,7 +67,8 @@ def _time_train(model, cfg, *, iters: int = ITERS,
     return burst(iters)
 
 
-def _step_burst(model, cfg, *, fused_loss: bool | str = False):
+def _step_burst(model, cfg, *, fused_loss: bool | str = False,
+                batch_size: int = BATCH):
     """Build a reusable timed-burst closure over a fresh engine+state.
     The ONE home of this rig's fetch discipline: block_until_ready does
     not actually block on the axon backend, so every timing must end on a
@@ -79,7 +81,7 @@ def _step_burst(model, cfg, *, fused_loss: bool | str = False):
     box = {"state": engine.init_state(jax.random.PRNGKey(0))}
     rng = np.random.default_rng(0)
     batch = {"input_ids": jnp.asarray(
-        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)}
+        rng.integers(0, cfg.vocab_size, (batch_size, SEQ)), jnp.int32)}
 
     def burst(iters: int) -> float:
         state = box["state"]
@@ -90,7 +92,7 @@ def _step_burst(model, cfg, *, fused_loss: bool | str = False):
         dt = time.perf_counter() - t0
         box["state"] = state
         assert final == final, "loss is NaN"
-        return BATCH * SEQ * iters / dt
+        return batch_size * SEQ * iters / dt
 
     return burst
 
@@ -292,6 +294,27 @@ def main() -> None:
     except Exception as e:
         extras["loop_error"] = repr(e)
 
+    try:
+        # --scan-blocks on-chip throughput (round-2 pending lever: compile
+        # time is the known 38x win; per-step cost expected ~neutral)
+        scan_model, _ = gpt2.make_model(dataclasses.replace(cfg, scan_blocks=True))
+        scan_tps, scan_ratio = _ab_speedup(base_burst, scan_model, cfg)
+        extras["scan_blocks_tokens_per_sec"] = round(scan_tps, 1)
+        extras["scan_blocks_speedup"] = round(scan_ratio, 3)
+    except Exception as e:
+        extras["scan_blocks_error"] = repr(e)
+
+    try:
+        # logits_dtype=bfloat16: halves the largest activation buffer's HBM
+        # round-trips (round-2 pending lever)
+        b16_model, _ = gpt2.make_model(
+            dataclasses.replace(cfg, logits_dtype="bfloat16"))
+        b16_tps, b16_ratio = _ab_speedup(base_burst, b16_model, cfg)
+        extras["logits_bf16_tokens_per_sec"] = round(b16_tps, 1)
+        extras["logits_bf16_speedup"] = round(b16_ratio, 3)
+    except Exception as e:
+        extras["logits_bf16_error"] = repr(e)
+
     peak = _peak_flops()
     if peak:
         n_params = _param_count(model)
@@ -305,6 +328,38 @@ def main() -> None:
         extras.update(_time_merge(model))
     except Exception as e:
         extras["merge_error"] = repr(e)
+
+    try:
+        # MFU scale point (round-2 verdict item 7): config 3's model on one
+        # chip, scan-blocks for compile safety on the deeper stack
+        cfg355 = dataclasses.replace(gpt2.PRESETS["gpt2-355m"], scan_blocks=True)
+        m355, _ = gpt2.make_model(cfg355)
+        tps355 = _time_train(m355, cfg355, iters=8)
+        extras["gpt2_355m_tokens_per_sec"] = round(tps355, 1)
+        if peak:
+            fpt = (6 * _param_count(m355)
+                   + 12 * cfg355.n_layer * cfg355.n_embd * SEQ)
+            extras["gpt2_355m_mfu"] = round(tps355 * fpt / peak, 4)
+    except Exception as e:
+        extras["gpt2_355m_error"] = repr(e)
+
+    if os.environ.get("DT_BENCH_B16"):
+        # batch 16 via scan-blocks — the round-2 blocked MFU experiment.
+        # Opt-in: a batch-16 compile once wedged this rig's tunnel for 8 h
+        # (docs/perf.md), so the driver's unattended run never attempts it;
+        # run manually via DT_BENCH_B16=1 after a healthy probe.
+        try:
+            scan_model, _ = gpt2.make_model(
+                dataclasses.replace(cfg, scan_blocks=True))
+            b16 = _step_burst(scan_model, cfg, batch_size=16)
+            b16(WARMUP)
+            tps_b16 = b16(ITERS)
+            extras["batch16_scan_tokens_per_sec"] = round(tps_b16, 1)
+            if peak:
+                extras["batch16_scan_mfu"] = round(
+                    tps_b16 * flops_per_token / peak, 4)
+        except Exception as e:
+            extras["batch16_error"] = repr(e)
 
     print(json.dumps({
         "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",

@@ -116,6 +116,8 @@ class RunConfig:
 
     # -- observability ------------------------------------------------------
     metrics_path: Optional[str] = None       # JSONL sink
+    log_every: int = 1000                    # train steps between metric logs
+                                             # (ref :394-402)
     mlflow_uri: Optional[str] = None
     profile_dir: Optional[str] = None        # jax.profiler trace capture
     profile_steps: int = 5                   # train steps per capture
@@ -130,6 +132,14 @@ class RunConfig:
         kw = {k: v for k, v in vars(ns).items() if k in fields}
         kw.pop("mesh", None)
         return cls(role=role, mesh=mesh, **kw)
+
+
+def _dataset_arg(value: str) -> str:
+    if value in ("auto", "wikitext", "synthetic") or \
+            value.startswith("files:"):
+        return value
+    raise argparse.ArgumentTypeError(
+        f"{value!r}: expected auto, wikitext, synthetic, or files:<glob>")
 
 
 def build_parser(role: str) -> argparse.ArgumentParser:
@@ -216,9 +226,13 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "validator/averager accept adapter submissions")
     g.add_argument("--lora-alpha", dest="lora_alpha", type=float,
                    default=d.lora_alpha)
-    g.add_argument("--dataset", choices=("auto", "wikitext", "synthetic"),
-                   default=d.dataset)
-    g.add_argument("--tokenizer", default=d.tokenizer)
+    g.add_argument("--dataset", default=d.dataset, type=_dataset_arg,
+                   help="auto | wikitext | synthetic | files:<glob> (local "
+                        "text files as the corpus; real data with zero "
+                        "egress)")
+    g.add_argument("--tokenizer", default=d.tokenizer,
+                   help="auto | byte | word (corpus-fit word vocab, "
+                        "deterministic per corpus) | <hf tokenizer name>")
     g.add_argument("--fused-loss", dest="fused_loss", action="store_true",
                    help="compute the LM loss with a tiled head matmul that "
                         "never materializes the [batch, seq, vocab] logits "
@@ -309,6 +323,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
 
     g = p.add_argument_group("observability")
     g.add_argument("--metrics-path", dest="metrics_path", default=None)
+    if role == "miner":
+        g.add_argument("--log-every", dest="log_every", type=int,
+                       default=d.log_every,
+                       help="train steps between metric-sink logs (each log "
+                            "syncs the device loss to the host)")
     g.add_argument("--mlflow-uri", dest="mlflow_uri", default=None)
     if role == "miner":  # only the miner's train loop ticks TraceCapture
         g.add_argument("--profile-dir", dest="profile_dir", default=None,

@@ -9,10 +9,11 @@ fully static shapes.
 """
 
 from .packing import pack_documents, PackedBatch
-from .datasets import ByteTokenizer, load_tokenizer, text_corpus, batch_iterator
+from .datasets import (ByteTokenizer, WordTokenizer, load_tokenizer,
+                       text_corpus, batch_iterator)
 from .prefetch import PrefetchIterator, prefetch
 from .vision import image_batches, synthetic_images
 
-__all__ = ["pack_documents", "PackedBatch", "ByteTokenizer", "load_tokenizer",
-           "text_corpus", "batch_iterator", "image_batches",
+__all__ = ["pack_documents", "PackedBatch", "ByteTokenizer", "WordTokenizer",
+           "load_tokenizer", "text_corpus", "batch_iterator", "image_batches",
            "synthetic_images", "PrefetchIterator", "prefetch"]

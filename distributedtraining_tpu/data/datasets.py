@@ -11,6 +11,8 @@ structure).
 from __future__ import annotations
 
 import hashlib
+import os
+import re
 from typing import Iterable, Iterator, Sequence
 
 import numpy as np
@@ -63,8 +65,13 @@ _WORDS = ("the of and to in is was for on that with as by at from it an be "
 def text_corpus(*, split: str = "train", n_docs: int = 256,
                 seed: int = 0, source: str = "auto") -> list[str]:
     """Document list. source="wikitext" forces HF wikitext-103 (needs cache);
-    "synthetic" forces the offline corpus; "auto" tries wikitext then falls
-    back."""
+    "synthetic" forces the offline corpus; "files:<glob>" reads local text
+    files (real natural-language data with zero egress — the E2E protocol
+    run trains on it, scripts/e2e_round.py); "auto" tries wikitext then
+    falls back to synthetic."""
+    if source.startswith("files:"):
+        return _files_corpus(source[len("files:"):], split=split,
+                             n_docs=n_docs)
     if source in ("auto", "wikitext"):
         try:
             from datasets import load_dataset
@@ -90,6 +97,81 @@ def text_corpus(*, split: str = "train", n_docs: int = 256,
                 words[j] = words[j - 1]
         docs.append(" ".join(words) + ".")
     return docs
+
+
+def _files_corpus(pattern: str, *, split: str, n_docs: int) -> list[str]:
+    """Paragraph documents from local text files matching a glob (the
+    reference's wikitext role, filled by whatever real text the machine
+    has). Deterministic: files sorted by path, paragraphs in file order,
+    and the train/test split is a stable 9:1 interleave by paragraph index
+    so the two splits never share a document."""
+    import glob as _glob
+
+    paths = sorted(p for p in _glob.glob(pattern, recursive=True)
+                   if os.path.isfile(p))
+    if not paths:
+        raise FileNotFoundError(f"files corpus: nothing matches {pattern!r}")
+    docs: list[str] = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        for para in text.split("\n\n"):
+            para = para.strip()
+            # drop trivial fragments: a one-line header teaches nothing and
+            # wastes a packed row boundary
+            if len(para) >= 200:
+                docs.append(para)
+    if not docs:
+        raise ValueError(f"files corpus: no >=200-char paragraphs under "
+                         f"{pattern!r}")
+    keep = (lambda i: i % 10 != 9) if split == "train" else \
+           (lambda i: i % 10 == 9)
+    return [d for i, d in enumerate(docs) if keep(i)][:n_docs]
+
+
+# the ONE tokenization rule WordTokenizer fits and encodes with — fit and
+# encode must split identically or fit-corpus words stop mapping to their
+# own ids
+_WORD_RE = re.compile(r"\w+|[^\w\s]")
+
+
+class WordTokenizer:
+    """Frequency-ranked word-level tokenizer fit on a corpus.
+
+    The real GPT-2 BPE needs vocab/merges artifacts this zero-egress
+    environment cannot fetch; this is the honest stand-in that still
+    exercises a REALISTIC id distribution over the full model vocabulary
+    (the byte fallback touches only 257 of GPT-2's 50257 embedding rows).
+    Deterministic: every role fitting on the same corpus builds the
+    identical vocab, which is what keeps miner/validator/averager
+    tokenization consistent without a shared artifact.
+    """
+
+    pad_id = 0
+    _UNK = 1
+
+    def __init__(self, docs: Iterable[str], *, vocab_size: int = 50257):
+        import collections
+
+        counts: collections.Counter = collections.Counter()
+        for d in docs:
+            counts.update(_WORD_RE.findall(d))
+        # stable rank: by (-count, word) so ties don't depend on dict order
+        ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        self._id = {w: i + 2 for i, (w, _) in
+                    enumerate(ranked[: vocab_size - 2])}
+        self._word = {i: w for w, i in self._id.items()}
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str) -> list[int]:
+        return [self._id.get(w, self._UNK) for w in _WORD_RE.findall(text)]
+
+    def decode(self, ids) -> str:
+        return " ".join(self._word.get(i, "<unk>") for i in ids
+                        if i != self.pad_id)
 
 
 def shuffle_seed_for(identity: str) -> int:

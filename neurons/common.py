@@ -270,6 +270,14 @@ def build(cfg: RunConfig) -> Components:
     if cfg.tokenizer == "byte" or (cfg.tokenizer == "auto"
                                    and model_cfg.vocab_size < 50257):
         tokenizer = ByteTokenizer()
+    elif cfg.tokenizer == "word":
+        # corpus-fit word vocab, deterministic per corpus: every role of a
+        # deployment rebuilds the identical mapping with no shared artifact
+        # (the offline stand-in for the GPT-2 BPE — scripts/e2e_round.py)
+        from distributedtraining_tpu.data import WordTokenizer
+        tokenizer = WordTokenizer(
+            text_corpus(split="train", source=cfg.dataset),
+            vocab_size=model_cfg.vocab_size)
     else:
         tokenizer = load_tokenizer(
             "gpt2" if cfg.tokenizer == "auto" else cfg.tokenizer)

@@ -57,7 +57,7 @@ def main(argv=None) -> int:
         loop = LoRAMinerLoop(engine, c.transport, cfg.hotkey,
                              send_interval=cfg.send_interval,
                              check_update_interval=cfg.check_update_interval,
-                             metrics=c.metrics,
+                             metrics=c.metrics, log_every=cfg.log_every,
                              checkpoint_store=store,
                              checkpoint_interval=cfg.checkpoint_interval,
                              trace=trace)
@@ -65,7 +65,7 @@ def main(argv=None) -> int:
         loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
                          send_interval=cfg.send_interval,
                          check_update_interval=cfg.check_update_interval,
-                         metrics=c.metrics,
+                         metrics=c.metrics, log_every=cfg.log_every,
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval,
                          trace=trace)
