@@ -343,6 +343,31 @@ def main() -> None:
     except Exception as e:
         extras["gpt2_355m_error"] = repr(e)
 
+    if os.environ.get("DT_BENCH_BIGVOCAB"):
+        # the fused-CE crossover case: same 12-layer/768-wide body with a
+        # Llama-3-width vocabulary (128256), where the head matmul
+        # dominates the step — this pair decides whether pallas CE becomes
+        # the default for the large-vocab family. Opt-in like batch-16:
+        # the STANDARD-path baseline here materializes 4x1024x128256 f32
+        # logits, a bigger program than the batch-16 one that wedged the
+        # tunnel in r2 — never run it unattended (batch 4 keeps the
+        # activation footprint inside one v5e's HBM; the ratio is what
+        # matters, both sides see the same batch).
+        try:
+            cfg_bv = dataclasses.replace(cfg, vocab_size=128256)
+            m_bv, _ = gpt2.make_model(cfg_bv)
+            bv_burst = _step_burst(m_bv, cfg_bv, batch_size=4)
+            pairs = _ab_pairs(
+                bv_burst,
+                _step_burst(m_bv, cfg_bv, fused_loss="pallas",
+                            batch_size=4))
+            extras["bigvocab_pallas_tokens_per_sec"] = round(
+                float(np.mean([b for _, b in pairs])), 1)
+            extras["bigvocab_pallas_speedup"] = round(
+                float(np.mean([b / a for a, b in pairs])), 3)
+        except Exception as e:
+            extras["bigvocab_error"] = repr(e)
+
     if os.environ.get("DT_BENCH_B16"):
         # batch 16 via scan-blocks — the round-2 blocked MFU experiment.
         # Opt-in: a batch-16 compile once wedged this rig's tunnel for 8 h
