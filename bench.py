@@ -110,16 +110,22 @@ def _ab_pairs(burst_a, burst_b, *, trials: int = 2, iters: int = 10):
     return pairs
 
 
-def _ab_speedup(burst_a, model_b, cfg_b, *, fused_b: bool | str = False
-                ) -> tuple[float, float]:
+def _pair_stats(pairs) -> tuple[float, float]:
+    """(b_tokens_per_sec_mean, b_over_a_speedup_mean) of interleaved
+    pairs — the only statistics any A/B in this file reports."""
+    return (float(np.mean([b for _, b in pairs])),
+            float(np.mean([b / a for a, b in pairs])))
+
+
+def _ab_speedup(burst_a, model_b, cfg_b, *, fused_b: bool | str = False,
+                batch_size: int = BATCH) -> tuple[float, float]:
     """Interleaved (b_tokens_per_sec_mean, b_over_a_speedup_mean).
     ``burst_a`` is the shared, already-compiled baseline burst — rebuilding
     the identical standard engine per comparison would add redundant XLA
     compiles to a bench run whose timeout budget is counted in compiles."""
-    burst_b = _step_burst(model_b, cfg_b, fused_loss=fused_b)
-    pairs = _ab_pairs(burst_a, burst_b)
-    return (float(np.mean([b for _, b in pairs])),
-            float(np.mean([b / a for a, b in pairs])))
+    burst_b = _step_burst(model_b, cfg_b, fused_loss=fused_b,
+                          batch_size=batch_size)
+    return _pair_stats(_ab_pairs(burst_a, burst_b))
 
 
 def _time_loop_vs_engine(model, cfg, base_burst, *, trials: int = 2,
@@ -153,10 +159,9 @@ def _time_loop_vs_engine(model, cfg, base_burst, *, trials: int = 2,
 
     pairs = _ab_pairs(base_burst, loop_burst, trials=trials, iters=iters)
     assert loop.report.last_loss == loop.report.last_loss, "loss is NaN"
-    return {"loop_tokens_per_sec":
-                round(float(np.mean([b for _, b in pairs])), 1),
-            "loop_vs_engine":
-                round(float(np.mean([b / a for a, b in pairs])), 3)}
+    loop_tps, loop_ratio = _pair_stats(pairs)
+    return {"loop_tokens_per_sec": round(loop_tps, 1),
+            "loop_vs_engine": round(loop_ratio, 3)}
 
 
 def _param_count(model) -> int:
@@ -357,14 +362,10 @@ def main() -> None:
             cfg_bv = dataclasses.replace(cfg, vocab_size=128256)
             m_bv, _ = gpt2.make_model(cfg_bv)
             bv_burst = _step_burst(m_bv, cfg_bv, batch_size=4)
-            pairs = _ab_pairs(
-                bv_burst,
-                _step_burst(m_bv, cfg_bv, fused_loss="pallas",
-                            batch_size=4))
-            extras["bigvocab_pallas_tokens_per_sec"] = round(
-                float(np.mean([b for _, b in pairs])), 1)
-            extras["bigvocab_pallas_speedup"] = round(
-                float(np.mean([b / a for a, b in pairs])), 3)
+            bv_tps, bv_ratio = _ab_speedup(bv_burst, m_bv, cfg_bv,
+                                           fused_b="pallas", batch_size=4)
+            extras["bigvocab_pallas_tokens_per_sec"] = round(bv_tps, 1)
+            extras["bigvocab_pallas_speedup"] = round(bv_ratio, 3)
         except Exception as e:
             extras["bigvocab_error"] = repr(e)
 
