@@ -84,6 +84,7 @@ class RunConfig:
     fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
     scan_blocks: bool = False                # lax.scan the block stack
     logits_dtype: Optional[str] = None       # "bfloat16": half-size logits buf
+    delta_dtype: Optional[str] = None        # "bfloat16": half-size wire deltas
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
     accum_steps: int = 1                     # microbatches per optimizer step
 
@@ -247,6 +248,13 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="batches the background input thread keeps ready "
                         "(tokenize+pack ahead of the device; 0 disables, "
                         "the reference's DataLoader-workers equivalent)")
+    if role == "miner":  # only the miner publishes raw deltas
+        g.add_argument("--delta-dtype", dest="delta_dtype",
+                       choices=("float32", "bfloat16"), default=d.delta_dtype,
+                       help="wire dtype of published deltas; bfloat16 halves "
+                            "artifact bytes, transport bandwidth, and the "
+                            "averager's merge HBM (validators/averagers "
+                            "accept both, and merges accumulate in f32)")
     g.add_argument("--logits-dtype", dest="logits_dtype",
                    choices=("float32", "bfloat16"), default=d.logits_dtype,
                    help="storage dtype of the [batch, seq, vocab] logits "

@@ -35,9 +35,25 @@ def tree_add(a: Params, b: Params) -> Params:
     return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
 
 
-def compute_delta(trained: Params, base: Params) -> Params:
-    """delta = trained - base (the artifact a miner uploads)."""
-    return tree_sub(trained, base)
+def compute_delta(trained: Params, base: Params,
+                  wire_dtype: str | None = None) -> Params:
+    """delta = trained - base (the artifact a miner uploads).
+
+    ``wire_dtype="bfloat16"`` casts the result for the wire: half the
+    artifact bytes, transport bandwidth, and merge HBM. The precision
+    cost is bf16 rounding of the DELTA (not the weights) — ~0.4% relative
+    on an update the averager then mixes at f32 (weighted_merge upcasts).
+    A documented extension over the reference, which ships f32 torch
+    tensors (training_manager.py:417-422); receivers accept both
+    spellings (screen_delta ``extra_dtypes``), so publishers opt in
+    per-miner without a fleet-wide flag."""
+    d = tree_sub(trained, base)
+    if wire_dtype is None:
+        return d
+    dt = jnp.dtype(wire_dtype)
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating)
+        else x, d)
 
 
 def apply_delta(base: Params, delta: Params) -> Params:
@@ -74,15 +90,20 @@ def has_nonfinite(tree: Params) -> bool:
     return bool(jax.device_get(_any_nonfinite(tree)))
 
 
-def shapes_match(tree: Params, reference: Params, *, check_dtype: bool = False) -> bool:
+def shapes_match(tree: Params, reference: Params, *, check_dtype: bool = False,
+                 extra_dtypes: Sequence[str] = ()) -> bool:
     """True iff ``tree`` has the same structure and per-leaf shapes as ``reference``.
 
-    Used to reject malformed miner submissions before any compute touches them.
+    Used to reject malformed miner submissions before any compute touches
+    them. ``extra_dtypes`` lists alternate dtypes a FLOAT leaf may carry in
+    addition to the reference's own (the bf16 wire-delta spelling) — f64 or
+    integer substitutions stay rejected.
     """
     ts = jax.tree_util.tree_structure(tree)
     rs = jax.tree_util.tree_structure(reference)
     if ts != rs:
         return False
+    extra = {np.dtype(d) for d in extra_dtypes}
     for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(reference)):
         if tuple(np.shape(a)) != tuple(np.shape(b)):
             return False
@@ -92,21 +113,29 @@ def shapes_match(tree: Params, reference: Params, *, check_dtype: bool = False) 
             # check would pass vacuously.
             da = a.dtype if hasattr(a, "dtype") else np.asarray(a).dtype
             db = b.dtype if hasattr(b, "dtype") else np.asarray(b).dtype
-            if np.dtype(da) != np.dtype(db):
+            if np.dtype(da) != np.dtype(db) and not (
+                    np.dtype(da) in extra
+                    and np.issubdtype(np.dtype(db), np.floating)):
                 return False
     return True
 
 
 def screen_delta(delta: Params, base: Params, *, max_abs: float | None = None,
-                 check_dtype: bool = True) -> tuple[bool, str]:
+                 check_dtype: bool = True,
+                 extra_dtypes: Sequence[str] = ("bfloat16",)
+                 ) -> tuple[bool, str]:
     """Full admission screen for an untrusted delta.
 
     Returns (ok, reason). Checks structure/shape/dtype parity with the base,
     finiteness, and an optional magnitude cap (a crude poisoning guard the
     reference lacks). dtype parity matters: a f64/i64 submission would
-    silently promote the merge and double its memory.
+    silently promote the merge and double its memory. bf16 is accepted by
+    default wherever the base leaf is floating (the half-bytes wire
+    spelling of compute_delta(wire_dtype=...) — it cannot promote or grow
+    anything).
     """
-    if not shapes_match(delta, base, check_dtype=check_dtype):
+    if not shapes_match(delta, base, check_dtype=check_dtype,
+                        extra_dtypes=extra_dtypes):
         return False, "shape_mismatch"
     if has_nonfinite(delta):
         return False, "nonfinite"
@@ -185,8 +214,11 @@ def weighted_merge(base: Params, stacked_deltas: Params, weights: jax.Array) -> 
     hivetrain/averaging_logic.py:513-528).
     """
     def merge_leaf(b, d):
-        w = weights.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
-        return b + jnp.sum(w * d, axis=0)
+        # accumulate in the BASE's dtype (f32 for f32 params): a bf16 wire
+        # stack must not drag the weighted sum down to bf16. The upcast
+        # fuses into the multiply — no extra materialization.
+        w = weights.reshape((-1,) + (1,) * (d.ndim - 1)).astype(b.dtype)
+        return b + jnp.sum(w * d.astype(b.dtype), axis=0)
 
     return jax.tree_util.tree_map(merge_leaf, base, stacked_deltas)
 
@@ -233,8 +265,8 @@ def per_tensor_weighted_merge(base: Params, stacked_deltas: Params, weights: Par
     (num_models, num_params)).
     """
     def merge_leaf(b, d, w):
-        wv = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
-        return b + jnp.sum(wv * d, axis=0)
+        wv = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(b.dtype)
+        return b + jnp.sum(wv * d.astype(b.dtype), axis=0)
 
     return jax.tree_util.tree_map(merge_leaf, base, stacked_deltas, weights)
 

@@ -544,6 +544,7 @@ class MinerLoop:
                  metrics=None,
                  log_every: int = 1000,               # ref :394-402
                  nan_guard: bool = True,
+                 delta_dtype: str | None = None,      # bf16 wire deltas
                  checkpoint_store=None,
                  checkpoint_interval: float = 600.0,
                  trace=None):
@@ -556,6 +557,7 @@ class MinerLoop:
         self.trace = trace
         self.log_every = log_every
         self.nan_guard = nan_guard
+        self.delta_dtype = delta_dtype
         self.checkpoint_store = checkpoint_store
         self.report = MinerReport()
         # device-resident copy of the newest step's loss; fetched to
@@ -800,13 +802,16 @@ class MinerLoop:
         return fetched[0]
 
     # one program instead of an eager per-leaf op stream (each eager op on a
-    # cross-process mesh is its own collective program)
-    _compute_delta = staticmethod(jax.jit(delta_lib.compute_delta))
+    # cross-process mesh is its own collective program). wire_dtype is
+    # static (it changes the program), hence the static_argnames jit.
+    _compute_delta = staticmethod(
+        jax.jit(delta_lib.compute_delta, static_argnames=("wire_dtype",)))
 
     def _push_delta(self) -> None:
         if self.state is None:
             return
-        d = self._compute_delta(self.state.params, self.base_params)
+        d = self._compute_delta(self.state.params, self.base_params,
+                                wire_dtype=self.delta_dtype)
         if self.nan_guard and delta_lib.has_nonfinite(d):
             logger.warning("miner %s: delta has non-finite values, not pushing",
                            self.miner_id)

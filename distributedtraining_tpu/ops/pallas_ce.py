@@ -287,21 +287,24 @@ def fused_ce_loss(hidden: jax.Array, head_kernel: jax.Array,
     # VMEM-budgeted analysis values in the module docstring. Validate
     # eagerly: a bad value must fail with a named error, not burn a
     # TPU-access window on a cryptic Mosaic lowering failure.
-    for env, cur in (("DT_PALLAS_CE_BN", block_n), ("DT_PALLAS_CE_BV",
-                                                    block_v)):
+    def _env_block(env: str, default: int, mult: int, why: str) -> int:
         raw = os.environ.get(env)
-        if raw:
-            try:
-                val = int(raw)
-            except ValueError:
-                raise ValueError(f"{env}={raw!r} is not an integer") from None
-            if val <= 0 or val % 8:
-                raise ValueError(f"{env}={val} must be a positive "
-                                 "multiple of 8 (TPU sublane tiling)")
-            if env.endswith("BN"):
-                block_n = val
-            else:
-                block_v = val
+        if not raw:
+            return default
+        try:
+            val = int(raw)
+        except ValueError:
+            raise ValueError(f"{env}={raw!r} is not an integer") from None
+        if val <= 0 or val % mult:
+            raise ValueError(f"{env}={val} must be a positive multiple "
+                             f"of {mult} ({why})")
+        return val
+
+    # BN is a sublane dim (16 covers the strictest bf16 tiling); BV is the
+    # MINORMOST dim of the logits tiles — sub-128 lanes are the narrow-lane
+    # Mosaic trap the module docstring warns about
+    block_n = _env_block("DT_PALLAS_CE_BN", block_n, 16, "sublane tiling")
+    block_v = _env_block("DT_PALLAS_CE_BV", block_v, 128, "lane width")
     e = hidden.shape[-1]
     v = head_kernel.shape[0]
     h = hidden.reshape(-1, e)

@@ -119,8 +119,10 @@ def psum_weighted_merge(base: Params, stacked: Params, weights: jax.Array,
 
     def local_merge(b_tree, d_tree, w):
         def leaf(b, d):
-            wv = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
-            partial = jnp.sum(wv * d, axis=0)
+            # accumulate (and psum) in the base's dtype so a bf16 wire
+            # stack doesn't degrade the reduction — mirrors weighted_merge
+            wv = w.reshape((-1,) + (1,) * (d.ndim - 1)).astype(b.dtype)
+            partial = jnp.sum(wv * d.astype(b.dtype), axis=0)
             return b + jax.lax.psum(partial, axis)
         return jax.tree_util.tree_map(leaf, b_tree, d_tree)
 

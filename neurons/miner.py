@@ -66,6 +66,8 @@ def main(argv=None) -> int:
                          send_interval=cfg.send_interval,
                          check_update_interval=cfg.check_update_interval,
                          metrics=c.metrics, log_every=cfg.log_every,
+                         delta_dtype=(None if cfg.delta_dtype == "float32"
+                                      else cfg.delta_dtype),
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval,
                          trace=trace)

@@ -149,3 +149,42 @@ def test_per_tensor_merge():
     for a, b in zip(jax.tree_util.tree_leaves(merged),
                     jax.tree_util.tree_leaves(expect)):
         np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_bf16_wire_delta_screens_and_merges():
+    """compute_delta(wire_dtype='bfloat16'): half-size artifact accepted by
+    the default screen (f64/int substitutions stay rejected), applied with
+    f32 promotion, and merged with f32 accumulation."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtraining_tpu import delta
+
+    base = {"a": jnp.ones((8, 4), jnp.float32),
+            "b": jnp.zeros((3,), jnp.float32)}
+    trained = jax.tree_util.tree_map(lambda x: x + 0.01, base)
+    d16 = delta.compute_delta(trained, base, wire_dtype="bfloat16")
+    assert all(l.dtype == jnp.bfloat16
+               for l in jax.tree_util.tree_leaves(d16))
+
+    ok, reason = delta.screen_delta(d16, base)
+    assert ok, reason
+    # a f64 submission must still be rejected (promotion attack)
+    d64 = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float64), d16)
+    ok, reason = delta.screen_delta(d64, base)
+    assert not ok and reason == "shape_mismatch"
+
+    applied = delta.apply_delta(base, d16)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(applied))
+
+    # merge of an all-bf16 stack: output f32, values within bf16 rounding
+    # of the f32 merge (accumulation happens in f32 per merge_leaf)
+    d32 = delta.compute_delta(trained, base)
+    w = jnp.asarray([0.7, 0.3])
+    m16 = delta.weighted_merge(base, delta.stack_deltas([d16, d16]), w)
+    m32 = delta.weighted_merge(base, delta.stack_deltas([d32, d32]), w)
+    for a, b in zip(jax.tree_util.tree_leaves(m16),
+                    jax.tree_util.tree_leaves(m32)):
+        assert a.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2)

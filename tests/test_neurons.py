@@ -160,6 +160,34 @@ def test_round2_flags_parse_into_config():
     assert d.prefetch_depth == 2
 
 
+def test_bf16_delta_round(tmp_path):
+    """--delta-dtype bfloat16: the published delta is about half the f32
+    artifact's bytes, and the validator/averager accept and merge it
+    (screen + f32-accumulating merge)."""
+    f32_dir, bf16_dir = tmp_path / "f32", tmp_path / "bf16"
+    for d, extra in ((f32_dir, []), (bf16_dir, ["--delta-dtype", "bfloat16"])):
+        rc = miner.main(_common(
+            d, "hotkey_0",
+            ["--max-steps", "8", "--send-interval", "1e9",
+             "--checkpoint-interval", "0", *extra]))
+        assert rc == 0
+    f32_bytes = (f32_dir / "artifacts" / "deltas" / "hotkey_0.msgpack"
+                 ).stat().st_size
+    bf16_bytes = (bf16_dir / "artifacts" / "deltas" / "hotkey_0.msgpack"
+                  ).stat().st_size
+    assert bf16_bytes < 0.6 * f32_bytes, (bf16_bytes, f32_bytes)
+
+    rc = validator.main(_common(bf16_dir, "hotkey_91", ["--rounds", "1"]))
+    assert rc == 0
+    meta = json.loads((bf16_dir / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 0) > 0, \
+        "validator rejected the bf16 wire delta"
+    rc = averager.main(_common(
+        bf16_dir, "hotkey_99", ["--rounds", "1", "--strategy", "weighted"]))
+    assert rc == 0
+    assert (bf16_dir / "artifacts" / "base" / "averaged_model.msgpack").exists()
+
+
 def test_logits_dtype_flag_reaches_model_config(tmp_path):
     """--logits-dtype parses into RunConfig AND lands on the model config
     through neurons/common.build, like its siblings --scan-blocks and
