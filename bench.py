@@ -196,7 +196,7 @@ def _time_merge(model) -> dict:
     n_bytes = sum(l.size * l.dtype.itemsize
                   for l in jax.tree_util.tree_leaves(stacked))
 
-    def timed(merge_fn):
+    def timed(merge_fn, stack):
         @jax.jit
         def merge(params, stacked, w):
             merged = merge_fn(params, stacked, w)
@@ -207,24 +207,35 @@ def _time_merge(model) -> dict:
                         for l in jax.tree_util.tree_leaves(merged))
             return merged, probe
 
-        _, probe = merge(params, stacked, w)
+        _, probe = merge(params, stack, w)
         float(probe)  # warm + full sync
         t0 = time.perf_counter()
         for _ in range(MERGE_ITERS):
-            _, probe = merge(params, stacked, w)
+            _, probe = merge(params, stack, w)
         float(probe)
         return (time.perf_counter() - t0) / MERGE_ITERS
 
     out = {"merge_m": MERGE_M}
-    dt = timed(delta_lib.weighted_merge)
+    dt = timed(delta_lib.weighted_merge, stacked)
     out["merge_wallclock_s"] = round(dt, 4)
     out["merge_gbps"] = round(n_bytes / dt / 1e9, 1)
     try:
-        dt_flat = timed(delta_lib.weighted_merge_flat)
+        dt_flat = timed(delta_lib.weighted_merge_flat, stacked)
         out["merge_flat_wallclock_s"] = round(dt_flat, 4)
         out["merge_flat_gbps"] = round(n_bytes / dt_flat / 1e9, 1)
     except Exception as e:
         out["merge_flat_error"] = repr(e)
+    try:
+        # bf16 wire-delta stack (--delta-dtype bfloat16): the merge is
+        # bandwidth-bound, so halving the stack's bytes should land near
+        # 2x on wall-clock (accumulation stays f32 inside merge_leaf)
+        stacked16 = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), stacked)
+        dt16 = timed(delta_lib.weighted_merge, stacked16)
+        out["merge_bf16_wallclock_s"] = round(dt16, 4)
+        out["merge_bf16_speedup"] = round(dt / dt16, 3)
+    except Exception as e:
+        out["merge_bf16_error"] = repr(e)
     return out
 
 

@@ -407,9 +407,16 @@ def broadcast_optional_tree(host_template: Params, coordinator_fetch
     ok = bool(mhu.broadcast_one_to_all(np.asarray(t is not None, np.int32)))
     if not ok:
         return None
+    # normalize to the TEMPLATE's dtypes: broadcast_one_to_all needs every
+    # process to declare identical buffers, and only the coordinator knows
+    # what the wire actually carried (e.g. a bf16 --delta-dtype submission
+    # against this f32 template). Values upcast exactly; the bytes-path
+    # variants keep the wire savings, this fallback trades them for the
+    # collective's same-dtype contract.
     t = jax.tree_util.tree_map(
-        lambda x: np.asarray(jax.device_get(x)),
-        t if t is not None else host_template)
+        lambda x, ref: np.asarray(jax.device_get(x)).astype(
+            np.asarray(ref).dtype, copy=False),
+        t if t is not None else host_template, host_template)
     return mhu.broadcast_one_to_all(t)
 
 

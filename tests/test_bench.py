@@ -1,0 +1,76 @@
+"""bench.py's helpers at tiny scale.
+
+The driver runs bench.py unattended at round end on hardware this CI
+never sees; a broken helper means a silently lost measurement round, so
+the burst/A-B/merge plumbing is pinned here on the CPU backend with a
+tiny model (the numbers are meaningless off-TPU — only the mechanics and
+contracts are under test).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+from distributedtraining_tpu.models import gpt2
+
+
+@pytest.fixture()
+def tiny(monkeypatch):
+    monkeypatch.setattr(bench, "BATCH", 2)
+    monkeypatch.setattr(bench, "SEQ", 32)
+    monkeypatch.setattr(bench, "WARMUP", 1)
+    monkeypatch.setattr(bench, "MERGE_M", 3)
+    monkeypatch.setattr(bench, "MERGE_ITERS", 2)
+    model, cfg = gpt2.make_model(gpt2.GPT2Config(
+        n_layer=2, n_embd=64, n_head=2, vocab_size=256, n_positions=32))
+    return model, cfg
+
+
+def test_step_burst_contract(tiny):
+    model, cfg = tiny
+    burst = bench._step_burst(model, cfg)
+    a = burst(2)
+    b = burst(2)
+    assert a > 0 and b > 0
+    # state persists across bursts (the warm burst really warms)
+    burst16 = bench._step_burst(model, cfg, batch_size=4)
+    assert burst16(1) > 0
+
+
+def test_ab_speedup_and_pair_stats(tiny):
+    model, cfg = tiny
+    base = bench._step_burst(model, cfg)
+    base(1)
+    tps, ratio = bench._ab_speedup(base, model, cfg, fused_b="scan")
+    assert tps > 0 and ratio > 0
+    assert bench._pair_stats([(100.0, 50.0), (200.0, 100.0)]) == (75.0, 0.5)
+
+
+def test_loop_vs_engine_reports_both_keys(tiny):
+    model, cfg = tiny
+    base = bench._step_burst(model, cfg)
+    base(1)
+    out = bench._time_loop_vs_engine(model, cfg, base, trials=1, iters=2)
+    assert set(out) == {"loop_tokens_per_sec", "loop_vs_engine"}
+    assert out["loop_tokens_per_sec"] > 0
+
+
+def test_time_merge_reports_all_spellings(tiny):
+    model, cfg = tiny
+    out = bench._time_merge(model)
+    for key in ("merge_wallclock_s", "merge_gbps", "merge_flat_wallclock_s",
+                "merge_bf16_wallclock_s", "merge_bf16_speedup"):
+        assert key in out, out
+    assert out["merge_m"] == 3
+
+
+def test_peak_flops_ladder(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
+    assert bench._peak_flops() == 197e12
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v6e")
+    assert bench._peak_flops() == 918e12
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "")
+    # CPU backend, no env hint -> None (mfu omitted, bench still runs)
+    assert bench._peak_flops() is None
