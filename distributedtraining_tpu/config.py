@@ -85,6 +85,7 @@ class RunConfig:
     scan_blocks: bool = False                # lax.scan the block stack
     logits_dtype: Optional[str] = None       # "bfloat16": half-size logits buf
     delta_dtype: Optional[str] = None        # "bfloat16": half-size wire deltas
+    remat: Optional[bool] = None             # per-block rematerialization
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
     accum_steps: int = 1                     # microbatches per optimizer step
 
@@ -261,6 +262,15 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "buffer (the step's largest activation); MXU "
                         "accumulation stays f32 either way, the loss still "
                         "reduces in f32. bfloat16 halves its HBM round-trips")
+    g.add_argument("--remat", dest="remat", action="store_true",
+                   default=None,
+                   help="jax.checkpoint each transformer block: activation "
+                        "HBM of one block instead of the whole stack, one "
+                        "extra forward of FLOPs (the 7B/8B configs' knob; "
+                        "Llama presets default on, GPT-2 off)")
+    g.add_argument("--no-remat", dest="remat", action="store_false",
+                   help="force rematerialization OFF (overrides a preset "
+                        "that defaults on)")
     g.add_argument("--scan-blocks", dest="scan_blocks", action="store_true",
                    help="trace the transformer stack as one lax.scan'd "
                         "block (~n_layer-fold smaller program, much faster "

@@ -124,6 +124,8 @@ def build(cfg: RunConfig) -> Components:
         model_cfg = _dc.replace(model_cfg, scan_blocks=True)
     if cfg.logits_dtype:
         model_cfg = _dc.replace(model_cfg, logits_dtype=cfg.logits_dtype)
+    if cfg.remat is not None:   # tri-state: None = keep the preset's default
+        model_cfg = _dc.replace(model_cfg, remat=cfg.remat)
     model, model_cfg = family.make_model(model_cfg)
 
     mesh = None

@@ -197,14 +197,20 @@ def test_logits_dtype_flag_reaches_model_config(tmp_path):
     from neurons import common
 
     cfg = RunConfig.from_args("miner", _common(
-        tmp_path, "hotkey_0", ["--logits-dtype", "bfloat16"]))
-    assert cfg.logits_dtype == "bfloat16"
+        tmp_path, "hotkey_0", ["--logits-dtype", "bfloat16", "--remat"]))
+    assert cfg.logits_dtype == "bfloat16" and cfg.remat is True
     comps = common.build(cfg)
     assert comps.model_cfg.logits_dtype == "bfloat16"
-    # default: the model preset's own dtype is left untouched
+    assert comps.model_cfg.remat is True
+    # default: the model preset's own dtype/remat are left untouched
     d = RunConfig.from_args("miner", _common(tmp_path, "hotkey_0"))
-    assert d.logits_dtype is None
-    assert common.build(d).model_cfg.logits_dtype == "float32"
+    assert d.logits_dtype is None and d.remat is None
+    dc = common.build(d).model_cfg
+    assert dc.logits_dtype == "float32" and dc.remat is False
+    # tri-state: --no-remat overrides a preset that defaults ON
+    n = RunConfig.from_args("miner", _common(
+        tmp_path, "hotkey_0", ["--no-remat"]))
+    assert n.remat is False
 
 
 def test_validator_entry_refuses_without_vpermit(tmp_path):
