@@ -107,6 +107,7 @@ class RunConfig:
 
     # -- averager strategy --------------------------------------------------
     strategy: str = "parameterized"          # weighted | parameterized | genetic
+    merge_chunk: int = 8                     # weighted-merge device chunk
     meta_epochs: int = 7                     # averager.py:106
     meta_lr: float = 0.01
     outer_momentum: float = 0.0              # >0 wraps strategy in OuterOptMerge
@@ -324,6 +325,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
         g.add_argument("--strategy",
                        choices=("weighted", "parameterized", "genetic"),
                        default=d.strategy)
+        g.add_argument("--merge-chunk", dest="merge_chunk", type=int,
+                       default=d.merge_chunk,
+                       help="deltas stacked on-device at a time in the "
+                            "weighted merge (device memory stays "
+                            "chunk x params however many miners submit)")
         g.add_argument("--meta-epochs", dest="meta_epochs", type=int,
                        default=d.meta_epochs)
         g.add_argument("--outer-momentum", dest="outer_momentum", type=float,

@@ -223,6 +223,11 @@ def weighted_merge(base: Params, stacked_deltas: Params, weights: jax.Array) -> 
     return jax.tree_util.tree_map(merge_leaf, base, stacked_deltas)
 
 
+# jitted once at module level: per-call jax.jit(weighted_merge) creates a
+# fresh function identity each time and retraces/recompiles every round
+weighted_merge_jit = jax.jit(weighted_merge)
+
+
 def weighted_merge_flat(base: Params, stacked_deltas: Params,
                         weights: jax.Array) -> Params:
     """``weighted_merge`` computed over one raveled buffer instead of
@@ -253,6 +258,46 @@ def weighted_merge_flat(base: Params, stacked_deltas: Params,
     merged_flat = base_flat + jnp.einsum(
         "m,mn->n", weights.astype(base_flat.dtype), stacked_flat)
     return unravel(merged_flat)
+
+
+def chunked_weighted_merge(base: Params, deltas: Sequence[Params],
+                           weights: jax.Array, *, chunk: int = 8) -> Params:
+    """``weighted_merge`` over a HOST-side delta list with bounded device
+    memory: at most ``chunk`` deltas are stacked on-device at a time.
+
+    Why it exists: the reference merges up to a whole subnet's submissions
+    (100 uids) by re-reading each from disk per batch
+    (averaging_logic.py:450-470) — unbounded M, terrible bandwidth. The
+    stacked merge is the fast spelling but materializes M x params on one
+    device: ~90 full GPT-2-124M deltas is ~45 GB, past any single chip's
+    HBM. This path accumulates chunk partial sums instead —
+    O(chunk x params) device memory, one compiled program for every chunk
+    (the last one is zero-padded to the same shape), identical math.
+    The mesh averager doesn't need it (the miner axis is ingest-sharded
+    across devices, parallel/collectives.py).
+    """
+    m = len(deltas)
+    if m == 0:
+        raise ValueError("chunked_weighted_merge: empty delta list")
+    if weights.shape[0] != m:
+        raise ValueError(f"{weights.shape[0]} weights for {m} deltas")
+    chunk = max(1, min(chunk, m))
+    # the accumulator step IS weighted_merge (acc + sum w_i d_i), reused
+    # through the module-level jitted spelling so repeated averaging
+    # rounds hit the same compiled program instead of retracing
+    merged = base
+    zero = None
+    for i in range(0, m, chunk):
+        part = list(deltas[i:i + chunk])
+        if len(part) < chunk:
+            # pad with zero deltas so every chunk compiles to ONE program
+            if zero is None:
+                zero = zeros_like(part[0])
+            part = part + [zero] * (chunk - len(part))
+        merged = weighted_merge_jit(merged, stack_deltas(part),
+                                    pad_merge_weights(weights[i:i + chunk],
+                                                      chunk))
+    return merged
 
 
 def per_tensor_weighted_merge(base: Params, stacked_deltas: Params, weights: Params) -> Params:

@@ -49,10 +49,22 @@ Params = Any
 class WeightedAverage:
     """Fixed-weight merge; weights default to validator consensus scores
     (the reference weighs each miner's delta by its normalized validator
-    score, averaging_logic.py:129-147)."""
+    score, averaging_logic.py:129-147).
 
-    def __init__(self, *, uniform: bool = False):
+    Single-chip ingestion is a HOST delta list merged ``chunk_size``
+    deltas at a time (delta.chunked_weighted_merge): device memory stays
+    O(chunk x params) however many miners submit — the reference's
+    whole-subnet case (up to 100 uids) would otherwise need an M x params
+    stack past one chip's HBM. A mesh averager keeps the sharded-stack
+    psum path instead."""
+
+    # tells AveragerLoop to hand over the raw host list on single-chip
+    # runs instead of materializing a full device stack
+    host_list_ingest = True
+
+    def __init__(self, *, uniform: bool = False, chunk_size: int = 8):
         self.uniform = uniform
+        self.chunk_size = chunk_size
 
     def merge(self, engine, base: Params, stacked: Params, miner_ids: list[str],
               *, val_batches=None, consensus: dict[str, float] | None = None
@@ -71,8 +83,11 @@ class WeightedAverage:
             from ..parallel.collectives import merge_axis, psum_weighted_merge
             merged = psum_weighted_merge(base, stacked, w, engine.mesh,
                                          axis=merge_axis(engine.mesh))
+        elif isinstance(stacked, list):
+            merged = delta_lib.chunked_weighted_merge(
+                base, stacked, w, chunk=self.chunk_size)
         else:
-            merged = jax.jit(delta_lib.weighted_merge)(base, stacked, w)
+            merged = delta_lib.weighted_merge_jit(base, stacked, w)
         return merged, w
 
 
@@ -92,6 +107,12 @@ class OuterOptMerge:
         new_base  = base + outer_lr * (momentum * v_t + delta_t)   [nesterov]
                   = base + outer_lr * v_t                          [plain]
     """
+
+    @property
+    def host_list_ingest(self) -> bool:
+        """Forward the inner strategy's ingestion preference (the outer
+        step itself never touches the stack)."""
+        return getattr(self.inner, "host_list_ingest", False)
 
     def __init__(self, inner, *, outer_lr: float = 0.7,
                  momentum: float = 0.9, nesterov: bool = True,
@@ -466,6 +487,10 @@ class AveragerLoop:
             from ..parallel.collectives import merge_axis, stack_deltas_sharded
             stacked = stack_deltas_sharded(deltas, self.engine.mesh,
                                            axis=merge_axis(self.engine.mesh))
+        elif getattr(self.strategy, "host_list_ingest", False):
+            # the strategy bounds its own device memory (chunked merge) —
+            # handing it a full device stack would defeat that
+            stacked = deltas
         else:
             stacked = delta_lib.stack_deltas(deltas)
         if self._multi():

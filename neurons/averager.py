@@ -32,7 +32,7 @@ from neurons.common import build                               # noqa: E402
 
 def make_strategy(cfg: RunConfig, model):
     if cfg.strategy == "weighted":
-        strategy = WeightedAverage()
+        strategy = WeightedAverage(chunk_size=cfg.merge_chunk)
     elif cfg.strategy == "genetic":
         strategy = GeneticMerge()
     else:

@@ -188,3 +188,39 @@ def test_bf16_wire_delta_screens_and_merges():
                     jax.tree_util.tree_leaves(m32)):
         assert a.dtype == jnp.float32
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2)
+
+
+def test_chunked_weighted_merge_matches_stacked():
+    """Bounded-memory merge == stacked merge, including a chunk that does
+    not divide M (zero-padding path) and bf16 wire deltas in the list."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtraining_tpu import delta
+
+    base = {"a": jnp.ones((16, 8), jnp.float32),
+            "b": {"c": jnp.full((5,), 2.0, jnp.float32)}}
+    rng = np.random.default_rng(0)
+    deltas = []
+    for i in range(5):
+        d = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(rng.normal(0, 0.01, x.shape), x.dtype),
+            base)
+        if i == 3:  # one bf16 wire submission in the mix
+            d = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16), d)
+        deltas.append(d)
+    w = jnp.asarray([0.4, 0.1, 0.2, 0.2, 0.1])
+
+    want = delta.weighted_merge(base, delta.stack_deltas(deltas), w)
+    for chunk in (1, 2, 5, 8):
+        got = delta.chunked_weighted_merge(base, deltas, w, chunk=chunk)
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            assert a.dtype == b.dtype
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+    with pytest.raises(ValueError):
+        delta.chunked_weighted_merge(base, [], w)
+    with pytest.raises(ValueError):
+        delta.chunked_weighted_merge(base, deltas, w[:3])

@@ -466,3 +466,40 @@ def test_outer_opt_velocity_restores_sharded_on_mesh(setup, tmp_path):
     for b, v in zip(jax.tree_util.tree_leaves(base),
                     jax.tree_util.tree_leaves(s2.velocity)):
         assert v.sharding == b.sharding, (v.sharding, b.sharding)
+
+
+def test_chunked_averager_round_matches_stacked(setup, tmp_path):
+    """AveragerLoop + WeightedAverage hands the strategy the host delta
+    list (host_list_ingest) and chunked merging publishes the identical
+    base a full-device-stack merge would — with M deliberately not
+    dividing chunk_size."""
+    model, cfg, engine, train_batches, val_batches = setup
+    base = model.init_params(jax.random.PRNGKey(0))
+
+    def run(strategy):
+        transport = InMemoryTransport()
+        transport.publish_base(base)
+        for i in range(3):
+            d = jax.tree_util.tree_map(
+                lambda x, s=i + 1: 0.004 * s * jnp.ones_like(x), base)
+            transport.publish_delta(f"hotkey_{i}", d)
+        chain = LocalChain(str(tmp_path / f"c{id(strategy)}"),
+                           my_hotkey="hotkey_99", epoch_length=0)
+        avg = AveragerLoop(engine, transport, chain, strategy,
+                           val_batches=val_batches)
+        avg.bootstrap(jax.random.PRNGKey(0))
+        assert avg.run_round()
+        host = jax.tree_util.tree_map(
+            lambda x: np.zeros(x.shape, x.dtype),
+            jax.eval_shape(lambda: base))
+        return transport.fetch_base(host)[0]
+
+    chunked = run(WeightedAverage(chunk_size=2))       # 3 deltas, chunk 2
+    # control: a strategy WITHOUT host_list_ingest gets the full stack
+    class StackedWeighted(WeightedAverage):
+        host_list_ingest = False
+    stacked = run(StackedWeighted())
+    for a, b in zip(jax.tree_util.tree_leaves(chunked),
+                    jax.tree_util.tree_leaves(stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
