@@ -275,9 +275,10 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--scan-blocks", dest="scan_blocks", action="store_true",
                    help="trace the transformer stack as one lax.scan'd "
                         "block (~n_layer-fold smaller program, much faster "
-                        "XLA compiles on deep models); identical math, "
-                        "stacked per-block param layout -- all roles of a "
-                        "deployment must agree on this flag")
+                        "XLA compiles on deep models); identical math. "
+                        "Wire artifacts stay in the universal unrolled "
+                        "layout, so roles can flip this independently "
+                        "(LoRA mode excepted)")
 
     g = p.add_argument_group("mesh")
     g.add_argument("--dp", type=int, default=d.mesh.dp,

@@ -398,14 +398,18 @@ class AveragerLoop:
         return mesh_spans(self.engine)
 
     def _host_template(self):
+        """Cached WIRE-layout template for every transport read (see the
+        wire helpers in train.py — artifacts travel unrolled; wire_in
+        converts to this engine's internal layout)."""
         if self._host_template_cache is None:
-            from .train import host_zeros_template
-            self._host_template_cache = host_zeros_template(self.engine)
+            from .train import host_wire_template
+            self._host_template_cache = host_wire_template(self.engine)
         return self._host_template_cache
 
     def bootstrap(self, rng=None, params=None) -> None:
         """``params`` (value or zero-arg callable, e.g. a pretrained loader)
         seeds the genesis base; an already-published base always wins."""
+        from .train import wire_in, wire_out
         if self._multi():
             # coordinator-read + broadcast, like every pod transport read
             from .train import broadcast_base_fetch
@@ -416,7 +420,8 @@ class AveragerLoop:
         else:
             fetched = None
         if fetched is not None:
-            self.base_params, self._base_revision = fetched
+            self.base_params = wire_in(self.engine, fetched[0])
+            self._base_revision = fetched[1]
         else:
             given = None if callable(params) else params
             if given is None and callable(params):
@@ -429,22 +434,26 @@ class AveragerLoop:
             self.base_params = template
             # the averager owns the shared repo and publishes the first base
             # (averaging_logic.py:549-568); coordinator-gated on a pod
-            self._base_revision = self.transport.publish_base(template)
+            self._base_revision = self.transport.publish_base(
+                wire_out(self.engine, template))
         self.base_params = self.engine.place_params(self.base_params)
 
     def _fetch_delta(self, hotkey: str):
         from .lora_train import (adapter_template, fetch_delta_any,
                                  fetch_delta_any_broadcast)
+        from .train import wire_in
         if self.lora_cfg is not None and self._lora_template is None:
             self._lora_template = adapter_template(self.base_params,
                                                    self.lora_cfg)
         if self._multi():
-            return fetch_delta_any_broadcast(
+            d = fetch_delta_any_broadcast(
                 self.transport, hotkey, self._host_template(), self.lora_cfg,
                 lora_template=self._lora_template)
-        return fetch_delta_any(self.transport, hotkey, self.base_params,
-                               self.lora_cfg,
-                               lora_template=self._lora_template)
+        else:
+            d = fetch_delta_any(self.transport, hotkey,
+                                self._host_template(), self.lora_cfg,
+                                lora_template=self._lora_template)
+        return wire_in(self.engine, d)
 
     def gather_deltas(self) -> tuple[list[str], list[Params]]:
         if self._multi():
@@ -511,7 +520,9 @@ class AveragerLoop:
             self.metrics.log({"merged_loss": loss, "merged_ppl": ppl,
                               "accepted": len(ids)},
                              step=self.report.rounds)
-        self._base_revision = self.transport.publish_base(merged)
+        from .train import wire_out
+        self._base_revision = self.transport.publish_base(
+            wire_out(self.engine, merged))
         # round-spanning strategy state (e.g. OuterOptMerge velocity) commits
         # only once the new base is actually out
         commit = getattr(self.strategy, "commit", None)

@@ -149,7 +149,7 @@ class LoRAMinerLoop(MinerLoop):
                   params=None) -> None:
         """``params`` (value or zero-arg callable) seeds the frozen base when
         no base is published yet — see MinerLoop.bootstrap."""
-        from .train import host_zeros_template
+        from .train import wire_in
 
         if rng is not None:
             self._rng = rng
@@ -158,12 +158,11 @@ class LoRAMinerLoop(MinerLoop):
         if self._multi():
             fetched = self._fetch_base_broadcast()
         elif self.transport.base_revision() is not None:
-            fetched = self.transport.fetch_base(
-                host_zeros_template(self.engine))
+            fetched = self.transport.fetch_base(self._wire_template())
         else:
             fetched = None
         if fetched is not None:
-            base, rev = fetched
+            base, rev = wire_in(self.engine, fetched[0]), fetched[1]
             self._base_revision = rev
         else:
             init = params() if callable(params) else params
@@ -187,10 +186,11 @@ class LoRAMinerLoop(MinerLoop):
             rev = self.transport.base_revision()
             if rev is None or rev == self._base_revision:
                 return
-            fetched = self.transport.fetch_base(self.base_params)
+            fetched = self.transport.fetch_base(self._wire_template())
         if fetched is None:
             return
-        base, rev = fetched
+        from .train import wire_in
+        base, rev = wire_in(self.engine, fetched[0]), fetched[1]
         logger.info("lora miner %s: new base %s — resetting adapters + "
                     "optimizer", self.miner_id, rev and rev[:8])
         self.base_params = self.engine.place_params(base)

@@ -524,6 +524,54 @@ def host_zeros_template(engine) -> Params:
                                   engine.abstract_params())
 
 
+# -- wire layout ------------------------------------------------------------
+# Artifacts (bases, full-param deltas) ALWAYS travel in the UNROLLED block
+# layout (h_0..h_{L-1}); a scan_blocks run's stacked [L, ...] layout is a
+# local execution detail converted at the transport boundary by the three
+# helpers below. This is what makes --scan-blocks a per-role choice: a
+# fleet of independent miners cannot flip an execution flag in lockstep,
+# so a layout that leaked onto the wire would quarantine scan runs from
+# everyone else (the round-2 advisor's finding; the loader additionally
+# diagnoses a foreign stacked payload by name,
+# serialization._diagnose_block_layout_mismatch).
+
+def _scan_wire_adapters(model):
+    """(model_module, n_layer) when ``model`` runs the scan layout, else
+    None (unrolled models and toy models need no conversion)."""
+    cfg = getattr(model, "cfg", None)
+    if cfg is None or not getattr(cfg, "scan_blocks", False):
+        return None
+    from ..models import gpt2 as gpt2_mod
+    from ..models import llama as llama_mod
+    mod = llama_mod if isinstance(model, llama_mod.Llama) else gpt2_mod
+    return mod, int(cfg.n_layer)
+
+
+def wire_out(engine, tree: Params) -> Params:
+    """Internal layout -> wire (unrolled) layout. No-op off scan_blocks."""
+    ad = _scan_wire_adapters(engine.model)
+    if ad is None or tree is None:
+        return tree
+    mod, n = ad
+    return mod.unstack_blocks(tree, n)
+
+
+def wire_in(engine, tree: Params) -> Params:
+    """Wire (unrolled) layout -> internal layout. No-op off scan_blocks."""
+    ad = _scan_wire_adapters(engine.model)
+    if ad is None or tree is None:
+        return tree
+    mod, n = ad
+    return mod.stack_blocks(tree, n)
+
+
+def host_wire_template(engine) -> Params:
+    """host_zeros_template in the WIRE layout — the restore template every
+    transport read validates against (host numpy throughout; the unstack
+    is index views, no copies)."""
+    return wire_out(engine, host_zeros_template(engine))
+
+
 def _snapshot(params: Params) -> Params:
     """Independent copy of a param tree. The train step donates its input
     state (in-place buffer reuse on TPU), so the miner's base snapshot must
@@ -572,6 +620,10 @@ class MinerLoop:
         # float() would block the host on every step's completion and
         # serialize batch prep behind device compute)
         self._last_loss_dev = None
+        # cached wire-layout template (shapes fixed by the model config;
+        # rebuilding a full-model zeros tree per poll is O(model bytes) of
+        # pure allocation — same rationale as Validator._host_template)
+        self._wire_template_cache = None
 
         self.state: TrainState | None = None
         self.base_params: Params | None = None
@@ -646,13 +698,14 @@ class MinerLoop:
             # pod on divergent params
             fetched = self._fetch_base_broadcast()
         elif self.transport.base_revision() is not None:
-            fetched = self.transport.fetch_base(host_zeros_template(self.engine))
+            fetched = self.transport.fetch_base(self._wire_template())
         else:
             fetched = None
         if fetched is not None:
             base, rev = fetched
             self._base_revision = rev
-            self.state = self.engine.init_state(params=base)
+            self.state = self.engine.init_state(
+                params=wire_in(self.engine, base))
         else:
             init = params() if callable(params) else params
             if init is None:
@@ -671,7 +724,7 @@ class MinerLoop:
             rev = self.transport.base_revision()
             if rev is None or rev == self._base_revision:
                 return
-            fetched = self.transport.fetch_base(self.base_params)
+            fetched = self.transport.fetch_base(self._wire_template())
         if fetched is None:
             return
         params, rev = fetched
@@ -679,16 +732,23 @@ class MinerLoop:
                     self.miner_id, rev and rev[:8])
         # protocol semantics: optimizer state is discarded on base update
         # (training_manager.py:371-377)
-        self.state = self.engine.init_state(params=params)
+        self.state = self.engine.init_state(
+            params=wire_in(self.engine, params))
         self.base_params = _snapshot(self.state.params)
         self._base_revision = rev
         self._last_base_time = self.clock.now()
         self.report.base_pulls += 1
 
+    def _wire_template(self):
+        if self._wire_template_cache is None:
+            self._wire_template_cache = host_wire_template(self.engine)
+        return self._wire_template_cache
+
     def _fetch_base_broadcast(self):
-        """See broadcast_base_fetch (module level, shared with Validator)."""
-        return broadcast_base_fetch(self.transport,
-                                    host_zeros_template(self.engine),
+        """See broadcast_base_fetch (module level, shared with Validator).
+        Returns the WIRE-layout tree; callers wire_in like every other
+        fetch path (one conversion level, never two)."""
+        return broadcast_base_fetch(self.transport, self._wire_template(),
                                     self._base_revision)
 
     # -- local checkpoint/resume (checkpoint.py) ----------------------------
@@ -803,10 +863,10 @@ class MinerLoop:
         where a per-process read could diverge."""
         if revision is None or self.transport.base_revision() != revision:
             return None
-        fetched = self.transport.fetch_base(host_zeros_template(self.engine))
+        fetched = self.transport.fetch_base(self._wire_template())
         if fetched is None or fetched[1] != revision:
             return None
-        return fetched[0]
+        return wire_in(self.engine, fetched[0])
 
     # one program instead of an eager per-leaf op stream (each eager op on a
     # cross-process mesh is its own collective program). wire_dtype is
@@ -824,7 +884,9 @@ class MinerLoop:
                            self.miner_id)
             return
         try:
-            self.transport.publish_delta(self.miner_id, d)
+            # artifacts travel in the unrolled wire layout (see wire_out)
+            self.transport.publish_delta(self.miner_id,
+                                         wire_out(self.engine, d))
             self.report.pushes += 1
         except Exception:  # push failures must not kill training (ref :410-431)
             logger.exception("miner %s: delta push failed", self.miner_id)

@@ -93,12 +93,14 @@ class Validator:
     _host_template_cache = None
 
     def _host_template(self):
-        """Cached: shapes are fixed by the model config, and rebuilding a
-        full-model zeros tree per scored miner per round is O(model bytes)
-        of pure allocation."""
+        """Cached WIRE-layout template: shapes are fixed by the model
+        config, and rebuilding a full-model zeros tree per scored miner
+        per round is O(model bytes) of pure allocation. Everything read
+        from the transport validates against this and converts to the
+        internal layout via wire_in (train.py wire helpers)."""
         if self._host_template_cache is None:
-            from .train import host_zeros_template
-            self._host_template_cache = host_zeros_template(self.engine)
+            from .train import host_wire_template
+            self._host_template_cache = host_wire_template(self.engine)
         return self._host_template_cache
 
     def _broadcast_base(self, current_revision):
@@ -117,7 +119,9 @@ class Validator:
         else:
             fetched = None
         if fetched is not None:
-            base, self._base_revision = fetched
+            from .train import wire_in
+            base, self._base_revision = wire_in(self.engine,
+                                                fetched[0]), fetched[1]
         else:
             init = params() if callable(params) else params
             # genesis only: the one path that must materialize a full tree
@@ -147,7 +151,9 @@ class Validator:
             fetched = self.transport.fetch_base(self._host_template())
         if fetched is None:
             return
-        self.base_params = self.engine.place_params(fetched[0])
+        from .train import wire_in
+        self.base_params = self.engine.place_params(
+            wire_in(self.engine, fetched[0]))
         self._base_revision = fetched[1]
         self._eval_base()
 
@@ -168,13 +174,16 @@ class Validator:
         a mid-publish read skew would otherwise turn one SPMD eval into
         divergent programs emitting silently wrong scores."""
         from .lora_train import fetch_delta_any, fetch_delta_any_broadcast
+        from .train import wire_in
         if not self._multi():
-            return fetch_delta_any(self.transport, hotkey, self.base_params,
-                                   self.lora_cfg,
-                                   lora_template=self._adapter_template())
-        return fetch_delta_any_broadcast(
-            self.transport, hotkey, self._host_template(), self.lora_cfg,
-            lora_template=self._adapter_template())
+            d = fetch_delta_any(self.transport, hotkey,
+                                self._host_template(), self.lora_cfg,
+                                lora_template=self._adapter_template())
+        else:
+            d = fetch_delta_any_broadcast(
+                self.transport, hotkey, self._host_template(), self.lora_cfg,
+                lora_template=self._adapter_template())
+        return wire_in(self.engine, d)
 
     def score_miner(self, hotkey: str) -> MinerScore:
         d = self._fetch_delta(hotkey)

@@ -246,12 +246,13 @@ def make_model(preset_or_cfg) -> tuple[GPT2, GPT2Config]:
 def stack_blocks(params, n_layer: int, *, prefix: str = "h_",
                  scan_key: str = "h"):
     """Unrolled layout (``h_0..h_{L-1}``) -> scan layout (``h/block`` with a
-    leading [L] axis on every per-block leaf). HF converters
-    (models/convert.py) and checkpoints adapt through these two functions;
-    live wire artifacts (deltas/bases) travel in whichever layout the
-    publishing role runs, so ALL roles of a deployment must agree on
-    ``--scan-blocks`` — a mismatch is diagnosed by name at the loader
-    (serialization._diagnose_block_layout_mismatch)."""
+    leading [L] axis on every per-block leaf). Boundary adapters: HF
+    converters (models/convert.py) and, via the wire helpers in
+    engine/train.py (wire_out/wire_in), every transport artifact — bases
+    and full-param deltas ALWAYS travel unrolled, so ``--scan-blocks`` is
+    a per-role execution choice, not a fleet-wide protocol flag. A
+    genuinely foreign stacked payload is still diagnosed by name at the
+    loader (serialization._diagnose_block_layout_mismatch)."""
     blocks = [params[f"{prefix}{i}"] for i in range(n_layer)]
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
     out = {k: v for k, v in params.items()

@@ -24,8 +24,14 @@
 #   tputests - tests_tpu/ lane on the chip -> TPUTESTS_r{N}.json
 #   all      - probe && tputests && bench (correctness evidence first, so
 #              a bench-stage wedge can't cost the cheaper test record)
+#   extras   - the wedge-risk probes (batch-16-via-scan, big-vocab pallas
+#              crossover), DELIBERATELY not part of `all`: run manually,
+#              one healthy `all` first, and accept that a wedge here may
+#              end the rig's usefulness for hours. No timeout on purpose —
+#              killing these compiles is what wedges (rule 2); Ctrl-C only
+#              if you accept that risk.
 #
-# usage: scripts/measure.sh [probe|bench|tputests|all] [round-suffix]
+# usage: scripts/measure.sh [probe|bench|tputests|extras|all] [round-suffix]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -84,10 +90,18 @@ PY
   return "$rc"
 }
 
+extras() {
+  echo "extras: batch-16 + big-vocab benches; a wedged compile here can" >&2
+  echo "take the tunnel down for hours — no timeout, do not Ctrl-C." >&2
+  DT_BENCH_B16=1 DT_BENCH_BIGVOCAB=1 python bench.py
+}
+
 case "$STAGE" in
   probe)    probe ;;
   bench)    probe && bench ;;
   tputests) probe && tputests ;;
+  extras)   probe && extras ;;
   all)      probe && tputests && bench ;;
-  *) echo "usage: $0 [probe|bench|tputests|all] [round-suffix]" >&2; exit 2 ;;
+  *) echo "usage: $0 [probe|bench|tputests|extras|all] [round-suffix]" >&2
+     exit 2 ;;
 esac

@@ -162,3 +162,77 @@ def test_convert_load_params_stacks_for_scan(tmp_path):
     for a, b in zip(jtu.tree_leaves(loaded), jtu.tree_leaves(expect)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-6)
+
+
+def test_mixed_fleet_wire_layout(tmp_path):
+    """--scan-blocks is a per-role choice: artifacts always travel in the
+    unrolled wire layout (engine/train.py wire_out/wire_in), so a scan
+    miner's delta scores on an unrolled validator, an unrolled miner's
+    delta merges on a scan averager, and both miners pull the merged base
+    back into their own layouts."""
+    from distributedtraining_tpu.chain import LocalChain
+    from distributedtraining_tpu.data import (ByteTokenizer, batch_iterator,
+                                              text_corpus)
+    from distributedtraining_tpu.engine import (AveragerLoop, MinerLoop,
+                                                TrainEngine, Validator,
+                                                WeightedAverage)
+    from distributedtraining_tpu.transport import InMemoryTransport
+
+    cfg = _f32(gpt2.PRESETS["tiny"])
+    m_unroll, _ = gpt2.make_model(cfg)
+    m_scan, _ = gpt2.make_model(dataclasses.replace(cfg, scan_blocks=True))
+    e_unroll = TrainEngine(m_unroll, seq_len=32)
+    e_scan = TrainEngine(m_scan, seq_len=32)
+
+    docs = text_corpus(split="train", n_docs=32, source="synthetic")
+
+    def batches(n=6):
+        it = batch_iterator(docs, ByteTokenizer(), batch_size=4, seq_len=32,
+                            repeat=True, max_vocab=cfg.vocab_size)
+        return [next(it) for _ in range(n)]
+
+    transport = InMemoryTransport()
+    # genesis base published by an UNROLLED averager
+    base = m_unroll.init_params(jax.random.PRNGKey(0))
+    transport.publish_base(base)
+
+    # scan miner trains from the unrolled wire base and publishes a delta
+    scan_miner = MinerLoop(e_scan, transport, "hotkey_0",
+                           send_interval=1e9, check_update_interval=1e9)
+    scan_miner.bootstrap()
+    scan_miner.run(iter(batches(10)), max_steps=10)
+    scan_miner.flush()
+    # unrolled miner publishes too
+    u_miner = MinerLoop(e_unroll, transport, "hotkey_1",
+                        send_interval=1e9, check_update_interval=1e9)
+    u_miner.bootstrap()
+    u_miner.run(iter(batches(10)), max_steps=10)
+    u_miner.flush()
+
+    # the wire really is unrolled: raw fetch against an unrolled template
+    from distributedtraining_tpu import delta as delta_lib
+    host = jtu.tree_map(lambda x: np.zeros(x.shape, x.dtype),
+                        jax.eval_shape(lambda: base))
+    wire_delta = transport.fetch_delta("hotkey_0", host)
+    assert wire_delta is not None and "h_0" in wire_delta
+
+    # UNROLLED validator scores BOTH deltas above zero
+    chain = LocalChain(str(tmp_path), my_hotkey="hotkey_95", epoch_length=0)
+    v = Validator(e_unroll, transport, chain,
+                  eval_batches=lambda: iter(batches(2)))
+    v.bootstrap()
+    scores = {s.hotkey: s.score for s in v.validate_and_score()}
+    assert scores.get("hotkey_0", 0) > 0, scores
+    assert scores.get("hotkey_1", 0) > 0, scores
+
+    # SCAN averager merges both and publishes; scan miner pulls it back
+    avg = AveragerLoop(e_scan, transport, chain, WeightedAverage(),
+                       val_batches=lambda: iter(batches(2)))
+    avg.bootstrap()
+    assert avg.run_round()
+    assert avg.report.last_accepted == 2
+    scan_miner._check_pull()
+    assert scan_miner._base_revision == transport.base_revision()
+    # and an unrolled miner can too
+    u_miner._check_pull()
+    assert u_miner._base_revision == transport.base_revision()
