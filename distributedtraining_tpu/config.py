@@ -276,9 +276,9 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="trace the transformer stack as one lax.scan'd "
                         "block (~n_layer-fold smaller program, much faster "
                         "XLA compiles on deep models); identical math. "
-                        "Wire artifacts stay in the universal unrolled "
-                        "layout, so roles can flip this independently "
-                        "(LoRA mode excepted)")
+                        "Wire artifacts (bases, deltas, adapters) stay in "
+                        "the universal unrolled layout, so roles can flip "
+                        "this independently")
 
     g = p.add_argument_group("mesh")
     g.add_argument("--dp", type=int, default=d.mesh.dp,

@@ -443,7 +443,9 @@ class AveragerLoop:
                                  fetch_delta_any_broadcast)
         from .train import wire_in
         if self.lora_cfg is not None and self._lora_template is None:
-            self._lora_template = adapter_template(self.base_params,
+            # WIRE layout: adapter artifacts travel unrolled (train.py
+            # wire helpers), whatever layout this averager runs
+            self._lora_template = adapter_template(self._host_template(),
                                                    self.lora_cfg)
         if self._multi():
             d = fetch_delta_any_broadcast(

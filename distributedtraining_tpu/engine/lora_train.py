@@ -209,7 +209,13 @@ class LoRAMinerLoop(MinerLoop):
                            self.miner_id)
             return
         try:
-            self.transport.publish_delta(self.miner_id, adapters)
+            # adapter trees mirror the base structure, so the same wire
+            # normalization applies: a scan_blocks LoRA miner's stacked
+            # [L, in, r]/[L, r, out] factors unstack to the universal
+            # per-block wire layout (train.py wire_out)
+            from .train import wire_out
+            self.transport.publish_delta(self.miner_id,
+                                         wire_out(self.engine, adapters))
             self.report.pushes += 1
         except Exception:
             logger.exception("lora miner %s: push failed", self.miner_id)

@@ -163,7 +163,9 @@ class Validator:
             return None
         if self._lora_template is None:
             from .lora_train import adapter_template
-            self._lora_template = adapter_template(self.base_params,
+            # WIRE layout, like every transport template: adapter trees
+            # travel unrolled regardless of the publisher's scan setting
+            self._lora_template = adapter_template(self._host_template(),
                                                    self.lora_cfg)
         return self._lora_template
 

@@ -121,9 +121,11 @@ def _diagnose_block_layout_mismatch(raw, template) -> str | None:
     except Exception:
         return None
     if got and want and got != want:
-        return (f"payload uses the {got} block layout but this role expects "
-                f"{want} — the deployment's --scan-blocks settings disagree; "
-                f"all roles must run with the same flag")
+        return (f"payload uses the {got} block layout but this surface "
+                f"expects {want} — artifacts are supposed to travel in the "
+                f"unrolled wire layout regardless of --scan-blocks (engine "
+                f"wire_out/wire_in normalize at publish/fetch); a stacked "
+                f"payload means a legacy or non-conforming publisher")
     return None
 
 

@@ -296,17 +296,6 @@ def build(cfg: RunConfig) -> Components:
 
     lora_cfg = None
     if cfg.lora_rank > 0:
-        if cfg.scan_blocks:
-            # full-param artifacts are layout-normalized at the wire
-            # (engine/train.py wire_out/wire_in), but ADAPTER trees carry
-            # per-block paths that follow the publisher's internal layout —
-            # cross-layout adapter exchange needs its own normalization
-            # that doesn't exist yet. Refuse loudly instead of silently
-            # zero-scoring every peer.
-            raise SystemExit(
-                "--lora-rank with --scan-blocks is not supported yet: "
-                "adapter artifacts are layout-dependent; run LoRA roles "
-                "unrolled")
         from distributedtraining_tpu.models.lora import LoRAConfig
         lora_cfg = LoRAConfig(rank=cfg.lora_rank, alpha=cfg.lora_alpha)
 

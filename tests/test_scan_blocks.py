@@ -236,3 +236,113 @@ def test_mixed_fleet_wire_layout(tmp_path):
     # and an unrolled miner can too
     u_miner._check_pull()
     assert u_miner._base_revision == transport.base_revision()
+
+
+def test_mixed_fleet_lora_wire_layout(tmp_path):
+    """Adapter artifacts normalize at the wire too: a scan_blocks LoRA
+    miner's stacked [L, in, r] factors unstack to the universal per-block
+    wire form, score on an UNROLLED validator, and merge on an unrolled
+    averager."""
+    from distributedtraining_tpu.chain import LocalChain
+    from distributedtraining_tpu.data import (ByteTokenizer, batch_iterator,
+                                              text_corpus)
+    from distributedtraining_tpu.engine import (AveragerLoop, LoRAEngine,
+                                                LoRAMinerLoop, TrainEngine,
+                                                Validator, WeightedAverage)
+    from distributedtraining_tpu.models.lora import LoRAConfig
+    from distributedtraining_tpu.transport import InMemoryTransport
+
+    cfg = _f32(gpt2.PRESETS["tiny"])
+    m_unroll, _ = gpt2.make_model(cfg)
+    m_scan, _ = gpt2.make_model(dataclasses.replace(cfg, scan_blocks=True))
+    lcfg = LoRAConfig(rank=2)
+
+    docs = text_corpus(split="train", n_docs=32, source="synthetic")
+
+    def batches(n=6):
+        it = batch_iterator(docs, ByteTokenizer(), batch_size=4, seq_len=32,
+                            repeat=True, max_vocab=cfg.vocab_size)
+        return [next(it) for _ in range(n)]
+
+    transport = InMemoryTransport()
+    transport.publish_base(m_unroll.init_params(jax.random.PRNGKey(0)))
+
+    scan_lora = LoRAMinerLoop(LoRAEngine(m_scan, lcfg, seq_len=32),
+                              transport, "hotkey_0",
+                              send_interval=1e9, check_update_interval=1e9)
+    scan_lora.bootstrap()
+    scan_lora.run(iter(batches(12)), max_steps=12)
+    scan_lora.flush()
+
+    # the wire adapters are per-block (h_0...), not stacked
+    from distributedtraining_tpu.engine.lora_train import adapter_template
+    host_base = jtu.tree_map(
+        lambda x: np.zeros(x.shape, x.dtype),
+        jax.eval_shape(lambda: m_unroll.init_params(jax.random.PRNGKey(0))))
+    wire = transport.fetch_delta(
+        "hotkey_0", adapter_template(host_base, lcfg))
+    assert wire is not None and "h_0" in wire
+
+    e_unroll = TrainEngine(m_unroll, seq_len=32)
+    chain = LocalChain(str(tmp_path), my_hotkey="hotkey_95", epoch_length=0)
+    v = Validator(e_unroll, transport, chain,
+                  eval_batches=lambda: iter(batches(2)), lora_cfg=lcfg)
+    v.bootstrap()
+    scores = {s.hotkey: s.score for s in v.validate_and_score()}
+    assert scores.get("hotkey_0", 0) > 0, scores
+
+    avg = AveragerLoop(e_unroll, transport, chain, WeightedAverage(),
+                       val_batches=lambda: iter(batches(2)), lora_cfg=lcfg)
+    avg.bootstrap()
+    assert avg.run_round()
+    assert avg.report.last_accepted == 1
+
+
+def test_scan_consumer_accepts_unrolled_lora(tmp_path):
+    """The reverse direction: a --scan-blocks validator/averager builds
+    its adapter template in the WIRE layout, so an UNROLLED LoRA miner's
+    adapters validate, score, and merge (reverting the wire-layout
+    templates in validate.py/average.py breaks exactly this)."""
+    from distributedtraining_tpu.chain import LocalChain
+    from distributedtraining_tpu.data import (ByteTokenizer, batch_iterator,
+                                              text_corpus)
+    from distributedtraining_tpu.engine import (AveragerLoop, LoRAEngine,
+                                                LoRAMinerLoop, TrainEngine,
+                                                Validator, WeightedAverage)
+    from distributedtraining_tpu.models.lora import LoRAConfig
+    from distributedtraining_tpu.transport import InMemoryTransport
+
+    cfg = _f32(gpt2.PRESETS["tiny"])
+    m_unroll, _ = gpt2.make_model(cfg)
+    m_scan, _ = gpt2.make_model(dataclasses.replace(cfg, scan_blocks=True))
+    lcfg = LoRAConfig(rank=2)
+    docs = text_corpus(split="train", n_docs=32, source="synthetic")
+
+    def batches(n=6):
+        it = batch_iterator(docs, ByteTokenizer(), batch_size=4, seq_len=32,
+                            repeat=True, max_vocab=cfg.vocab_size)
+        return [next(it) for _ in range(n)]
+
+    transport = InMemoryTransport()
+    transport.publish_base(m_unroll.init_params(jax.random.PRNGKey(0)))
+
+    u_lora = LoRAMinerLoop(LoRAEngine(m_unroll, lcfg, seq_len=32),
+                           transport, "hotkey_0",
+                           send_interval=1e9, check_update_interval=1e9)
+    u_lora.bootstrap()
+    u_lora.run(iter(batches(12)), max_steps=12)
+    u_lora.flush()
+
+    e_scan = TrainEngine(m_scan, seq_len=32)
+    chain = LocalChain(str(tmp_path), my_hotkey="hotkey_95", epoch_length=0)
+    v = Validator(e_scan, transport, chain,
+                  eval_batches=lambda: iter(batches(2)), lora_cfg=lcfg)
+    v.bootstrap()
+    scores = {s.hotkey: s.score for s in v.validate_and_score()}
+    assert scores.get("hotkey_0", 0) > 0, scores
+
+    avg = AveragerLoop(e_scan, transport, chain, WeightedAverage(),
+                       val_batches=lambda: iter(batches(2)), lora_cfg=lcfg)
+    avg.bootstrap()
+    assert avg.run_round()
+    assert avg.report.last_accepted == 1
