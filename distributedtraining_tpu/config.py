@@ -252,11 +252,14 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "the reference's DataLoader-workers equivalent)")
     if role == "miner":  # only the miner publishes raw deltas
         g.add_argument("--delta-dtype", dest="delta_dtype",
-                       choices=("float32", "bfloat16"), default=d.delta_dtype,
-                       help="wire dtype of published deltas; bfloat16 halves "
-                            "artifact bytes, transport bandwidth, and the "
-                            "averager's merge HBM (validators/averagers "
-                            "accept both, and merges accumulate in f32)")
+                       choices=("float32", "bfloat16", "int8"),
+                       default=d.delta_dtype,
+                       help="wire dtype of published deltas: bfloat16 "
+                            "halves artifact bytes; int8 quarters them "
+                            "(per-tensor symmetric scales, rounding error "
+                            "<= 1 step per artifact). Receivers auto-detect "
+                            "every form and dequantize at ingest; merges "
+                            "accumulate in f32")
     g.add_argument("--logits-dtype", dest="logits_dtype",
                    choices=("float32", "bfloat16"), default=d.logits_dtype,
                    help="storage dtype of the [batch, seq, vocab] logits "

@@ -163,6 +163,58 @@ def global_norm(tree: Params) -> float:
 
 
 # ---------------------------------------------------------------------------
+# int8 wire quantization (an opt-in WIRE format, like the bf16 cast above
+# but 4x: per-tensor symmetric scales, error feedback at the publisher)
+# ---------------------------------------------------------------------------
+
+def _is_qleaf(node) -> bool:
+    return isinstance(node, dict) and set(node) == {"q", "scale"}
+
+
+def quantize_delta(delta: Params) -> Params:
+    """Float delta -> int8 wire tree: every leaf becomes
+    ``{"q": int8, "scale": f32 scalar}`` (symmetric, scale = max|x|/127).
+
+    A wire format only: receivers dequantize at ingest
+    (``dequantize_delta``) and everything downstream — screens, apply,
+    merge — runs on the float tree, so the scale being attacker-controlled
+    adds nothing the magnitude/finiteness screens don't already catch.
+    Per-artifact rounding error is bounded by one step (max|x|/127 per
+    tensor); NOTE this protocol's artifacts REPLACE each other (each push
+    re-publishes the whole cumulative delta), so error-feedback-style
+    residual carrying would ADD error here, not cancel it — don't.
+    All-float trees only (matching quantized_template), enforced loudly.
+    Jittable."""
+    def leaf(x):
+        if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            raise ValueError(
+                "quantize_delta: non-float leaf of dtype "
+                f"{jnp.asarray(x).dtype} — the int8 wire format covers "
+                "all-float delta trees only")
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12) / 127.0
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return {"q": q, "scale": scale.astype(jnp.float32)}
+    return jax.tree_util.tree_map(leaf, delta)
+
+
+def dequantize_delta(qtree: Params) -> Params:
+    """Inverse of quantize_delta (f32 out). Jittable."""
+    return jax.tree_util.tree_map(
+        lambda d: d["q"].astype(jnp.float32) * d["scale"],
+        qtree, is_leaf=_is_qleaf)
+
+
+def quantized_template(base_template: Params) -> Params:
+    """Host-side zeros tree in the int8 wire structure — the
+    template-restoring load's discriminator for quantized submissions
+    (engine/lora_train.py fetch_delta_any's try-chain)."""
+    return jax.tree_util.tree_map(
+        lambda x: {"q": np.zeros(np.shape(x), np.int8),
+                   "scale": np.zeros((), np.float32)},
+        base_template)
+
+
+# ---------------------------------------------------------------------------
 # Stacking: the averager's miner axis
 # ---------------------------------------------------------------------------
 

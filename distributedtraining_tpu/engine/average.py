@@ -391,6 +391,7 @@ class AveragerLoop:
         self.base_params: Params | None = None
         self._base_revision = None
         self._host_template_cache = None
+        self._quant_template_cache = None
 
     # -- multi-host (the averager can span a pod too) -----------------------
     def _multi(self) -> bool:
@@ -450,12 +451,21 @@ class AveragerLoop:
         if self._multi():
             d = fetch_delta_any_broadcast(
                 self.transport, hotkey, self._host_template(), self.lora_cfg,
-                lora_template=self._lora_template)
+                lora_template=self._lora_template,
+                quant_template=self._quant_template)
         else:
             d = fetch_delta_any(self.transport, hotkey,
                                 self._host_template(), self.lora_cfg,
-                                lora_template=self._lora_template)
+                                lora_template=self._lora_template,
+                                quant_template=self._quant_template)
         return wire_in(self.engine, d)
+
+    def _quant_template(self):
+        """Lazy+cached int8 wire template supplier (see Validator's)."""
+        if self._quant_template_cache is None:
+            self._quant_template_cache = delta_lib.quantized_template(
+                self._host_template())
+        return self._quant_template_cache
 
     def gather_deltas(self) -> tuple[list[str], list[Params]]:
         if self._multi():

@@ -239,47 +239,53 @@ def adapter_template(base, lora_cfg: lora_lib.LoRAConfig):
 
 def fetch_delta_any(transport, hotkey: str, base,
                     lora_cfg: Optional[lora_lib.LoRAConfig] = None,
-                    *, lora_template=None):
+                    *, lora_template=None, quant_template=None):
     """Fetch a miner's submission as a dense delta, whatever its wire form.
 
-    Validates against the full-param template first, then the adapter
-    template (reconstructing the dense delta). Returns None when neither
-    matches — the caller scores 0 (validation_logic.py:152-166 semantics).
-    With ``lora_cfg`` unset this degrades to a plain ``fetch_delta``.
+    Validates against the full-param template first, then the int8
+    quantized-wire template (dequantized here — downstream only ever sees
+    floats), then the adapter template (reconstructing the dense delta).
+    Returns None when nothing matches — the caller scores 0
+    (validation_logic.py:152-166 semantics).
 
     When the transport exposes ``fetch_delta_bytes`` the artifact is pulled
-    from the network ONCE and both validations run on the same bytes —
-    the HF transport deletes its download after each fetch, so two
-    ``fetch_delta`` calls would mean two full downloads per miner per round.
+    from the network ONCE and every validation runs on the same bytes —
+    the HF transport deletes its download after each fetch, so repeated
+    ``fetch_delta`` calls would mean repeated full downloads per miner per
+    round. Templates pass through lazily: a full-param submission never
+    pays the quant/adapter template allocs; callers scoring many miners
+    should pass per-base-revision cached templates.
     """
-    if lora_cfg is None:
-        return transport.fetch_delta(hotkey, base)
-
-    # template construction is deferred: most submissions in a mixed fleet
-    # validate as full-param on the first attempt, and rebuilding the
-    # adapter template per miner per round is redundant trace/alloc work —
-    # callers scoring many miners should pass a per-base-revision cached
-    # ``lora_template``
-    def template():
-        nonlocal lora_template
-        if lora_template is None:
-            lora_template = adapter_template(base, lora_cfg)
-        return lora_template
-
     fetch_bytes = getattr(transport, "fetch_delta_bytes", None)
     if fetch_bytes is not None:
         data = fetch_bytes(hotkey)
         if data is None:
             return None
-        # lora_template passes through as-is: densify builds it lazily, so
-        # a full-param submission never pays the adapter-template alloc
         return densify_delta_bytes(data, base, lora_cfg,
-                                   lora_template=lora_template)
+                                   lora_template=lora_template,
+                                   quant_template=quant_template)
 
     d = transport.fetch_delta(hotkey, base)
     if d is not None:
         return d
-    adapters = transport.fetch_delta(hotkey, template())
+    if callable(quant_template):
+        quant_template = quant_template()
+    elif quant_template is None:
+        quant_template = delta_lib.quantized_template(base)
+    q = transport.fetch_delta(hotkey, quant_template)
+    if q is not None:
+        # custom transports load without dtype pinning; re-check host-side
+        # before trusting the bytes (int8 is the contract — see
+        # densify_delta_bytes)
+        if not delta_lib.shapes_match(q, quant_template, check_dtype=True,
+                                      extra_dtypes=()):
+            return None
+        return jax.device_get(delta_lib.dequantize_delta(q))
+    if lora_cfg is None:
+        return None
+    if lora_template is None:
+        lora_template = adapter_template(base, lora_cfg)
+    adapters = transport.fetch_delta(hotkey, lora_template)
     if adapters is None:
         return None
     return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)
@@ -287,7 +293,7 @@ def fetch_delta_any(transport, hotkey: str, base,
 
 def fetch_delta_any_broadcast(transport, hotkey: str, base_template,
                               lora_cfg: Optional[lora_lib.LoRAConfig] = None,
-                              *, lora_template=None):
+                              *, lora_template=None, quant_template=None):
     """Pod variant of ``fetch_delta_any``: the coordinator reads the RAW
     artifact bytes, every process receives the identical broadcast and
     densifies locally (a LoRA submission stays ~MB on the interconnect).
@@ -301,22 +307,28 @@ def fetch_delta_any_broadcast(transport, hotkey: str, base_template,
         return broadcast_optional_tree(
             base_template,
             lambda: fetch_delta_any(transport, hotkey, base_template,
-                                    lora_cfg, lora_template=lora_template))
+                                    lora_cfg, lora_template=lora_template,
+                                    quant_template=quant_template))
     data = broadcast_optional_bytes(
         fetch_bytes(hotkey) if multihost.is_coordinator() else None)
     if data is None:
         return None
     return densify_delta_bytes(data, base_template, lora_cfg,
-                               lora_template=lora_template)
+                               lora_template=lora_template,
+                               quant_template=quant_template)
 
 
 def densify_delta_bytes(data: bytes, base,
                         lora_cfg: Optional[lora_lib.LoRAConfig] = None,
-                        *, lora_template=None):
+                        *, lora_template=None, quant_template=None):
     """Validated artifact bytes -> dense delta (or None): the byte half of
     ``fetch_delta_any``, split out so a pod validator can broadcast the RAW
     bytes once (20 MB of adapters, not a densified full-model tree) and
-    densify identically on every process."""
+    densify identically on every process.
+
+    The try-chain discriminates the three wire forms by template: plain
+    dense tree, int8-quantized tree ({"q","scale"} leaves — dequantized
+    here so everything downstream sees floats), then LoRA adapters."""
     from .. import serialization as ser
     from .. import signing
 
@@ -332,6 +344,18 @@ def densify_delta_bytes(data: bytes, base,
         return ser.validated_load(data, base)
     except ser.PayloadError:
         pass
+    if callable(quant_template):   # lazy+cached supplier from the loops
+        quant_template = quant_template()
+    elif quant_template is None:
+        quant_template = delta_lib.quantized_template(base)
+    try:
+        # dtype-pinned: "q" MUST be int8 (a structurally matching f64 tree
+        # would parse at 8x the advertised bytes — see validated_load)
+        q = ser.validated_load(data, quant_template, check_dtypes=True)
+    except ser.PayloadError:
+        q = None
+    if q is not None:
+        return jax.device_get(delta_lib.dequantize_delta(q))
     if lora_cfg is None:
         return None
     if lora_template is None:

@@ -873,20 +873,29 @@ class MinerLoop:
     # static (it changes the program), hence the static_argnames jit.
     _compute_delta = staticmethod(
         jax.jit(delta_lib.compute_delta, static_argnames=("wire_dtype",)))
+    _quantize = staticmethod(jax.jit(delta_lib.quantize_delta))
 
     def _push_delta(self) -> None:
         if self.state is None:
             return
-        d = self._compute_delta(self.state.params, self.base_params,
-                                wire_dtype=self.delta_dtype)
+        d = self._compute_delta(
+            self.state.params, self.base_params,
+            wire_dtype=None if self.delta_dtype == "int8" else self.delta_dtype)
         if self.nan_guard and delta_lib.has_nonfinite(d):
             logger.warning("miner %s: delta has non-finite values, not pushing",
                            self.miner_id)
             return
+        # artifacts travel in the unrolled wire layout (see wire_out);
+        # int8 quantization runs on the WIRE tree so scales are per wire
+        # tensor (per block under scan_blocks, not per stacked stack).
+        # NO error feedback: artifacts replace each other (each push is
+        # the whole cumulative delta), so carrying a residual into the
+        # next push would add the superseded push's rounding error.
+        payload = wire_out(self.engine, d)
+        if self.delta_dtype == "int8":
+            payload = self._quantize(payload)
         try:
-            # artifacts travel in the unrolled wire layout (see wire_out)
-            self.transport.publish_delta(self.miner_id,
-                                         wire_out(self.engine, d))
+            self.transport.publish_delta(self.miner_id, payload)
             self.report.pushes += 1
         except Exception:  # push failures must not kill training (ref :410-431)
             logger.exception("miner %s: delta push failed", self.miner_id)

@@ -180,12 +180,26 @@ class Validator:
         if not self._multi():
             d = fetch_delta_any(self.transport, hotkey,
                                 self._host_template(), self.lora_cfg,
-                                lora_template=self._adapter_template())
+                                lora_template=self._adapter_template(),
+                                quant_template=self._quant_template)
         else:
             d = fetch_delta_any_broadcast(
                 self.transport, hotkey, self._host_template(), self.lora_cfg,
-                lora_template=self._adapter_template())
+                lora_template=self._adapter_template(),
+                quant_template=self._quant_template)
         return wire_in(self.engine, d)
+
+    _quant_template_cache = None
+
+    def _quant_template(self):
+        """Cached int8 wire template, passed UNCALLED as a lazy supplier:
+        an all-f32/bf16 fleet never validates against it and never pays
+        the quarter-model-bytes allocation."""
+        if self._quant_template_cache is None:
+            from .. import delta as _dl
+            self._quant_template_cache = _dl.quantized_template(
+                self._host_template())
+        return self._quant_template_cache
 
     def score_miner(self, hotkey: str) -> MinerScore:
         d = self._fetch_delta(hotkey)

@@ -255,13 +255,21 @@ def load_file(path: str, template: Params | None = None,
 
 def validated_load(data: bytes, template: Params, *, fmt: str = "msgpack",
                    max_bytes: int = DEFAULT_MAX_BYTES,
-                   check_shapes: bool = True) -> Params:
+                   check_shapes: bool = True,
+                   check_dtypes: bool = False) -> Params:
     """One-stop loader for untrusted peer bytes: parse, restore into the
-    template structure, and verify per-leaf shapes."""
+    template structure, and verify per-leaf shapes.
+
+    ``check_dtypes=True`` additionally pins every leaf to the template's
+    exact dtype — required for wire formats whose small dtype IS the
+    contract (the int8 quantized delta: a hostile f64 "q" tree matching
+    the structure/shapes would otherwise parse at 8x the advertised
+    bytes)."""
     from . import delta as _delta
 
     loader = from_safetensors if fmt == "safetensors" else from_msgpack
     tree = loader(data, template, max_bytes=max_bytes)
-    if check_shapes and not _delta.shapes_match(tree, template):
-        raise PayloadError("leaf shape mismatch against template")
+    if check_shapes and not _delta.shapes_match(
+            tree, template, check_dtype=check_dtypes, extra_dtypes=()):
+        raise PayloadError("leaf shape/dtype mismatch against template")
     return tree

@@ -224,3 +224,64 @@ def test_chunked_weighted_merge_matches_stacked():
         delta.chunked_weighted_merge(base, [], w)
     with pytest.raises(ValueError):
         delta.chunked_weighted_merge(base, deltas, w[:3])
+
+
+def test_int8_wire_quantization_roundtrip_and_screens():
+    """Per-tensor int8 wire format: bounded roundtrip error, hostile
+    scales die in the existing screens after dequantization, non-float
+    trees are refused loudly (no silent template mismatch)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtraining_tpu import delta
+
+    rng = np.random.default_rng(0)
+    base = {"a": jnp.zeros((64, 32), jnp.float32),
+            "b": jnp.zeros((17,), jnp.float32)}
+    d = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(rng.normal(0, 0.01, x.shape), x.dtype), base)
+
+    q = delta.quantize_delta(d)
+    deq = delta.dequantize_delta(q)
+    for a, b in zip(jax.tree_util.tree_leaves(deq),
+                    jax.tree_util.tree_leaves(d)):
+        err = float(jnp.abs(a - b).max())
+        bound = float(jnp.abs(b).max()) / 127.0  # one quantization step
+        assert err <= bound + 1e-9, (err, bound)
+    ok, reason = delta.screen_delta(deq, base)
+    assert ok, reason
+
+    # hostile scales: inf/nan -> nonfinite screen; huge -> magnitude screen
+    evil = jax.tree_util.tree_map(
+        lambda l: {"q": l["q"], "scale": jnp.asarray(float("inf"))},
+        q, is_leaf=delta._is_qleaf)
+    ok, reason = delta.screen_delta(delta.dequantize_delta(evil), base)
+    assert not ok and reason == "nonfinite"
+    big = jax.tree_util.tree_map(
+        lambda l: {"q": l["q"], "scale": jnp.asarray(1e30, jnp.float32)},
+        q, is_leaf=delta._is_qleaf)
+    ok, reason = delta.screen_delta(delta.dequantize_delta(big), base,
+                                    max_abs=1e3)
+    assert not ok and reason.startswith("magnitude_exceeded")
+
+    # non-float leaves refuse loudly (the wire format is all-float)
+    with pytest.raises(ValueError, match="non-float"):
+        delta.quantize_delta({"a": jnp.zeros((4,), jnp.int32)})
+
+
+def test_int8_hostile_f64_q_rejected():
+    """A structurally matching tree whose "q" leaves are f64 must NOT pass
+    the dtype-pinned quant load (8x memory amplification otherwise)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedtraining_tpu import delta, serialization as ser
+
+    base = {"a": np.zeros((8, 4), np.float32)}
+    tmpl = delta.quantized_template(base)
+    legit = delta.quantize_delta({"a": jnp.full((8, 4), 0.01)})
+    ser.validated_load(ser.to_msgpack(legit), tmpl, check_dtypes=True)
+    hostile = {"a": {"q": np.ones((8, 4), np.float64),
+                     "scale": np.float32(1.0)}}
+    with pytest.raises(ser.PayloadError):
+        ser.validated_load(ser.to_msgpack(hostile), tmpl, check_dtypes=True)

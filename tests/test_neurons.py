@@ -188,6 +188,34 @@ def test_bf16_delta_round(tmp_path):
     assert (bf16_dir / "artifacts" / "base" / "averaged_model.msgpack").exists()
 
 
+def test_int8_delta_round(tmp_path):
+    """--delta-dtype int8: the artifact shrinks ~4x vs f32 and the
+    validator auto-detects the quantized wire form, dequantizes, and
+    scores it; the averager merges it."""
+    f32_dir, q_dir = tmp_path / "f32", tmp_path / "int8"
+    for d, extra in ((f32_dir, []), (q_dir, ["--delta-dtype", "int8"])):
+        rc = miner.main(_common(
+            d, "hotkey_0",
+            ["--max-steps", "8", "--send-interval", "1e9",
+             "--checkpoint-interval", "0", *extra]))
+        assert rc == 0
+    f32_bytes = (f32_dir / "artifacts" / "deltas" / "hotkey_0.msgpack"
+                 ).stat().st_size
+    q_bytes = (q_dir / "artifacts" / "deltas" / "hotkey_0.msgpack"
+               ).stat().st_size
+    assert q_bytes < 0.35 * f32_bytes, (q_bytes, f32_bytes)
+
+    rc = validator.main(_common(q_dir, "hotkey_91", ["--rounds", "1"]))
+    assert rc == 0
+    meta = json.loads((q_dir / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 0) > 0, \
+        "validator rejected the int8 wire delta"
+    rc = averager.main(_common(
+        q_dir, "hotkey_99", ["--rounds", "1", "--strategy", "weighted"]))
+    assert rc == 0
+    assert (q_dir / "artifacts" / "base" / "averaged_model.msgpack").exists()
+
+
 def test_logits_dtype_flag_reaches_model_config(tmp_path):
     """--logits-dtype parses into RunConfig AND lands on the model config
     through neurons/common.build, like its siblings --scan-blocks and
