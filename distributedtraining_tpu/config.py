@@ -74,6 +74,8 @@ class RunConfig:
     eval_seq_len: int = 512                  # validator len (validator.py:63)
     batch_size: int = 8
     eval_batches: int = 12                   # ~100 texts / batch 8 (ref :49,98)
+    score_metric: str = "loss"               # loss | perplexity (ref :93-97)
+    max_delta_abs: float = 1e3               # admission magnitude cap (0=off)
     learning_rate: float = 5e-4              # neurons/miner.py:121-128
     grad_clip: Optional[float] = None
     mu_dtype: Optional[str] = None           # "bfloat16": half-size Adam mu
@@ -179,6 +181,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        help="run even when this hotkey holds no validator "
                             "stake (scores are computed but weights are "
                             "never emitted; useful for dry runs)")
+        g.add_argument("--score-metric", dest="score_metric",
+                       choices=("loss", "perplexity"),
+                       default=d.score_metric,
+                       help="scoring rule: max(0, base - candidate) on "
+                            "eval loss or on perplexity (the reference's "
+                            "two modes, validation_logic.py:93-97)")
 
     g = p.add_argument_group("storage")
     g.add_argument("--backend", choices=("local", "memory", "hf"),
@@ -215,6 +223,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    default=d.batch_size)
     g.add_argument("--eval-batches", dest="eval_batches", type=int,
                    default=d.eval_batches)
+    if role in ("validator", "averager"):
+        g.add_argument("--max-delta-abs", dest="max_delta_abs", type=float,
+                       default=d.max_delta_abs,
+                       help="admission screen: reject submissions whose "
+                            "largest |value| exceeds this (crude poisoning "
+                            "guard the reference lacks; 0 disables)")
     g.add_argument("--learning-rate", dest="learning_rate", type=float,
                    default=d.learning_rate)
     g.add_argument("--grad-clip", dest="grad_clip", type=float, default=None)

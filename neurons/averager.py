@@ -56,6 +56,7 @@ def main(argv=None) -> int:
                         make_strategy(cfg, c.model),
                         val_batches=c.eval_batches(),
                         address_store=c.address_store,
+                        max_delta_abs=cfg.max_delta_abs or None,
                         metrics=c.metrics, lora_cfg=c.lora_cfg)
     loop.bootstrap(params=c.initial_params)
     try:

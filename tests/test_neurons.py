@@ -241,6 +241,49 @@ def test_logits_dtype_flag_reaches_model_config(tmp_path):
     assert n.remat is False
 
 
+def test_score_metric_flag(tmp_path):
+    """--score-metric perplexity reaches the Validator and still scores a
+    good delta positive (the reference's second scoring mode)."""
+    from distributedtraining_tpu.config import RunConfig
+    cfg = RunConfig.from_args("validator", _common(
+        tmp_path, "hotkey_91", ["--score-metric", "perplexity"]))
+    assert cfg.score_metric == "perplexity"
+
+    miner.main(_common(tmp_path, "hotkey_0",
+                       ["--max-steps", "15", "--send-interval", "1e9"]))
+    rc = validator.main(_common(
+        tmp_path, "hotkey_91",
+        ["--rounds", "1", "--score-metric", "perplexity"]))
+    assert rc == 0
+    meta = json.loads((tmp_path / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 0) > 0
+
+
+def test_max_delta_abs_flag(tmp_path):
+    """--max-delta-abs: a tight cap rejects an honest delta (scored 0);
+    0 disables the screen entirely; parse + 0->None translation pinned."""
+    from distributedtraining_tpu.config import RunConfig
+    cfg = RunConfig.from_args("validator", _common(
+        tmp_path, "hotkey_91", ["--max-delta-abs", "0"]))
+    assert cfg.max_delta_abs == 0.0
+
+    miner.main(_common(tmp_path, "hotkey_0",
+                       ["--max-steps", "10", "--send-interval", "1e9"]))
+    # absurdly tight cap: every real delta exceeds 1e-9 -> scored 0
+    rc = validator.main(_common(
+        tmp_path, "hotkey_91",
+        ["--rounds", "1", "--max-delta-abs", "1e-9"]))
+    assert rc == 0
+    meta = json.loads((tmp_path / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 1) == 0
+    # 0 disables the magnitude screen -> the same delta now scores
+    rc = validator.main(_common(
+        tmp_path, "hotkey_91", ["--rounds", "1", "--max-delta-abs", "0"]))
+    assert rc == 0
+    meta = json.loads((tmp_path / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 0) > 0
+
+
 def test_validator_entry_refuses_without_vpermit(tmp_path):
     """hotkey_0 has miner stake (10 < vpermit limit 1000): the entry point
     must refuse up front unless --allow-no-vpermit is passed."""
