@@ -77,6 +77,7 @@ class RunConfig:
     score_metric: str = "loss"               # loss | perplexity (ref :93-97)
     max_delta_abs: float = 1e3               # admission magnitude cap (0=off)
     learning_rate: float = 5e-4              # neurons/miner.py:121-128
+    weight_decay: float = 0.01               # AdamW decoupled decay
     grad_clip: Optional[float] = None
     mu_dtype: Optional[str] = None           # "bfloat16": half-size Adam mu
     lora_rank: int = 0                       # >0: LoRA-delta mode (config 4)
@@ -111,6 +112,9 @@ class RunConfig:
     strategy: str = "parameterized"          # weighted | parameterized | genetic
     merge_chunk: int = 8                     # weighted-merge device chunk
     meta_epochs: int = 7                     # averager.py:106
+    genetic_population: int = 10             # averaging_logic.py:830-970
+    genetic_generations: int = 10
+    genetic_sigma: float = 0.1
     meta_lr: float = 0.01
     outer_momentum: float = 0.0              # >0 wraps strategy in OuterOptMerge
     outer_lr: float = 0.7                    # DiLoCo-style outer Nesterov step
@@ -137,6 +141,14 @@ class RunConfig:
         kw = {k: v for k, v in vars(ns).items() if k in fields}
         kw.pop("mesh", None)
         return cls(role=role, mesh=mesh, **kw)
+
+
+def _nonneg_float(value: str) -> float:
+    f = float(value)
+    if f < 0:
+        raise argparse.ArgumentTypeError(
+            f"{value}: must be >= 0 (0 disables)")
+    return f
 
 
 def _dataset_arg(value: str) -> str:
@@ -224,13 +236,16 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--eval-batches", dest="eval_batches", type=int,
                    default=d.eval_batches)
     if role in ("validator", "averager"):
-        g.add_argument("--max-delta-abs", dest="max_delta_abs", type=float,
-                       default=d.max_delta_abs,
+        g.add_argument("--max-delta-abs", dest="max_delta_abs",
+                       type=_nonneg_float, default=d.max_delta_abs,
                        help="admission screen: reject submissions whose "
                             "largest |value| exceeds this (crude poisoning "
                             "guard the reference lacks; 0 disables)")
     g.add_argument("--learning-rate", dest="learning_rate", type=float,
                    default=d.learning_rate)
+    g.add_argument("--weight-decay", dest="weight_decay", type=float,
+                   default=d.weight_decay,
+                   help="AdamW decoupled weight decay")
     g.add_argument("--grad-clip", dest="grad_clip", type=float, default=None)
     g.add_argument("--mu-dtype", dest="mu_dtype",
                    choices=("float32", "bfloat16"), default=d.mu_dtype,
@@ -358,6 +373,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        default=d.outer_lr)
         g.add_argument("--meta-lr", dest="meta_lr", type=float,
                        default=d.meta_lr)
+        g.add_argument("--genetic-population", dest="genetic_population",
+                       type=int, default=d.genetic_population)
+        g.add_argument("--genetic-generations", dest="genetic_generations",
+                       type=int, default=d.genetic_generations)
+        g.add_argument("--genetic-sigma", dest="genetic_sigma", type=float,
+                       default=d.genetic_sigma)
 
     g = p.add_argument_group("run bounds")
     g.add_argument("--max-steps", dest="max_steps", type=int, default=None)

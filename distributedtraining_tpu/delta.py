@@ -139,7 +139,11 @@ def screen_delta(delta: Params, base: Params, *, max_abs: float | None = None,
         return False, "shape_mismatch"
     if has_nonfinite(delta):
         return False, "nonfinite"
-    if max_abs is not None:
+    # <= 0 disables, exactly like None: this is THE home of that rule so
+    # callers wiring a config value through never reinvent (or forget)
+    # the translation — max_abs=0 rejecting everything would zero a whole
+    # subnet's scores
+    if max_abs is not None and max_abs > 0:
         m = global_max_abs(delta)
         if m > max_abs:
             return False, f"magnitude_exceeded({m:.3e}>{max_abs:.3e})"

@@ -34,7 +34,9 @@ def make_strategy(cfg: RunConfig, model):
     if cfg.strategy == "weighted":
         strategy = WeightedAverage(chunk_size=cfg.merge_chunk)
     elif cfg.strategy == "genetic":
-        strategy = GeneticMerge()
+        strategy = GeneticMerge(population=cfg.genetic_population,
+                                generations=cfg.genetic_generations,
+                                sigma=cfg.genetic_sigma)
     else:
         strategy = ParameterizedMerge(model, meta_epochs=cfg.meta_epochs,
                                       meta_lr=cfg.meta_lr)
@@ -56,7 +58,7 @@ def main(argv=None) -> int:
                         make_strategy(cfg, c.model),
                         val_batches=c.eval_batches(),
                         address_store=c.address_store,
-                        max_delta_abs=cfg.max_delta_abs or None,
+                        max_delta_abs=cfg.max_delta_abs,
                         metrics=c.metrics, lora_cfg=c.lora_cfg)
     loop.bootstrap(params=c.initial_params)
     try:

@@ -157,6 +157,7 @@ def build(cfg: RunConfig) -> Components:
         model,
         optimizer=default_optimizer(cfg.learning_rate,
                                     grad_clip=cfg.grad_clip,
+                                    weight_decay=cfg.weight_decay,
                                     mu_dtype=cfg.mu_dtype),
         mesh=mesh, seq_len=seq, fused_loss=cfg.fused_loss,
         accum_steps=cfg.accum_steps)

@@ -36,7 +36,7 @@ def main(argv=None) -> int:
     validator = Validator(c.engine, c.transport, c.chain,
                           eval_batches=c.eval_batches(),
                           metric=cfg.score_metric,
-                          max_delta_abs=cfg.max_delta_abs or None,
+                          max_delta_abs=cfg.max_delta_abs,
                           metrics=c.metrics, lora_cfg=c.lora_cfg)
     # the reference gates weight-setting to staked validators
     # (btt_connector.py:358-385); refuse up front instead of silently

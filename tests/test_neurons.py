@@ -160,6 +160,25 @@ def test_round2_flags_parse_into_config():
     assert d.prefetch_depth == 2
 
 
+def test_round3_flags_parse_into_config():
+    """Round-3 knobs land in RunConfig (same regression guard class)."""
+    from distributedtraining_tpu.config import RunConfig
+    m = RunConfig.from_args("miner", [
+        "--delta-dtype", "int8", "--weight-decay", "0.1", "--remat",
+        "--logits-dtype", "bfloat16", "--log-every", "7"])
+    assert m.delta_dtype == "int8" and m.weight_decay == 0.1
+    assert m.remat is True and m.log_every == 7
+    a = RunConfig.from_args("averager", [
+        "--merge-chunk", "4", "--genetic-population", "6",
+        "--genetic-generations", "3", "--genetic-sigma", "0.2",
+        "--max-delta-abs", "50"])
+    assert a.merge_chunk == 4 and a.genetic_population == 6
+    assert a.genetic_generations == 3 and a.genetic_sigma == 0.2
+    assert a.max_delta_abs == 50.0
+    v = RunConfig.from_args("validator", ["--score-metric", "perplexity"])
+    assert v.score_metric == "perplexity"
+
+
 def test_bf16_delta_round(tmp_path):
     """--delta-dtype bfloat16: the published delta is about half the f32
     artifact's bytes, and the validator/averager accept and merge it
