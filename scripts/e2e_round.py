@@ -72,7 +72,8 @@ def make_hf_checkpoint(path: str, *, model: str = "gpt2-124m",
 
 def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         corpus: str = "files:/usr/share/common-licenses/*",
-        eval_batches: int = 2, record: str | None = None) -> dict:
+        eval_batches: int = 2, record: str | None = None,
+        delta_dtype: str | None = None, signed: bool = False) -> dict:
     from neurons import averager, miner, validator
 
     # per-preset directory: a reused --work-dir with a different --model
@@ -86,13 +87,19 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         "--dp", "1", "--batch-size", "8", "--seq-len", "64",
         "--eval-seq-len", "128", "--eval-batches", str(eval_batches),
     ]
+    if signed:
+        # the full authenticity stack at protocol scale: every artifact in
+        # an Ed25519 envelope, the base signature mandatory once the
+        # averager's pubkey registers
+        common += ["--sign-artifacts", "--base-signer", "hotkey_99"]
 
     t0 = time.time()
     rc = miner.main(common + [
         "--hotkey", "hotkey_0", "--max-steps", str(steps),
         "--send-interval", "1e9", "--checkpoint-interval", "0",
         "--init-from", ckpt, "--metrics-path", metrics_path,
-        "--log-every", "5"])
+        "--log-every", "5"]
+        + (["--delta-dtype", delta_dtype] if delta_dtype else []))
     assert rc == 0, "miner failed"
     rc = validator.main(common + ["--hotkey", "hotkey_91", "--rounds", "1"])
     assert rc == 0, "validator failed"
@@ -114,10 +121,16 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
                 train_losses.append(rec["train_loss"])
     base_art = os.path.join(work_dir, "artifacts", "base",
                             "averaged_model.msgpack")
+    delta_art = os.path.join(work_dir, "artifacts", "deltas",
+                             "hotkey_0.msgpack")
     summary = {
         "protocol": "miner->delta->validator->averager, "
                     f"{model} from a pretrained-format checkpoint",
         "corpus": corpus, "tokenizer": "word (corpus-fit)",
+        "delta_dtype": delta_dtype or "float32",
+        "signed_artifacts": signed,
+        "delta_artifact_bytes": (os.path.getsize(delta_art)
+                                 if os.path.exists(delta_art) else None),
         "steps": steps, "wall_seconds": round(wall, 1),
         "train_loss_first": train_losses[0] if train_losses else None,
         "train_loss_last": train_losses[-1] if train_losses else None,
@@ -149,9 +162,16 @@ def main() -> int:
     p.add_argument("--eval-batches", type=int, default=2)
     p.add_argument("--record", default=None,
                    help="write the summary JSON here as a committed artifact")
+    p.add_argument("--delta-dtype", default=None,
+                   choices=("bfloat16", "int8"),
+                   help="compressed wire deltas for the miner")
+    p.add_argument("--signed", action="store_true",
+                   help="Ed25519-envelope every artifact (full authenticity "
+                        "stack at protocol scale)")
     a = p.parse_args()
     run(a.work_dir, steps=a.steps, model=a.model, corpus=a.corpus,
-        eval_batches=a.eval_batches, record=a.record)
+        eval_batches=a.eval_batches, record=a.record,
+        delta_dtype=a.delta_dtype, signed=a.signed)
     return 0
 
 

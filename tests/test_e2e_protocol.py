@@ -29,6 +29,24 @@ def test_protocol_round_tiny(tmp_path):
     assert summary["merged_base_published"]
 
 
+def test_protocol_round_hardened_tiny(tmp_path):
+    """The full hardened stack in one round: Ed25519-signed artifacts AND
+    int8 compressed wire deltas, through the same three CLIs."""
+    summary = run(str(tmp_path), steps=12, model="tiny", eval_batches=2,
+                  delta_dtype="int8", signed=True)
+    assert summary["validator_score_hotkey_0"] > 0
+    assert summary["signed_artifacts"] and summary["delta_dtype"] == "int8"
+    # the signed envelope magic really is on the wire artifacts, and the
+    # payload really is quantized (an ignored --delta-dtype would publish
+    # ~4x these bytes: tiny's f32 delta is ~550 KB)
+    from distributedtraining_tpu import signing
+    delta_bytes = (tmp_path / "artifacts" / "deltas" /
+                   "hotkey_0.msgpack").read_bytes()
+    assert signing.is_enveloped(delta_bytes)
+    assert summary["delta_artifact_bytes"] < 200_000, \
+        summary["delta_artifact_bytes"]
+
+
 def test_checkpoint_is_idempotent_and_bit_real(tmp_path):
     """The generated checkpoint is a real HF layout (loadable by the
     production converter) and a second call reuses it."""
