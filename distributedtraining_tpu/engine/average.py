@@ -214,6 +214,12 @@ class ParameterizedMerge:
         # the reference keeps raw weights; softmax parameterization keeps the
         # mixture normalized and is the default here (documented deviation)
         self.softmax_weights = softmax_weights
+        # (mixture, meta_step, tx) per m_pad: the jitted functions take
+        # base/stacked as ARGUMENTS, so they are reusable round after
+        # round — rebuilding them per merge() would hand jax a fresh
+        # function identity and retrace+recompile the full model fwd+bwd
+        # every averaging round
+        self._step_cache: dict[int, tuple] = {}
 
     def _build_step(self, m_pad: int):
         """``base``/``stacked`` flow through every jitted function as
@@ -222,7 +228,11 @@ class ParameterizedMerge:
         its sharding that way — the merge then silently replicates the full
         M x params stack per device instead of compiling to local partial
         sums + an ICI all-reduce (checked at the HLO level by
-        tests/test_parallel.py::test_parameterized_mesh_merge_lowers_to_allreduce)."""
+        tests/test_parallel.py::test_parameterized_mesh_merge_lowers_to_allreduce).
+        Cached per m_pad so repeated rounds reuse the compiled programs."""
+        cached = self._step_cache.get(m_pad)
+        if cached is not None:
+            return cached
         model = self.model
 
         # the stack may be zero-padded for even mesh sharding; weights are
@@ -262,7 +272,8 @@ class ParameterizedMerge:
             w = optax.apply_updates(w, updates)
             return w, opt_state, loss
 
-        return mixture, meta_step, tx
+        self._step_cache[m_pad] = (jax.jit(mixture), meta_step, tx)
+        return self._step_cache[m_pad]
 
     def merge(self, engine, base: Params, stacked: Params, miner_ids: list[str],
               *, val_batches: Callable[[], Iterable[dict]],
@@ -289,7 +300,7 @@ class ParameterizedMerge:
             logger.info("meta-learning epoch %d/%d loss=%.4f",
                         epoch + 1, self.meta_epochs,
                         float("nan") if last is None else float(last))
-        merged = jax.jit(mixture)(w, base, stacked)
+        merged = mixture(w, base, stacked)   # pre-jitted (_build_step cache)
         return merged, w
 
 
@@ -313,10 +324,11 @@ class GeneticMerge:
         m_pad = delta_lib.miner_axis_size(stacked)
         rng = jax.random.PRNGKey(self.seed)
 
-        @jax.jit
         def merge_fn(base, stacked, w):
-            # w is normalized over the real M; zero-pad to a padded stack
-            return delta_lib.weighted_merge(
+            # w is normalized over the real M; zero-pad to a padded stack.
+            # The module-level jitted merge is reused so repeated rounds
+            # (and the many per-generation fitness evals) never retrace
+            return delta_lib.weighted_merge_jit(
                 base, stacked, delta_lib.pad_merge_weights(w, m_pad))
 
         cache: dict[bytes, float] = {}

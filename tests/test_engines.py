@@ -503,3 +503,22 @@ def test_chunked_averager_round_matches_stacked(setup, tmp_path):
                     jax.tree_util.tree_leaves(stacked)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-7)
+
+
+def test_parameterized_merge_reuses_compiled_step(setup):
+    """Repeated merge() rounds must hit the cached jitted functions — a
+    fresh function identity per round would retrace and recompile the
+    full model fwd+bwd every averaging cycle."""
+    model, cfg, engine, train_batches, val_batches = setup
+    pm = ParameterizedMerge(model, meta_epochs=1, meta_lr=0.1,
+                            per_tensor=False)
+    assert pm._build_step(4) is pm._build_step(4)
+    assert pm._build_step(4) is not pm._build_step(8)  # different shape
+
+    base = model.init_params(jax.random.PRNGKey(0))
+    d = jax.tree_util.tree_map(lambda x: 0.01 * jnp.ones_like(x), base)
+    stacked = delta.stack_deltas([d, d])
+    pm.merge(engine, base, stacked, ["a", "b"], val_batches=val_batches)
+    n_after_first = len(pm._step_cache)
+    pm.merge(engine, base, stacked, ["a", "b"], val_batches=val_batches)
+    assert len(pm._step_cache) == n_after_first  # round 2 reused round 1's
