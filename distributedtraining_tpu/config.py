@@ -76,6 +76,7 @@ class RunConfig:
     eval_batches: int = 12                   # ~100 texts / batch 8 (ref :49,98)
     score_metric: str = "loss"               # loss | perplexity (ref :93-97)
     max_delta_abs: float = 1e3               # admission magnitude cap (0=off)
+    accept_quant: bool = True                # accept int8-wire submissions
     learning_rate: float = 5e-4              # neurons/miner.py:121-128
     weight_decay: float = 0.01               # AdamW decoupled decay
     grad_clip: Optional[float] = None
@@ -241,6 +242,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        help="admission screen: reject submissions whose "
                             "largest |value| exceeds this (crude poisoning "
                             "guard the reference lacks; 0 disables)")
+        g.add_argument("--no-accept-quant", dest="accept_quant",
+                       action="store_false", default=d.accept_quant,
+                       help="fleet is known all-float: reject int8-wire "
+                            "submissions instead of dequantizing, and skip "
+                            "the quant-template alloc on garbage")
     g.add_argument("--learning-rate", dest="learning_rate", type=float,
                    default=d.learning_rate)
     g.add_argument("--weight-decay", dest="weight_decay", type=float,

@@ -385,7 +385,8 @@ class AveragerLoop:
                  clock: Clock | None = None,
                  max_delta_abs: float | None = 1e3,
                  metrics=None,
-                 lora_cfg=None):
+                 lora_cfg=None,
+                 accept_quant: bool = True):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -395,6 +396,9 @@ class AveragerLoop:
         self.clock = clock or RealClock()
         self.max_delta_abs = max_delta_abs
         self.metrics = metrics
+        # False = all-float fleet: reject int8-wire submissions and skip
+        # the quant-template alloc on garbage (see Validator.accept_quant)
+        self.accept_quant = accept_quant
         # accept adapter-tree submissions alongside full-param deltas;
         # template cached once (depends only on base shapes)
         self.lora_cfg = lora_cfg
@@ -464,12 +468,14 @@ class AveragerLoop:
             d = fetch_delta_any_broadcast(
                 self.transport, hotkey, self._host_template(), self.lora_cfg,
                 lora_template=self._lora_template,
-                quant_template=self._quant_template)
+                quant_template=self._quant_template,
+                accept_quant=self.accept_quant)
         else:
             d = fetch_delta_any(self.transport, hotkey,
                                 self._host_template(), self.lora_cfg,
                                 lora_template=self._lora_template,
-                                quant_template=self._quant_template)
+                                quant_template=self._quant_template,
+                                accept_quant=self.accept_quant)
         return wire_in(self.engine, d)
 
     def _quant_template(self):

@@ -237,9 +237,23 @@ def adapter_template(base, lora_cfg: lora_lib.LoRAConfig):
         lambda s: np.zeros(s.shape, s.dtype), abstract)
 
 
+def _resolve_quant_template(quant_template, base):
+    """int8 wire template from whatever the caller passed: a lazy+cached
+    supplier (the loops), a ready tree, or None (ad-hoc callers — built
+    here, a quarter-model-bytes alloc). One resolver shared by
+    fetch_delta_any and densify_delta_bytes so the plain-transport and
+    raw-bytes paths cannot diverge."""
+    if callable(quant_template):
+        return quant_template()
+    if quant_template is None:
+        return delta_lib.quantized_template(base)
+    return quant_template
+
+
 def fetch_delta_any(transport, hotkey: str, base,
                     lora_cfg: Optional[lora_lib.LoRAConfig] = None,
-                    *, lora_template=None, quant_template=None):
+                    *, lora_template=None, quant_template=None,
+                    accept_quant: bool = True):
     """Fetch a miner's submission as a dense delta, whatever its wire form.
 
     Validates against the full-param template first, then the int8
@@ -263,24 +277,26 @@ def fetch_delta_any(transport, hotkey: str, base,
             return None
         return densify_delta_bytes(data, base, lora_cfg,
                                    lora_template=lora_template,
-                                   quant_template=quant_template)
+                                   quant_template=quant_template,
+                                   accept_quant=accept_quant)
 
     d = transport.fetch_delta(hotkey, base)
     if d is not None:
         return d
-    if callable(quant_template):
-        quant_template = quant_template()
-    elif quant_template is None:
-        quant_template = delta_lib.quantized_template(base)
-    q = transport.fetch_delta(hotkey, quant_template)
-    if q is not None:
-        # custom transports load without dtype pinning; re-check host-side
-        # before trusting the bytes (int8 is the contract — see
-        # densify_delta_bytes)
-        if not delta_lib.shapes_match(q, quant_template, check_dtype=True,
-                                      extra_dtypes=()):
-            return None
-        return jax.device_get(delta_lib.dequantize_delta(q))
+    # accept_quant=False (fleet known all-float): skip the quarter-model
+    # template alloc + second transport fetch that a garbage submission
+    # would otherwise pay on every call
+    if accept_quant:
+        quant_template = _resolve_quant_template(quant_template, base)
+        q = transport.fetch_delta(hotkey, quant_template)
+        if q is not None:
+            # custom transports load without dtype pinning; re-check
+            # host-side before trusting the bytes (int8 is the contract —
+            # see densify_delta_bytes)
+            if not delta_lib.shapes_match(q, quant_template,
+                                          check_dtype=True, extra_dtypes=()):
+                return None
+            return jax.device_get(delta_lib.dequantize_delta(q))
     if lora_cfg is None:
         return None
     if lora_template is None:
@@ -288,12 +304,17 @@ def fetch_delta_any(transport, hotkey: str, base,
     adapters = transport.fetch_delta(hotkey, lora_template)
     if adapters is None:
         return None
-    return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)
+    # host-side like every other fetch result: averagers gather up to
+    # ~100 densified full-param deltas before the chunked merge — a jnp
+    # tree here would park each one in device HBM at ingest
+    return jax.device_get(lora_lib.lora_to_full_delta(base, adapters,
+                                                      lora_cfg))
 
 
 def fetch_delta_any_broadcast(transport, hotkey: str, base_template,
                               lora_cfg: Optional[lora_lib.LoRAConfig] = None,
-                              *, lora_template=None, quant_template=None):
+                              *, lora_template=None, quant_template=None,
+                              accept_quant: bool = True):
     """Pod variant of ``fetch_delta_any``: the coordinator reads the RAW
     artifact bytes, every process receives the identical broadcast and
     densifies locally (a LoRA submission stays ~MB on the interconnect).
@@ -308,19 +329,22 @@ def fetch_delta_any_broadcast(transport, hotkey: str, base_template,
             base_template,
             lambda: fetch_delta_any(transport, hotkey, base_template,
                                     lora_cfg, lora_template=lora_template,
-                                    quant_template=quant_template))
+                                    quant_template=quant_template,
+                                    accept_quant=accept_quant))
     data = broadcast_optional_bytes(
         fetch_bytes(hotkey) if multihost.is_coordinator() else None)
     if data is None:
         return None
     return densify_delta_bytes(data, base_template, lora_cfg,
                                lora_template=lora_template,
-                               quant_template=quant_template)
+                               quant_template=quant_template,
+                               accept_quant=accept_quant)
 
 
 def densify_delta_bytes(data: bytes, base,
                         lora_cfg: Optional[lora_lib.LoRAConfig] = None,
-                        *, lora_template=None, quant_template=None):
+                        *, lora_template=None, quant_template=None,
+                        accept_quant: bool = True):
     """Validated artifact bytes -> dense delta (or None): the byte half of
     ``fetch_delta_any``, split out so a pod validator can broadcast the RAW
     bytes once (20 MB of adapters, not a densified full-model tree) and
@@ -344,18 +368,17 @@ def densify_delta_bytes(data: bytes, base,
         return ser.validated_load(data, base)
     except ser.PayloadError:
         pass
-    if callable(quant_template):   # lazy+cached supplier from the loops
-        quant_template = quant_template()
-    elif quant_template is None:
-        quant_template = delta_lib.quantized_template(base)
-    try:
-        # dtype-pinned: "q" MUST be int8 (a structurally matching f64 tree
-        # would parse at 8x the advertised bytes — see validated_load)
-        q = ser.validated_load(data, quant_template, check_dtypes=True)
-    except ser.PayloadError:
-        q = None
-    if q is not None:
-        return jax.device_get(delta_lib.dequantize_delta(q))
+    if accept_quant:
+        quant_template = _resolve_quant_template(quant_template, base)
+        try:
+            # dtype-pinned: "q" MUST be int8 (a structurally matching f64
+            # tree would parse at 8x the advertised bytes — see
+            # validated_load)
+            q = ser.validated_load(data, quant_template, check_dtypes=True)
+        except ser.PayloadError:
+            q = None
+        if q is not None:
+            return jax.device_get(delta_lib.dequantize_delta(q))
     if lora_cfg is None:
         return None
     if lora_template is None:
@@ -364,4 +387,6 @@ def densify_delta_bytes(data: bytes, base,
         adapters = ser.validated_load(data, lora_template)
     except ser.PayloadError:
         return None
-    return lora_lib.lora_to_full_delta(base, adapters, lora_cfg)
+    # host-side: see fetch_delta_any (averagers hold many of these at once)
+    return jax.device_get(lora_lib.lora_to_full_delta(base, adapters,
+                                                      lora_cfg))

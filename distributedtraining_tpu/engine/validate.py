@@ -48,7 +48,8 @@ class Validator:
                  max_delta_abs: float | None = 1e3,
                  clock: Clock | None = None,
                  metrics=None,
-                 lora_cfg=None):
+                 lora_cfg=None,
+                 accept_quant: bool = True):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -57,6 +58,10 @@ class Validator:
         self.max_delta_abs = max_delta_abs
         self.clock = clock or RealClock()
         self.metrics = metrics
+        # ``accept_quant=False``: fleet is known all-float — int8-wire
+        # submissions are rejected instead of dequantized, and garbage
+        # submissions skip the quarter-model quant-template alloc
+        self.accept_quant = accept_quant
         # accept adapter-tree submissions alongside full-param deltas
         # (engine/lora_train.py fetch_delta_any)
         self.lora_cfg = lora_cfg
@@ -181,12 +186,14 @@ class Validator:
             d = fetch_delta_any(self.transport, hotkey,
                                 self._host_template(), self.lora_cfg,
                                 lora_template=self._adapter_template(),
-                                quant_template=self._quant_template)
+                                quant_template=self._quant_template,
+                                accept_quant=self.accept_quant)
         else:
             d = fetch_delta_any_broadcast(
                 self.transport, hotkey, self._host_template(), self.lora_cfg,
                 lora_template=self._adapter_template(),
-                quant_template=self._quant_template)
+                quant_template=self._quant_template,
+                accept_quant=self.accept_quant)
         return wire_in(self.engine, d)
 
     _quant_template_cache = None

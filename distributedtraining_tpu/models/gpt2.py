@@ -27,6 +27,7 @@ import dataclasses
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.attention import causal_attention
 
@@ -254,7 +255,14 @@ def stack_blocks(params, n_layer: int, *, prefix: str = "h_",
     genuinely foreign stacked payload is still diagnosed by name at the
     loader (serialization._diagnose_block_layout_mismatch)."""
     blocks = [params[f"{prefix}{i}"] for i in range(n_layer)]
-    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    # host numpy stays host numpy: transport-fetched deltas arrive as numpy
+    # and averagers may gather ~100 of them before merging chunk-at-a-time
+    # (delta.chunked_weighted_merge) — a jnp.stack here would commit every
+    # full-param delta to device HBM at the wire boundary, defeating the
+    # merge's O(chunk x params) device-memory bound
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs) if isinstance(xs[0], np.ndarray)
+        else jnp.stack(xs), *blocks)
     out = {k: v for k, v in params.items()
            if not (k.startswith(prefix) and k[len(prefix):].isdigit())}
     out[scan_key] = {"block": stacked}

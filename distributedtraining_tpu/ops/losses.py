@@ -56,7 +56,8 @@ def perplexity(mean_loss: jax.Array) -> jax.Array:
 def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
                                labels: jax.Array,
                                loss_mask: Optional[jax.Array] = None,
-                               *, chunk: int = 4096, impl: str = "auto"
+                               *, chunk: int = 4096, impl: str = "auto",
+                               interpret: Optional[bool] = None
                                ) -> tuple[jax.Array, jax.Array]:
     """Shifted-label CE of ``logits = hidden @ head_kernel.T`` WITHOUT ever
     materializing the [N, V] logits tensor.
@@ -84,9 +85,19 @@ def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
         impl = "pallas" if pallas_ce_available(hidden, head_kernel) else "scan"
     if impl == "pallas":
         from .pallas_ce import fused_ce_loss
-        return fused_ce_loss(hidden, head_kernel, labels, loss_mask)
+        # ``interpret=True`` acknowledges a deliberate off-TPU run (numeric
+        # cross-checks); None lets the kernel resolve the backend and warn
+        # if that lands it in interpret mode
+        return fused_ce_loss(hidden, head_kernel, labels, loss_mask,
+                             interpret=interpret)
     if impl != "scan":
         raise ValueError(f"unknown fused-CE impl {impl!r}")
+    if interpret is not None:
+        # interpret is a Pallas-only knob; silently dropping it here would
+        # let an off-TPU cross-check (impl left at "auto" -> scan) compare
+        # the scan path against itself and prove nothing
+        raise ValueError("interpret= applies only to impl='pallas'; "
+                         f"this call resolved to impl={impl!r}")
     E = hidden.shape[-1]
     V = head_kernel.shape[0]
     n_chunks = -(-V // chunk)

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 from typing import Optional
 
 import jax
@@ -282,11 +283,26 @@ def fused_ce_loss(hidden: jax.Array, head_kernel: jax.Array,
     """
     if interpret is None:
         interpret = _interpret()
+        if interpret:
+            # an explicit impl='pallas' (or a direct call) off-TPU would
+            # otherwise silently run the kernels in interpret mode —
+            # orders of magnitude slower than the scan fallback the
+            # caller thinks they opted out of
+            warnings.warn(
+                "pallas fused-CE requested on a non-TPU backend; running "
+                "in Pallas INTERPRET mode (very slow). Use "
+                "fused_loss=True/'scan' off-TPU, or pass interpret=True "
+                "explicitly to silence this.", stacklevel=2)
     # on-chip tuning knobs without an edit-redeploy loop (the rig's TPU
     # access is intermittent; see scripts/measure.sh). Defaults are the
     # VMEM-budgeted analysis values in the module docstring. Validate
     # eagerly: a bad value must fail with a named error, not burn a
     # TPU-access window on a cryptic Mosaic lowering failure.
+    # NOTE: read at TRACE time — they bind at the first compile of a given
+    # jitted program; changing them in-process later does not retrace
+    # (bn/bv are not part of the program's avals). Set them before the
+    # first step, or construct a fresh engine per setting (the tuning
+    # sweep in bench.py does the latter).
     def _env_block(env: str, default: int, mult: int, why: str) -> int:
         raw = os.environ.get(env)
         if not raw:

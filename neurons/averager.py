@@ -59,7 +59,8 @@ def main(argv=None) -> int:
                         val_batches=c.eval_batches(),
                         address_store=c.address_store,
                         max_delta_abs=cfg.max_delta_abs,
-                        metrics=c.metrics, lora_cfg=c.lora_cfg)
+                        metrics=c.metrics, lora_cfg=c.lora_cfg,
+                        accept_quant=cfg.accept_quant)
     loop.bootstrap(params=c.initial_params)
     try:
         merged = loop.run_periodic(interval=cfg.averaging_interval,

@@ -251,3 +251,33 @@ def test_lora_miner_checkpoint_roundtrip(setup, tmp_path):
         # and it keeps training from there
         resumed.run(train_batches(), max_steps=2)
         assert resumed.report.steps == 10
+
+
+def test_fetch_delta_any_accept_quant_gate(setup):
+    """accept_quant=False (all-float fleet) rejects int8-wire submissions
+    on BOTH the raw-bytes path and the plain fetch_delta path — the two
+    must not diverge per transport type (round-3 review)."""
+    from distributedtraining_tpu import delta as delta_lib
+
+    model = setup[0]
+    base = jax.tree_util.tree_map(
+        np.asarray, model.init_params(jax.random.PRNGKey(0)))
+    d = jax.tree_util.tree_map(
+        lambda x: np.full(x.shape, 0.01, np.float32), base)
+    q = delta_lib.quantize_delta(d)
+
+    transport = InMemoryTransport()          # exposes fetch_delta_bytes
+    transport.publish_delta("m", q)
+
+    class _NoBytes:
+        """Same store, raw-bytes path hidden (plain-transport shape)."""
+        def __init__(self, inner):
+            self._inner = inner
+        def fetch_delta(self, miner_id, template):
+            return self._inner.fetch_delta(miner_id, template)
+
+    for t in (transport, _NoBytes(transport)):
+        got = fetch_delta_any(t, "m", base)
+        assert got is not None, type(t).__name__
+        rej = fetch_delta_any(t, "m", base, accept_quant=False)
+        assert rej is None, type(t).__name__

@@ -350,3 +350,13 @@ def test_signed_round_end_to_end(tmp_path):
     rc = validator.main(_common(tmp_path, "hotkey_91",
                                 ["--rounds", "1", *signed]))
     assert rc == 0
+
+
+def test_round4_flags_parse_into_config():
+    """Round-4 knobs land in RunConfig (same regression guard class)."""
+    from distributedtraining_tpu.config import RunConfig
+    v = RunConfig.from_args("validator", ["--no-accept-quant"])
+    assert v.accept_quant is False
+    a = RunConfig.from_args("averager", ["--no-accept-quant"])
+    assert a.accept_quant is False
+    assert RunConfig.from_args("validator", []).accept_quant is True

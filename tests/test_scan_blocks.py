@@ -346,3 +346,31 @@ def test_scan_consumer_accepts_unrolled_lora(tmp_path):
     avg.bootstrap()
     assert avg.run_round()
     assert avg.report.last_accepted == 1
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_stack_blocks_preserves_host_numpy(family):
+    """Wire -> scan conversion of a HOST tree must stay host-side.
+
+    Averagers gather up to ~100 full-param deltas before merging them
+    chunk-at-a-time (delta.chunked_weighted_merge bounds device memory at
+    O(chunk x params)); a jnp.stack at the wire boundary would commit every
+    delta to device HBM at ingest and defeat that bound (round-3 advisor,
+    medium)."""
+    if family == "gpt2":
+        mod, cfg = gpt2, gpt2.PRESETS["tiny"]
+    else:
+        mod, cfg = llama, llama.PRESETS["tiny-llama"]
+    m1, _ = mod.make_model(cfg)
+    p1 = m1.init_params(jax.random.PRNGKey(0))
+    host = jtu.tree_map(lambda x: np.asarray(x), p1)
+    stacked = mod.stack_blocks(host, cfg.n_layer)
+    assert all(isinstance(l, np.ndarray) for l in jtu.tree_leaves(stacked))
+    # and device trees still produce device stacks (the training path)
+    dev_stacked = mod.stack_blocks(p1, cfg.n_layer)
+    assert all(isinstance(l, jax.Array) for l in jtu.tree_leaves(dev_stacked))
+    # roundtrip of the host tree is lossless and host-side (index views)
+    back = mod.unstack_blocks(stacked, cfg.n_layer)
+    for a, b in zip(jtu.tree_leaves(host), jtu.tree_leaves(back)):
+        assert isinstance(b, np.ndarray)
+        np.testing.assert_array_equal(a, b)

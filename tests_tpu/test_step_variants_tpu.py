@@ -41,23 +41,41 @@ def test_scan_blocks_loss_matches_unrolled_on_chip():
 
 
 def test_accumulated_step_matches_full_batch_on_chip():
+    """accum_steps=2 vs the full batch through the REAL jitted step.
+
+    Params are compared under sgd(1.0), where params_before - params_after
+    IS the gradient — comparing after an Adam step instead would amplify
+    reduction-order rounding on any near-zero gradient into a full
+    lr-sized difference (one bias-corrected Adam step is ~lr*sign(g)
+    however small |g| is), which is what this test tripped over the first
+    time it ever ran on hardware."""
+    import optax
+
     cfg = dataclasses.replace(gpt2.PRESETS["tiny"], n_positions=SEQ,
                               dtype="float32")
     model, _ = gpt2.make_model(cfg)
     p = model.init_params(jax.random.PRNGKey(0))
-    e1 = TrainEngine(model, seq_len=SEQ)
-    e2 = TrainEngine(model, seq_len=SEQ, accum_steps=2)
-    s1 = e1.init_state(params=p)
-    s2 = e2.init_state(params=p)
-    batch = _batch(cfg, b=4)
-    s1, m1 = e1.train_step(s1, batch)
-    s2, m2 = e2.train_step(s2, batch)
+    # 'highest' forces true-f32 matmuls (bf16x6 passes): the TPU default
+    # runs f32 matmuls as single-pass bf16 multiplies, which puts
+    # reduction-order differences at bf16 scale (~4e-4 observed) and
+    # drowns the summation-order property this test pins
+    with jax.default_matmul_precision("highest"):
+        e1 = TrainEngine(model, seq_len=SEQ, optimizer=optax.sgd(1.0))
+        e2 = TrainEngine(model, seq_len=SEQ, optimizer=optax.sgd(1.0),
+                         accum_steps=2)
+        s1 = e1.init_state(params=p)
+        s2 = e2.init_state(params=p)
+        batch = _batch(cfg, b=4)
+        s1, m1 = e1.train_step(s1, batch)
+        s2, m2 = e2.train_step(s2, batch)
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
                                rtol=1e-4)
+    # identical math up to summation order: measured on-chip agreement is
+    # ~3e-8 abs / ~8e-4 rel (near-zero grads); tolerances give ~3x margin
     for a, b in zip(jax.tree_util.tree_leaves(s1.params),
                     jax.tree_util.tree_leaves(s2.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-3, atol=2e-5)
+                                   rtol=3e-3, atol=1e-6)
 
 
 def test_bf16_logits_loss_close_on_chip():
@@ -100,25 +118,42 @@ def test_flat_merge_matches_leafwise_on_chip():
 
 
 def test_pallas_fused_ce_matches_standard_on_chip():
-    """The Pallas fused-CE kernels (ops/pallas_ce.py) on real hardware:
-    train-step loss and the resulting params track the standard
-    materialized-logits step. E is lane-aligned (128) — the kernel's
-    availability gate (pallas_ce_available) requires it."""
+    """The Pallas fused-CE kernels (ops/pallas_ce.py) on real hardware —
+    the first Mosaic-lowered execution record for this kernel (interpret
+    mode off-TPU cannot catch lowering bugs).
+
+    Loss is pinned per-step; GRADIENTS are pinned through the full jitted
+    step under sgd(1.0) (param diff == grad diff). Comparing params after
+    an Adam step amplifies bf16 rounding on near-zero grads into lr-sized
+    sign-flip differences (~lr*sign(g) per step) — the original spelling
+    of this test, which failed on its first real-hardware run for exactly
+    that reason while the kernel itself was numerically fine."""
+    import optax
+
     cfg = dataclasses.replace(gpt2.PRESETS["tiny"], n_positions=SEQ,
                               n_embd=128, n_head=4)
     model, _ = gpt2.make_model(cfg)
     p = model.init_params(jax.random.PRNGKey(0))
-    std = TrainEngine(model, seq_len=SEQ)
-    pal = TrainEngine(model, seq_len=SEQ, fused_loss="pallas")
+    std = TrainEngine(model, seq_len=SEQ, optimizer=optax.sgd(1.0))
+    pal = TrainEngine(model, seq_len=SEQ, optimizer=optax.sgd(1.0),
+                      fused_loss="pallas")
     s_std = std.init_state(params=p)
     s_pal = pal.init_state(params=p)
+    first = True
     for seed in range(2):
         batch = _batch(cfg, seed=seed)
         s_std, m_std = std.train_step(s_std, batch)
         s_pal, m_pal = pal.train_step(s_pal, batch)
         np.testing.assert_allclose(float(m_pal["loss"]),
                                    float(m_std["loss"]), rtol=5e-3)
-    for a, b in zip(jax.tree_util.tree_leaves(s_std.params),
-                    jax.tree_util.tree_leaves(s_pal.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-2, atol=2e-4)
+        if first:
+            # two correct-but-different bf16 computations of the same
+            # gradients (kernel recompute vs materialized logits): one
+            # bf16 ulp of the largest params (~1e-3 abs measured on-chip);
+            # checked after the FIRST step only — later steps legitimately
+            # diverge as the parameter trajectories separate
+            for a, b in zip(jax.tree_util.tree_leaves(s_std.params),
+                            jax.tree_util.tree_leaves(s_pal.params)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-2, atol=2e-3)
+            first = False
