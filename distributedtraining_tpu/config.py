@@ -88,7 +88,8 @@ class RunConfig:
     fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
     scan_blocks: bool = False                # lax.scan the block stack
     logits_dtype: Optional[str] = None       # "bfloat16": half-size logits buf
-    delta_dtype: Optional[str] = None        # "bfloat16": half-size wire deltas
+    delta_dtype: Optional[str] = None        # bf16/int8/sparse8 wire deltas
+    delta_density: float = 1.0 / 64.0        # sparse8 kept-coordinate ratio
     remat: Optional[bool] = None             # per-block rematerialization
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
     accum_steps: int = 1                     # microbatches per optimizer step
@@ -270,7 +271,10 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "egress)")
     g.add_argument("--tokenizer", default=d.tokenizer,
                    help="auto | byte | word (corpus-fit word vocab, "
-                        "deterministic per corpus) | <hf tokenizer name>")
+                        "deterministic per corpus) | bpe (byte-level BPE "
+                        "trained locally on the machine's own text — the "
+                        "32k real-vocab tokenizer, data/bpe.py) | "
+                        "<hf tokenizer name>")
     g.add_argument("--fused-loss", dest="fused_loss", action="store_true",
                    help="compute the LM loss with a tiled head matmul that "
                         "never materializes the [batch, seq, vocab] logits "
@@ -287,14 +291,24 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "the reference's DataLoader-workers equivalent)")
     if role == "miner":  # only the miner publishes raw deltas
         g.add_argument("--delta-dtype", dest="delta_dtype",
-                       choices=("float32", "bfloat16", "int8"),
+                       choices=("float32", "bfloat16", "int8", "sparse8"),
                        default=d.delta_dtype,
                        help="wire dtype of published deltas: bfloat16 "
                             "halves artifact bytes; int8 quarters them "
                             "(per-tensor symmetric scales, rounding error "
-                            "<= 1 step per artifact). Receivers auto-detect "
-                            "every form and dequantize at ingest; merges "
+                            "<= 1 step per artifact); sparse8 keeps only "
+                            "the top-k |values| per tensor int8-quantized "
+                            "(~2%% of f32 bytes at the default "
+                            "--delta-density — the 7B/8B-config format; "
+                            "needs a raw-bytes transport, which all "
+                            "built-ins are). Receivers auto-detect every "
+                            "form and dequantize at ingest; merges "
                             "accumulate in f32")
+        g.add_argument("--delta-density", dest="delta_density", type=float,
+                       default=d.delta_density,
+                       help="sparse8 kept-coordinate ratio per tensor "
+                            "(default 1/64; small tensors <= 4096 elements "
+                            "always ship dense)")
     g.add_argument("--logits-dtype", dest="logits_dtype",
                    choices=("float32", "bfloat16"), default=d.logits_dtype,
                    help="storage dtype of the [batch, seq, vocab] logits "

@@ -269,6 +269,13 @@ def fetch_delta_any(transport, hotkey: str, base,
     round. Templates pass through lazily: a full-param submission never
     pays the quant/adapter template allocs; callers scoring many miners
     should pass per-base-revision cached templates.
+
+    sparse8 submissions require the raw-bytes path: their per-leaf k
+    varies with the publisher's density flag, so there is no fixed
+    template to fetch against. Every shipped transport exposes
+    ``fetch_delta_bytes``; a custom template-only transport scores
+    sparse8 miners 0 (document that limitation to your fleet or add the
+    bytes method).
     """
     fetch_bytes = getattr(transport, "fetch_delta_bytes", None)
     if fetch_bytes is not None:
@@ -350,9 +357,13 @@ def densify_delta_bytes(data: bytes, base,
     bytes once (20 MB of adapters, not a densified full-model tree) and
     densify identically on every process.
 
-    The try-chain discriminates the three wire forms by template: plain
-    dense tree, int8-quantized tree ({"q","scale"} leaves — dequantized
-    here so everything downstream sees floats), then LoRA adapters."""
+    The try-chain discriminates the wire forms: plain dense tree, then
+    int8-quantized tree ({"q","scale"} leaves), then the self-describing
+    sparse8 top-k format (format marker + field-wise validation against
+    the base template — k varies with the publisher's density, so it is
+    not template-discriminable), then LoRA adapters. Quantized forms
+    (int8 AND sparse8) are dequantized/densified here so everything
+    downstream sees floats; ``accept_quant=False`` rejects both."""
     from .. import serialization as ser
     from .. import signing
 
@@ -379,6 +390,9 @@ def densify_delta_bytes(data: bytes, base,
             q = None
         if q is not None:
             return jax.device_get(delta_lib.dequantize_delta(q))
+        sp = delta_lib.sparse_delta_from_bytes(data, base)
+        if sp is not None:
+            return sp
     if lora_cfg is None:
         return None
     if lora_template is None:

@@ -114,13 +114,14 @@ def _default_lm_loss(model, params, batch):
     return causal_lm_loss(logits, batch["input_ids"], batch.get("loss_mask"))
 
 
-def _fused_lm_loss(model, params, batch, impl: str = "auto"):
+def _fused_lm_loss(model, params, batch, impl: str = "auto", mesh=None):
     """Same contract as _default_lm_loss but the [B, T, V] logits never
     materialize: the model returns hidden states and the head matmul runs
     tile-by-tile inside fused_linear_cross_entropy (``impl`` selects the
-    Pallas kernels or the portable lax.scan spelling). Requires a model
-    exposing ``return_hidden`` with a [V, E] head param — ``lm_head``
-    (Llama) or the tied ``wte`` (GPT-2)."""
+    Pallas kernels or the portable lax.scan spelling; impl='pallas' with a
+    ``mesh`` routes to the shard_map spelling). Requires a model exposing
+    ``return_hidden`` with a [V, E] head param — ``lm_head`` (Llama) or
+    the tied ``wte`` (GPT-2)."""
     from ..ops.losses import fused_linear_cross_entropy
 
     hidden = model.apply(
@@ -133,7 +134,7 @@ def _fused_lm_loss(model, params, batch, impl: str = "auto"):
     mask = batch.get("loss_mask")
     return fused_linear_cross_entropy(
         hidden[:, :-1, :], head, batch["input_ids"][:, 1:],
-        None if mask is None else mask[:, 1:], impl=impl)
+        None if mask is None else mask[:, 1:], impl=impl, mesh=mesh)
 
 
 class TrainEngine:
@@ -178,19 +179,30 @@ class TrainEngine:
                 # train_step trace
                 raise ValueError(f"unknown fused_loss impl {impl!r}; "
                                  "expected True, 'auto', 'pallas' or 'scan'")
+            loss_mesh = None
             if mesh is not None:
-                # pallas_call is not auto-partitionable under pjit: on a
-                # mesh the sharded-logits-free path is the scan spelling
-                # (GSPMD partitions its tiles fine). Explicit "pallas" on a
-                # mesh would need a shard_map wrapper that doesn't exist
-                # yet — refuse rather than compile something degenerate.
+                # pallas_call is not auto-partitionable under pjit.
+                # Explicit "pallas" on a mesh takes the shard_map spelling
+                # (ops/pallas_ce.fused_ce_loss_sharded: rows split across
+                # dp/fsdp AND tp, head all-gathered per device, totals
+                # psummed); "auto"/True stays on the lax.scan spelling,
+                # which GSPMD partitions without manual collectives.
                 if impl == "pallas":
-                    raise ValueError(
-                        "fused_loss='pallas' is single-device for now; on a "
-                        "mesh use fused_loss=True/'scan' (the lax.scan "
-                        "spelling partitions under GSPMD)")
-                impl = "scan"
-            loss_fn = functools.partial(_fused_lm_loss, impl=impl)
+                    if any(mesh.shape.get(a, 1) > 1
+                           for a in mesh.axis_names
+                           if a not in ("dp", "fsdp", "tp")):
+                        # the label shift in _fused_lm_loss crosses
+                        # sequence-shard boundaries — sp (ring attention)
+                        # runs take the scan spelling
+                        raise ValueError(
+                            "fused_loss='pallas' composes with dp/fsdp/tp "
+                            "meshes; for sp/other axes use "
+                            "fused_loss=True/'scan'")
+                    loss_mesh = mesh
+                else:
+                    impl = "scan"
+            loss_fn = functools.partial(_fused_lm_loss, impl=impl,
+                                        mesh=loss_mesh)
         self.model = model
         self.tx = optimizer or default_optimizer()
         self.mesh = mesh
@@ -599,7 +611,8 @@ class MinerLoop:
                  metrics=None,
                  log_every: int = 1000,               # ref :394-402
                  nan_guard: bool = True,
-                 delta_dtype: str | None = None,      # bf16 wire deltas
+                 delta_dtype: str | None = None,      # bf16/int8/sparse8 wire
+                 delta_density: float = 1.0 / 64.0,   # sparse8 top-k density
                  checkpoint_store=None,
                  checkpoint_interval: float = 600.0,
                  trace=None):
@@ -613,6 +626,13 @@ class MinerLoop:
         self.log_every = log_every
         self.nan_guard = nan_guard
         self.delta_dtype = delta_dtype
+        if not 0.0 < delta_density <= 1.0:
+            # fail at construction: the first validation inside sparsify
+            # happens at the first PUSH, a full send-interval of training
+            # later — work a bad flag would discard
+            raise ValueError(f"delta_density must be in (0, 1], "
+                             f"got {delta_density}")
+        self.delta_density = delta_density
         self.checkpoint_store = checkpoint_store
         self.report = MinerReport()
         # device-resident copy of the newest step's loss; fetched to
@@ -874,29 +894,37 @@ class MinerLoop:
     _compute_delta = staticmethod(
         jax.jit(delta_lib.compute_delta, static_argnames=("wire_dtype",)))
     _quantize = staticmethod(jax.jit(delta_lib.quantize_delta))
+    _sparsify = staticmethod(jax.jit(delta_lib.sparsify_delta,
+                                     static_argnames=("density",)))
 
     def _push_delta(self) -> None:
         if self.state is None:
             return
         d = self._compute_delta(
             self.state.params, self.base_params,
-            wire_dtype=None if self.delta_dtype == "int8" else self.delta_dtype)
+            wire_dtype=None if self.delta_dtype in ("int8", "sparse8")
+            else self.delta_dtype)
         if self.nan_guard and delta_lib.has_nonfinite(d):
             logger.warning("miner %s: delta has non-finite values, not pushing",
                            self.miner_id)
             return
         # artifacts travel in the unrolled wire layout (see wire_out);
-        # int8 quantization runs on the WIRE tree so scales are per wire
-        # tensor (per block under scan_blocks, not per stacked stack).
-        # NO error feedback: artifacts replace each other (each push is
-        # the whole cumulative delta), so carrying a residual into the
-        # next push would add the superseded push's rounding error.
+        # int8/sparse8 compression runs on the WIRE tree so scales and
+        # top-k selections are per wire tensor (per block under
+        # scan_blocks, not per stacked stack). NO error feedback:
+        # artifacts replace each other (each push is the whole cumulative
+        # delta), so carrying a residual into the next push would add the
+        # superseded push's rounding error.
         payload = wire_out(self.engine, d)
         if self.delta_dtype == "int8":
             payload = self._quantize(payload)
+        elif self.delta_dtype == "sparse8":
+            payload = self._sparsify(payload, density=self.delta_density)
         try:
             self.transport.publish_delta(self.miner_id, payload)
             self.report.pushes += 1
+            logger.info("miner %s: pushed delta #%d", self.miner_id,
+                        self.report.pushes)
         except Exception:  # push failures must not kill training (ref :410-431)
             logger.exception("miner %s: delta push failed", self.miner_id)
 

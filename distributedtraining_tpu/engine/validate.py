@@ -232,6 +232,8 @@ class Validator:
         from .train import broadcast_metagraph
         return broadcast_metagraph(self.chain)
 
+    _round = 0
+
     def validate_and_score(self) -> list[MinerScore]:
         """One validation round (validate_and_score,
         validation_logic.py:99-189)."""
@@ -241,12 +243,33 @@ class Validator:
         for hotkey in meta.hotkeys:
             if hotkey == self.chain.my_hotkey:
                 continue
-            s = self.score_miner(hotkey)
-            results.append(s)
-            if self.metrics:
-                self.metrics.log({f"loss_{s.hotkey}": s.loss,
-                                  f"score_{s.hotkey}": s.score})
+            results.append(self.score_miner(hotkey))
         scored = {s.hotkey: s.score for s in results}
+        if self.metrics:
+            # BOUNDED metric-name cardinality: the reference logged
+            # loss_<hotkey>/score_<hotkey> per miner — unbounded label
+            # space that melts a metrics backend past a few hundred uids.
+            # Here the per-round summary uses a fixed key set; the full
+            # per-miner detail rides ONE structured record (JSONL keeps
+            # it verbatim; MLflowSink's numeric filter drops it, keeping
+            # the backend's series count constant).
+            with_loss = [s for s in results if s.loss is not None]
+            positive = [s for s in results if s.score > 0]
+            self.metrics.log({
+                "scored": len(results),
+                "rejected": len(results) - len(with_loss),
+                "score_positive": len(positive),
+                "score_mean": (sum(s.score for s in results)
+                               / max(len(results), 1)),
+                "score_max": max((s.score for s in results), default=0.0),
+                "loss_best": min((s.loss for s in with_loss),
+                                 default=float("nan")),
+                "base_loss": self.base_loss,
+                "round_scores": {
+                    s.hotkey: {"score": s.score, "loss": s.loss,
+                               "reason": s.reason} for s in results},
+            }, step=self._round)
+        self._round += 1
         if self.chain.should_set_weights():
             if self.has_vpermit(meta):
                 self.chain.set_weights(scored)  # EMA+normalize inside chain

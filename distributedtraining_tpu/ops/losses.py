@@ -57,7 +57,8 @@ def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
                                labels: jax.Array,
                                loss_mask: Optional[jax.Array] = None,
                                *, chunk: int = 4096, impl: str = "auto",
-                               interpret: Optional[bool] = None
+                               interpret: Optional[bool] = None,
+                               mesh=None
                                ) -> tuple[jax.Array, jax.Array]:
     """Shifted-label CE of ``logits = hidden @ head_kernel.T`` WITHOUT ever
     materializing the [N, V] logits tensor.
@@ -84,10 +85,15 @@ def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
         from .pallas_ce import pallas_ce_available
         impl = "pallas" if pallas_ce_available(hidden, head_kernel) else "scan"
     if impl == "pallas":
-        from .pallas_ce import fused_ce_loss
         # ``interpret=True`` acknowledges a deliberate off-TPU run (numeric
         # cross-checks); None lets the kernel resolve the backend and warn
         # if that lands it in interpret mode
+        if mesh is not None:
+            from .pallas_ce import fused_ce_loss_sharded
+            return fused_ce_loss_sharded(hidden, head_kernel, labels,
+                                         loss_mask, mesh=mesh,
+                                         interpret=interpret)
+        from .pallas_ce import fused_ce_loss
         return fused_ce_loss(hidden, head_kernel, labels, loss_mask,
                              interpret=interpret)
     if impl != "scan":

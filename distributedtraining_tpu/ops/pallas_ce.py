@@ -268,6 +268,40 @@ def _round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult
 
 
+def _env_block(env: str, default: int, mult: int, why: str) -> int:
+    raw = os.environ.get(env)
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(f"{env}={raw!r} is not an integer") from None
+    if val <= 0 or val % mult:
+        raise ValueError(f"{env}={val} must be a positive multiple "
+                         f"of {mult} ({why})")
+    return val
+
+
+def _resolve_blocks(block_n: int, block_v: int) -> tuple[int, int]:
+    """On-chip tuning knobs without an edit-redeploy loop (the rig's TPU
+    access is intermittent; see scripts/measure.sh). Defaults are the
+    VMEM-budgeted analysis values in the module docstring. Validated
+    eagerly: a bad value must fail with a named error, not burn a
+    TPU-access window on a cryptic Mosaic lowering failure.
+
+    NOTE: read at TRACE time — they bind at the first compile of a given
+    jitted program; changing them in-process later does not retrace
+    (bn/bv are not part of the program's avals). Set them before the
+    first step, or construct a fresh engine per setting (the tuning
+    sweep in bench.py does the latter).
+
+    BN is a sublane dim (16 covers the strictest bf16 tiling); BV is the
+    MINORMOST dim of the logits tiles — sub-128 lanes are the narrow-lane
+    Mosaic trap the module docstring warns about."""
+    return (_env_block("DT_PALLAS_CE_BN", block_n, 16, "sublane tiling"),
+            _env_block("DT_PALLAS_CE_BV", block_v, 128, "lane width"))
+
+
 def fused_ce_loss(hidden: jax.Array, head_kernel: jax.Array,
                   labels: jax.Array,
                   loss_mask: Optional[jax.Array] = None,
@@ -293,34 +327,23 @@ def fused_ce_loss(hidden: jax.Array, head_kernel: jax.Array,
                 "in Pallas INTERPRET mode (very slow). Use "
                 "fused_loss=True/'scan' off-TPU, or pass interpret=True "
                 "explicitly to silence this.", stacklevel=2)
-    # on-chip tuning knobs without an edit-redeploy loop (the rig's TPU
-    # access is intermittent; see scripts/measure.sh). Defaults are the
-    # VMEM-budgeted analysis values in the module docstring. Validate
-    # eagerly: a bad value must fail with a named error, not burn a
-    # TPU-access window on a cryptic Mosaic lowering failure.
-    # NOTE: read at TRACE time — they bind at the first compile of a given
-    # jitted program; changing them in-process later does not retrace
-    # (bn/bv are not part of the program's avals). Set them before the
-    # first step, or construct a fresh engine per setting (the tuning
-    # sweep in bench.py does the latter).
-    def _env_block(env: str, default: int, mult: int, why: str) -> int:
-        raw = os.environ.get(env)
-        if not raw:
-            return default
-        try:
-            val = int(raw)
-        except ValueError:
-            raise ValueError(f"{env}={raw!r} is not an integer") from None
-        if val <= 0 or val % mult:
-            raise ValueError(f"{env}={val} must be a positive multiple "
-                             f"of {mult} ({why})")
-        return val
+    block_n, block_v = _resolve_blocks(block_n, block_v)
+    total, count = _fused_ce_totals(hidden, head_kernel, labels, loss_mask,
+                                    block_n=block_n, block_v=block_v,
+                                    interpret=interpret)
+    return total / jnp.maximum(count, 1.0), jnp.maximum(count, 1.0)
 
-    # BN is a sublane dim (16 covers the strictest bf16 tiling); BV is the
-    # MINORMOST dim of the logits tiles — sub-128 lanes are the narrow-lane
-    # Mosaic trap the module docstring warns about
-    block_n = _env_block("DT_PALLAS_CE_BN", block_n, 16, "sublane tiling")
-    block_v = _env_block("DT_PALLAS_CE_BV", block_v, 128, "lane width")
+
+def _fused_ce_totals(hidden: jax.Array, head_kernel: jax.Array,
+                     labels: jax.Array,
+                     loss_mask: Optional[jax.Array],
+                     *, block_n: int, block_v: int,
+                     interpret: bool) -> tuple[jax.Array, jax.Array]:
+    """(sum of masked per-token losses, RAW mask sum) — the un-normalized
+    half of ``fused_ce_loss``, split out so the shard_map spelling can
+    psum totals across devices before normalizing (a per-shard
+    ``max(count, 1)`` clamp would silently inflate the denominator for
+    shards whose rows are all padding)."""
     e = hidden.shape[-1]
     v = head_kernel.shape[0]
     h = hidden.reshape(-1, e)
@@ -348,6 +371,104 @@ def fused_ce_loss(hidden: jax.Array, head_kernel: jax.Array,
         msk = loss_mask.astype(per_tok.dtype)
     else:
         msk = jnp.ones_like(per_tok)
-    total = jnp.sum(per_tok * msk)
-    count = jnp.maximum(jnp.sum(msk), 1.0)
-    return total / count, count
+    return jnp.sum(per_tok * msk), jnp.sum(msk)
+
+
+# ---------------------------------------------------------------------------
+# mesh spelling: the same kernels under shard_map
+# ---------------------------------------------------------------------------
+
+def fused_ce_loss_sharded(hidden: jax.Array, head_kernel: jax.Array,
+                          labels: jax.Array,
+                          loss_mask: Optional[jax.Array] = None,
+                          *, mesh, block_n: int = 1024, block_v: int = 512,
+                          interpret: Optional[bool] = None
+                          ) -> tuple[jax.Array, jax.Array]:
+    """``fused_ce_loss`` on a dp/fsdp/tp mesh (shard_map over the Pallas
+    kernels — pallas_call is not auto-partitionable under GSPMD, which is
+    why the plain spelling is single-device).
+
+    Layout (parallel/sharding.py rules): hidden [B, T, E] rides the batch
+    sharding P(('dp','fsdp'), None, None); the head [V, E] is a param
+    sharded P('tp', 'fsdp'). Per device: the head shard is all-gathered
+    (the SAME traffic GSPMD inserts for the materialized-logits matmul
+    against these shardings), the device's rows are then split across tp
+    as well — every device computes a DISTINCT row chunk against the full
+    vocabulary, so tp scales the kernel instead of duplicating it — and
+    the masked totals psum across the whole mesh. Reverse-mode AD of the
+    shard_map transposes the all-gathers into psum_scatters, landing dW
+    shards exactly where the optimizer expects them.
+
+    sp meshes are refused by the engine routing (the label shift in
+    _fused_lm_loss crosses sequence-shard boundaries); ring-attention runs
+    take the scan spelling instead.
+    """
+    from jax.sharding import PartitionSpec as P
+    try:  # moved in newer jax
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.shard_map import shard_map
+
+    if interpret is None:
+        interpret = _interpret()
+        if interpret:
+            warnings.warn(
+                "pallas fused-CE (sharded) requested on a non-TPU backend; "
+                "running in Pallas INTERPRET mode (very slow). Use "
+                "fused_loss=True/'scan' off-TPU, or pass interpret=True "
+                "explicitly to silence this.", stacklevel=2)
+    block_n, block_v = _resolve_blocks(block_n, block_v)
+
+    names = mesh.axis_names
+    row_axes = tuple(a for a in ("dp", "fsdp") if a in names)
+    tp_ax = "tp" if "tp" in names else None
+    fsdp_ax = "fsdp" if "fsdp" in names else None
+    tp = int(mesh.shape[tp_ax]) if tp_ax else 1
+    psum_axes = row_axes + ((tp_ax,) if tp_ax else ())
+
+    if loss_mask is None:
+        loss_mask = jnp.ones(labels.shape, jnp.float32)
+
+    def local(h, w, y, m):
+        # reassemble the full head from its tp x fsdp shards
+        if fsdp_ax is not None:
+            w = jax.lax.all_gather(w, fsdp_ax, axis=1, tiled=True)
+        if tp_ax is not None:
+            w = jax.lax.all_gather(w, tp_ax, axis=0, tiled=True)
+        e = h.shape[-1]
+        h2 = h.reshape(-1, e)
+        y2 = y.reshape(-1)
+        m2 = m.reshape(-1)
+        if tp > 1:
+            # this device's slice of the local rows: tp peers hold the
+            # same batch shard, so carving it up makes tp a second data
+            # axis for the kernel (zero duplicate FLOPs). Padding rows
+            # carry mask 0 and vanish from both totals; AD transposes the
+            # pad back to a slice for dh.
+            n = h2.shape[0]
+            n_pad = _round_up(n, tp)
+            if n_pad > n:
+                h2 = jnp.pad(h2, ((0, n_pad - n), (0, 0)))
+                y2 = jnp.pad(y2, (0, n_pad - n))
+                m2 = jnp.pad(m2, (0, n_pad - n))
+            per = n_pad // tp
+            i = jax.lax.axis_index(tp_ax)
+            h2 = jax.lax.dynamic_slice_in_dim(h2, i * per, per, 0)
+            y2 = jax.lax.dynamic_slice_in_dim(y2, i * per, per, 0)
+            m2 = jax.lax.dynamic_slice_in_dim(m2, i * per, per, 0)
+        total, count = _fused_ce_totals(h2, w, y2, m2, block_n=block_n,
+                                        block_v=block_v, interpret=interpret)
+        total = jax.lax.psum(total, psum_axes)
+        count = jax.lax.psum(count, psum_axes)
+        return total, count
+
+    total, count = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(row_axes or None, None, None),
+                  P(tp_ax, fsdp_ax),
+                  P(row_axes or None, None),
+                  P(row_axes or None, None)),
+        out_specs=(P(), P()),
+        check_rep=False,
+    )(hidden, head_kernel, labels, loss_mask)
+    return total / jnp.maximum(count, 1.0), jnp.maximum(count, 1.0)

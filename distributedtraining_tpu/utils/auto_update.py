@@ -77,12 +77,47 @@ class AutoUpdater:
                  update_cmd: Sequence[str] | None = ("git", "pull",
                                                      "--ff-only"),
                  repo_dir: str = ".",
-                 restart: Callable[[], None] | None = None):
+                 restart: Callable[[], None] | None = None,
+                 hard_recovery_ref: Optional[str] = "origin/main"):
+        """``hard_recovery_ref``: when the polite ``update_cmd`` fails (a
+        dirty or diverged tree — an operator's local edit, a crashed
+        half-merge), fall back to ``git fetch && git reset --hard <ref>``
+        so a fleet member never stays wedged on old code. The reference
+        achieves the same end by re-cloning the whole repo on version
+        mismatch (run_miner.sh:229-268); a hard reset converges to the
+        identical tree without re-downloading history. None disables the
+        fallback (deployments where local state must never be discarded)."""
         self.current_version = current_version
         self.version_source = version_source
         self.update_cmd = list(update_cmd) if update_cmd else None
         self.repo_dir = repo_dir
         self.restart = restart if restart is not None else self._reexec
+        self.hard_recovery_ref = hard_recovery_ref
+
+    def _run(self, cmd: Sequence[str]) -> bool:
+        try:
+            subprocess.run(list(cmd), cwd=self.repo_dir, check=True,
+                           timeout=300, capture_output=True)
+            return True
+        except (subprocess.SubprocessError, OSError):
+            return False
+
+    def _update(self) -> bool:
+        if self._run(self.update_cmd):
+            return True
+        if self.hard_recovery_ref is None:
+            logger.error("auto-update: update command failed and hard "
+                         "recovery is disabled; not restarting")
+            return False
+        logger.warning("auto-update: %s failed (dirty/diverged tree?); "
+                       "hard-recovering to %s",
+                       " ".join(self.update_cmd), self.hard_recovery_ref)
+        ok = (self._run(("git", "fetch", "--quiet"))
+              and self._run(("git", "reset", "--hard",
+                             self.hard_recovery_ref)))
+        if not ok:
+            logger.error("auto-update: hard recovery failed; not restarting")
+        return ok
 
     def check(self) -> bool:
         """One poll. Returns True when an update was triggered (the default
@@ -95,14 +130,8 @@ class AutoUpdater:
         if published is None or published == self.current_version:
             return False
         logger.info("auto-update: %s -> %s", self.current_version, published)
-        if self.update_cmd:
-            try:
-                subprocess.run(self.update_cmd, cwd=self.repo_dir,
-                               check=True, timeout=300, capture_output=True)
-            except (subprocess.SubprocessError, OSError):
-                logger.exception("auto-update: update command failed; "
-                                 "not restarting")
-                return False
+        if self.update_cmd and not self._update():
+            return False
         self.restart()
         return True
 

@@ -30,6 +30,36 @@ logger = logging.getLogger(__name__)
 POISON_MODES = ("nan", "shape", "huge", "garbage", "forged")
 
 
+def benign_delta(template: Any, rng: np.random.Generator,
+                 scale: float = 1e-3):
+    """A plausible random delta shaped like ``template``."""
+    return jax.tree_util.tree_map(
+        lambda x: (rng.standard_normal(np.shape(x)) * scale)
+        .astype(np.float32), template)
+
+
+def poisoned_delta(template: Any, mode: str, rng: np.random.Generator,
+                   scale: float = 1e-3):
+    """A hostile delta for ``mode`` in {"nan","shape","huge"} — each maps
+    to exactly one admission screen (module docstring). The byte-level
+    modes ("garbage","forged") need a transport and live on
+    LoadGenerator. Public so protocol-scale scenarios (e.g.
+    scripts/e2e_discriminate.py) can poison a SPECIFIC chain hotkey
+    rather than a generated identity."""
+    d = benign_delta(template, rng, scale)
+    leaves, treedef = jax.tree_util.tree_flatten(d)
+    if mode == "nan":
+        leaves[0] = leaves[0].copy()
+        leaves[0].flat[0] = np.nan
+    elif mode == "shape":
+        leaves[0] = np.zeros(np.asarray(leaves[0]).shape + (2,), np.float32)
+    elif mode == "huge":
+        leaves[0] = leaves[0] + np.float32(1e9)
+    else:
+        raise ValueError(f"unknown tree-level poison mode {mode!r}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 @dataclasses.dataclass
 class LoadReport:
     published: int = 0
@@ -62,23 +92,10 @@ class LoadGenerator:
             m for m in POISON_MODES if m != "forged")
 
     def _benign_delta(self):
-        return jax.tree_util.tree_map(
-            lambda x: (self.rng.standard_normal(np.shape(x))
-                       * self.scale).astype(np.float32),
-            self.template)
+        return benign_delta(self.template, self.rng, self.scale)
 
     def _poisoned_delta(self, mode: str):
-        d = self._benign_delta()
-        leaves, treedef = jax.tree_util.tree_flatten(d)
-        if mode == "nan":
-            leaves[0] = leaves[0].copy()
-            leaves[0].flat[0] = np.nan
-        elif mode == "shape":
-            leaves[0] = np.zeros(np.asarray(leaves[0]).shape + (2,),
-                                 np.float32)
-        elif mode == "huge":
-            leaves[0] = leaves[0] + np.float32(1e9)
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+        return poisoned_delta(self.template, mode, self.rng, self.scale)
 
     def publish_round(self) -> LoadReport:
         """One wave: every identity publishes once; a ``poison_fraction`` of

@@ -281,6 +281,16 @@ def build(cfg: RunConfig) -> Components:
         tokenizer = WordTokenizer(
             text_corpus(split="train", source=cfg.dataset),
             vocab_size=model_cfg.vocab_size)
+    elif cfg.tokenizer == "bpe":
+        # REAL byte-level BPE (GPT-2's algorithm) trained locally on the
+        # machine's own text — the big-vocab production tokenizer with
+        # zero egress (data/bpe.py). Saved under the work_dir so the
+        # three roles of a deployment train it once.
+        from distributedtraining_tpu.data.bpe import BPETokenizer
+        tokenizer = BPETokenizer.train_or_load(
+            os.path.join(cfg.work_dir, "tokenizer",
+                         f"bpe-{min(model_cfg.vocab_size, 32000)}.json"),
+            vocab_size=min(model_cfg.vocab_size, 32000))
     else:
         tokenizer = load_tokenizer(
             "gpt2" if cfg.tokenizer == "auto" else cfg.tokenizer)

@@ -68,6 +68,7 @@ def main(argv=None) -> int:
                          metrics=c.metrics, log_every=cfg.log_every,
                          delta_dtype=(None if cfg.delta_dtype == "float32"
                                       else cfg.delta_dtype),
+                         delta_density=cfg.delta_density,
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval,
                          trace=trace)

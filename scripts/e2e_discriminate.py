@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Discriminating multi-miner round: does the validator actually RANK?
+
+The subnet's raison d'etre is ordering miners by contribution
+(/root/reference/hivetrain/validation_logic.py:99-189: score each delta
+against the shared base, emit normalized chain weights). The committed
+single-miner E2Es prove the protocol plumbing; this scenario proves the
+DISCRIMINATION:
+
+- three miners train from the SAME published base with deliberately
+  unequal step budgets (strong/medium/weak) on decorrelated data shards
+  (per-hotkey shuffle seeds, neurons/common.py),
+- one additional chain identity publishes a loadgen-poisoned artifact
+  (mode "huge" -> the max-abs admission screen),
+- the validator's RAW scores (base_loss - candidate_loss, pre-EMA,
+  pre-u16) must be strictly ordered strong > medium > weak > 0 and the
+  poisoned identity must be rejected with a named reason,
+- ParameterizedMerge (scalar per-miner weights, softmax) must learn
+  mixing weights whose ordering agrees with the validator's scores,
+- the merged base must beat the pre-round base on the eval set.
+
+Runs everything through the real components (RunConfig/build, the role
+CLI for miners, library Validator/ParameterizedMerge for raw access to
+scores and merge weights). Records E2E_r04_discriminate.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributedtraining_tpu.utils.platform import (  # noqa: E402
+    force_platform_from_env)
+
+force_platform_from_env()
+
+
+def run(work_dir: str, *, model: str = "gpt2-124m",
+        steps: tuple[int, int, int] = (60, 25, 8),
+        eval_batches: int = 3, meta_epochs: int = 3,
+        record: str | None = None) -> dict:
+    import numpy as np
+
+    from distributedtraining_tpu.config import RunConfig
+    from distributedtraining_tpu.engine import ParameterizedMerge, Validator
+    from distributedtraining_tpu.engine.average import AveragerLoop
+    from distributedtraining_tpu.utils import loadgen
+    from neurons import miner
+    from neurons.common import build
+    from scripts.e2e_round import make_hf_checkpoint
+
+    ckpt = make_hf_checkpoint(os.path.join(work_dir, f"pretrained-{model}"),
+                              model=model)
+    common = [
+        "--backend", "local", "--work-dir", work_dir,
+        "--model", model,
+        "--dataset", "files:/usr/share/common-licenses/*",
+        "--tokenizer", "word", "--dp", "1", "--batch-size", "8",
+        "--seq-len", "64", "--eval-seq-len", "128",
+        "--eval-batches", str(eval_batches),
+    ]
+
+    t0 = time.time()
+    miners = ["hotkey_0", "hotkey_1", "hotkey_2"]
+    for hotkey, n in zip(miners, steps):
+        rc = miner.main(common + [
+            "--hotkey", hotkey, "--max-steps", str(n),
+            "--send-interval", "1e9", "--checkpoint-interval", "0",
+            "--init-from", ckpt])
+        assert rc == 0, f"miner {hotkey} failed"
+
+    # the poisoned identity: a REGISTERED chain hotkey publishing a
+    # magnitude-poisoned artifact (loadgen mode "huge" -> max-abs screen)
+    vcfg = RunConfig.from_args("validator", common + ["--hotkey",
+                                                      "hotkey_91"])
+    c = build(vcfg)
+    template = c.engine.model.init_params  # noqa: F841 (template below)
+    import jax
+    host_template = jax.tree_util.tree_map(
+        lambda x: np.zeros(x.shape, np.float32),
+        jax.eval_shape(lambda: c.engine.model.init_params(
+            jax.random.PRNGKey(0))))
+    poisoned = "hotkey_3"
+    c.transport.publish_delta(
+        poisoned,
+        loadgen.poisoned_delta(host_template, "huge",
+                               np.random.default_rng(7)))
+
+    validator = Validator(c.engine, c.transport, c.chain,
+                          eval_batches=c.eval_batches(),
+                          max_delta_abs=vcfg.max_delta_abs)
+    validator.bootstrap()
+    results = {s.hotkey: s for s in validator.validate_and_score()}
+    raw = {h: results[h].score for h in miners}
+    pois = results[poisoned]
+
+    # -- merge with meta-learned scalar weights ------------------------------
+    acfg = RunConfig.from_args("averager", common + ["--hotkey",
+                                                     "hotkey_99"])
+    ca = build(acfg)
+    strategy = ParameterizedMerge(ca.model, meta_epochs=meta_epochs,
+                                  per_tensor=False)
+    loop = AveragerLoop(ca.engine, ca.transport, ca.chain, strategy,
+                        val_batches=ca.eval_batches(),
+                        max_delta_abs=acfg.max_delta_abs)
+    loop.bootstrap()
+    base_loss, _ = ca.engine.evaluate(loop.base_params, ca.eval_batches()())
+    ids, deltas = loop.gather_deltas()
+    assert poisoned not in ids, "averager accepted the poisoned artifact"
+    from distributedtraining_tpu import delta as delta_lib
+    stacked = delta_lib.stack_deltas(deltas)
+    merged, w = strategy.merge(ca.engine, loop.base_params, stacked, ids,
+                               val_batches=ca.eval_batches())
+    import jax.numpy as jnp
+    mix = {h: float(x) for h, x in zip(ids, jnp.asarray(
+        jax.nn.softmax(w)))}
+    merged_loss, _ = ca.engine.evaluate(merged, ca.eval_batches()())
+    wall = time.time() - t0
+
+    chain_meta = json.loads(open(os.path.join(
+        work_dir, "chain", "metagraph.json")).read())
+    emitted = chain_meta["weights"].get("hotkey_91", {})
+
+    summary = {
+        "scenario": "discriminating multi-miner round "
+                    f"({model}; unequal budgets {list(steps)}; one "
+                    "loadgen-poisoned identity)",
+        "steps": dict(zip(miners, steps)),
+        "raw_scores": raw,
+        "poisoned": {"hotkey": poisoned, "score": pois.score,
+                     "reason": pois.reason},
+        "chain_weights_u16": {h: emitted.get(h, 0)
+                              for h in miners + [poisoned]},
+        "merge_weights_softmax": mix,
+        "base_loss": float(base_loss),
+        "merged_loss": float(merged_loss),
+        "wall_seconds": round(wall, 1),
+    }
+
+    # the discrimination assertions
+    s0, s1, s2 = (raw[h] for h in miners)
+    assert s0 > s1 > s2 > 0, f"scores not strictly ordered: {raw}"
+    assert pois.score == 0 and pois.reason.startswith("magnitude_exceeded"), \
+        f"poisoned identity not screened: {pois}"
+    assert emitted.get(poisoned, 0) == 0, "poisoned identity got weight"
+    assert max((raw[h] for h in miners), default=0) == s0
+    # merge weights agree with the score ordering at the extremes: the
+    # strong miner must not be out-weighed by the weak one
+    assert mix[miners[0]] >= mix[miners[2]], \
+        f"merge weights contradict scores: {mix} vs {raw}"
+    assert merged_loss <= base_loss, (merged_loss, base_loss)
+    # non-saturated evidence: raw scores are loss deltas, not u16 caps
+    assert all(0 < raw[h] < 20 for h in miners), raw
+
+    if record:
+        with open(record, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    return summary
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--work-dir", default="./e2e_discriminate_run")
+    p.add_argument("--model", default="gpt2-124m")
+    p.add_argument("--steps", default="60,25,8",
+                   help="strong,medium,weak miner step budgets")
+    p.add_argument("--eval-batches", type=int, default=3)
+    p.add_argument("--meta-epochs", type=int, default=3)
+    p.add_argument("--record", default=None)
+    a = p.parse_args()
+    steps = tuple(int(x) for x in a.steps.split(","))
+    assert len(steps) == 3
+    run(a.work_dir, model=a.model, steps=steps,
+        eval_batches=a.eval_batches, meta_epochs=a.meta_epochs,
+        record=a.record)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,7 +73,8 @@ def make_hf_checkpoint(path: str, *, model: str = "gpt2-124m",
 def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         corpus: str = "files:/usr/share/common-licenses/*",
         eval_batches: int = 2, record: str | None = None,
-        delta_dtype: str | None = None, signed: bool = False) -> dict:
+        delta_dtype: str | None = None, signed: bool = False,
+        tokenizer: str = "word", fused_loss: bool = False) -> dict:
     from neurons import averager, miner, validator
 
     # per-preset directory: a reused --work-dir with a different --model
@@ -83,10 +84,14 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
     metrics_path = os.path.join(work_dir, "miner_metrics.jsonl")
     common = [
         "--backend", "local", "--work-dir", work_dir,
-        "--model", model, "--dataset", corpus, "--tokenizer", "word",
+        "--model", model, "--dataset", corpus, "--tokenizer", tokenizer,
         "--dp", "1", "--batch-size", "8", "--seq-len", "64",
         "--eval-seq-len", "128", "--eval-batches", str(eval_batches),
     ]
+    if fused_loss:
+        # the big-vocab loss path (no [B,T,V] logits buffer) — what the
+        # 32k-BPE round exists to exercise
+        common += ["--fused-loss"]
     if signed:
         # the full authenticity stack at protocol scale: every artifact in
         # an Ed25519 envelope, the base signature mandatory once the
@@ -123,10 +128,19 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
                             "averaged_model.msgpack")
     delta_art = os.path.join(work_dir, "artifacts", "deltas",
                              "hotkey_0.msgpack")
+    tok_desc = {"word": "word (corpus-fit)",
+                "bpe": "bpe (byte-level, locally trained)"}.get(
+        tokenizer, tokenizer)
+    tok_vocab = None
+    import glob as _glob
+    for tf in _glob.glob(os.path.join(work_dir, "tokenizer", "bpe-*.json")):
+        tok_vocab = len(json.load(open(tf))["model"]["vocab"])
     summary = {
         "protocol": "miner->delta->validator->averager, "
                     f"{model} from a pretrained-format checkpoint",
-        "corpus": corpus, "tokenizer": "word (corpus-fit)",
+        "corpus": corpus, "tokenizer": tok_desc,
+        "fused_loss": fused_loss,
+        "tokenizer_vocab": tok_vocab,
         "delta_dtype": delta_dtype or "float32",
         "signed_artifacts": signed,
         "delta_artifact_bytes": (os.path.getsize(delta_art)
@@ -163,15 +177,22 @@ def main() -> int:
     p.add_argument("--record", default=None,
                    help="write the summary JSON here as a committed artifact")
     p.add_argument("--delta-dtype", default=None,
-                   choices=("bfloat16", "int8"),
+                   choices=("bfloat16", "int8", "sparse8"),
                    help="compressed wire deltas for the miner")
     p.add_argument("--signed", action="store_true",
                    help="Ed25519-envelope every artifact (full authenticity "
                         "stack at protocol scale)")
+    p.add_argument("--tokenizer", default="word",
+                   help="word (default) | bpe (locally trained 32k "
+                        "byte-level BPE) | byte")
+    p.add_argument("--fused-loss", action="store_true",
+                   help="run the miner/validator/averager with the "
+                        "logits-free fused CE (the big-vocab path)")
     a = p.parse_args()
     run(a.work_dir, steps=a.steps, model=a.model, corpus=a.corpus,
         eval_batches=a.eval_batches, record=a.record,
-        delta_dtype=a.delta_dtype, signed=a.signed)
+        delta_dtype=a.delta_dtype, signed=a.signed,
+        tokenizer=a.tokenizer, fused_loss=a.fused_loss)
     return 0
 
 

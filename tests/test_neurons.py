@@ -360,3 +360,36 @@ def test_round4_flags_parse_into_config():
     a = RunConfig.from_args("averager", ["--no-accept-quant"])
     assert a.accept_quant is False
     assert RunConfig.from_args("validator", []).accept_quant is True
+
+
+def test_sparse8_delta_round(tmp_path):
+    """--delta-dtype sparse8: top-k int8 wire — the artifact shrinks well
+    past the dense int8 form (>=8x beyond int8 at the default density,
+    VERDICT r3 #5), the validator auto-detects the self-describing format
+    and scores it, the averager merges it."""
+    q_dir, sp_dir = tmp_path / "int8", tmp_path / "sparse8"
+    for d, extra in ((q_dir, ["--delta-dtype", "int8"]),
+                     (sp_dir, ["--delta-dtype", "sparse8"])):
+        rc = miner.main(_common(
+            d, "hotkey_0",
+            ["--max-steps", "8", "--send-interval", "1e9",
+             "--checkpoint-interval", "0", *extra]))
+        assert rc == 0
+    q_bytes = (q_dir / "artifacts" / "deltas" / "hotkey_0.msgpack"
+               ).stat().st_size
+    sp_bytes = (sp_dir / "artifacts" / "deltas" / "hotkey_0.msgpack"
+                ).stat().st_size
+    # tiny-model caveat: many leaves sit under the dense cutoff, so the
+    # tiny-model ratio understates the big-model one; still demand a
+    # clear multiple (the 124M evidence lives in the E2E artifact)
+    assert sp_bytes < 0.5 * q_bytes, (sp_bytes, q_bytes)
+
+    rc = validator.main(_common(sp_dir, "hotkey_91", ["--rounds", "1"]))
+    assert rc == 0
+    meta = json.loads((sp_dir / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 0) > 0, \
+        "validator rejected the sparse8 wire delta"
+    rc = averager.main(_common(
+        sp_dir, "hotkey_99", ["--rounds", "1", "--strategy", "weighted"]))
+    assert rc == 0
+    assert (sp_dir / "artifacts" / "base" / "averaged_model.msgpack").exists()

@@ -283,3 +283,60 @@ def test_trace_capture_close_mid_window(tmp_path):
     assert cap._active
     cap.close()
     assert cap._done and not cap._active
+
+
+def _git(cwd, *args):
+    import subprocess
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *args], cwd=str(cwd), check=True, capture_output=True)
+
+
+def test_autoupdater_hard_recovery_converges_dirty_tree(tmp_path):
+    """A dirty AND diverged clone (the state that wedges `git pull
+    --ff-only` forever) still converges to the published version via the
+    reset-hard fallback — the re-clone behavior of run_miner.sh:229-268
+    without the re-download (round-3 verdict #8)."""
+    from distributedtraining_tpu.utils.auto_update import git_remote_version
+
+    vf = "distributedtraining_tpu/__init__.py"
+    origin = tmp_path / "origin"
+    (origin / "distributedtraining_tpu").mkdir(parents=True)
+    (origin / vf).write_text('__version__ = "1.0.0"\n')
+    _git(origin, "init", "-q", "-b", "main")
+    _git(origin, "add", "-A")
+    _git(origin, "commit", "-qm", "v1")
+
+    clone = tmp_path / "clone"
+    _git(tmp_path, "clone", "-q", str(origin), str(clone))
+
+    # diverge: local commit + dirty working tree
+    (clone / "local.txt").write_text("local state\n")
+    _git(clone, "add", "local.txt")
+    _git(clone, "commit", "-qm", "local divergence")
+    (clone / vf).write_text('__version__ = "0.0.0-dirty"\n')
+
+    # publish v2 upstream
+    (origin / vf).write_text('__version__ = "2.0.0"\n')
+    _git(origin, "add", "-A")
+    _git(origin, "commit", "-qm", "v2")
+
+    calls = []
+    upd = AutoUpdater(
+        "1.0.0", lambda: git_remote_version(str(clone)),
+        repo_dir=str(clone), restart=lambda: calls.append("restart"))
+    assert upd.check() is True
+    assert calls == ["restart"]
+    assert (clone / vf).read_text() == '__version__ = "2.0.0"\n'
+
+    # with the fallback disabled the same state blocks the restart
+    (clone / vf).write_text('__version__ = "0.0.0-dirty"\n')
+    _git(clone, "commit", "-qam", "diverge again")
+    (origin / vf).write_text('__version__ = "3.0.0"\n')
+    _git(origin, "add", "-A")
+    _git(origin, "commit", "-qm", "v3")
+    upd2 = AutoUpdater(
+        "2.0.0", lambda: git_remote_version(str(clone)),
+        repo_dir=str(clone), hard_recovery_ref=None,
+        restart=lambda: calls.append("restart2"))
+    assert upd2.check() is False
+    assert calls == ["restart"]
