@@ -236,6 +236,36 @@ def _time_merge(model) -> dict:
         out["merge_bf16_speedup"] = round(dt / dt16, 3)
     except Exception as e:
         out["merge_bf16_error"] = repr(e)
+    try:
+        # sparse8 wire cost (--delta-dtype sparse8): publisher-side
+        # top-k+quantize and receiver-side densify for ONE 124M delta,
+        # plus the artifact bytes — the 7B/8B transport story in numbers
+        from distributedtraining_tpu import serialization as ser
+
+        sparsify = jax.jit(delta_lib.sparsify_delta,
+                           static_argnames=("density",))
+        d0 = deltas[0]
+        sp = sparsify(d0, density=1.0 / 64)
+        jax.block_until_ready(jax.tree_util.tree_leaves(sp)[0])
+        t0 = time.perf_counter()
+        for _ in range(MERGE_ITERS):
+            sp = sparsify(d0, density=1.0 / 64)
+        float(jax.tree_util.tree_leaves(sp)[-1].reshape(-1)[0])
+        out["sparse8_encode_s"] = round(
+            (time.perf_counter() - t0) / MERGE_ITERS, 4)
+        blob = ser.to_msgpack(sp)
+        out["sparse8_artifact_bytes"] = len(blob)
+        out["sparse8_vs_f32_bytes"] = round(
+            sum(np.asarray(l).nbytes
+                for l in jax.tree_util.tree_leaves(d0)) / len(blob), 1)
+        host_template = jax.tree_util.tree_map(
+            lambda x: np.zeros(x.shape, np.float32), params)
+        t0 = time.perf_counter()
+        dense = delta_lib.sparse_delta_from_bytes(blob, host_template)
+        out["sparse8_decode_s"] = round(time.perf_counter() - t0, 4)
+        assert dense is not None
+    except Exception as e:
+        out["sparse8_error"] = repr(e)
     return out
 
 

@@ -149,7 +149,14 @@ def mad_anomaly_mask(values: Sequence[float], *, threshold: float = 3.5
                      ) -> list[bool]:
     """Median-absolute-deviation outlier flags (True = anomalous) —
     the reference's cheater detection (detect_metric_anomaly,
-    btt_connector.py:388-426) using the modified z-score."""
+    btt_connector.py:388-426) using the modified z-score.
+
+    ONE-SIDED by design: only anomalously HIGH scores are flagged (a
+    gamed metric inflates; an honest-but-weaker miner deflates). The
+    first two-sided spelling of this zeroed a legitimately positive
+    miner whose score sat 4 MADs below a tight leader cluster — exactly
+    the discrimination the validator exists to express
+    (E2E_r04_discriminate.json caught it)."""
     v = np.asarray(list(values), dtype=np.float64)
     if v.size < 3:
         return [False] * v.size
@@ -163,4 +170,4 @@ def mad_anomaly_mask(values: Sequence[float], *, threshold: float = 3.5
             return [False] * v.size
         return [bool(x > 5.0 * med) for x in v]
     mz = 0.6745 * (v - med) / mad
-    return [bool(abs(z) > threshold) for z in mz]
+    return [bool(z > threshold) for z in mz]

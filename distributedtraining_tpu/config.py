@@ -117,6 +117,7 @@ class RunConfig:
     genetic_population: int = 10             # averaging_logic.py:830-970
     genetic_generations: int = 10
     genetic_sigma: float = 0.1
+    genetic_screen_batches: int = 2          # 0 = full-set fitness
     meta_lr: float = 0.01
     outer_momentum: float = 0.0              # >0 wraps strategy in OuterOptMerge
     outer_lr: float = 0.7                    # DiLoCo-style outer Nesterov step
@@ -397,6 +398,13 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        type=int, default=d.genetic_population)
         g.add_argument("--genetic-generations", dest="genetic_generations",
                        type=int, default=d.genetic_generations)
+        g.add_argument("--genetic-screen-batches",
+                       dest="genetic_screen_batches", type=int,
+                       default=d.genetic_screen_batches,
+                       help="successive-halving fitness: rank candidates "
+                            "on this many val batches, full passes only "
+                            "for elites (0 = the reference's full-set "
+                            "fitness for every candidate)")
         g.add_argument("--genetic-sigma", dest="genetic_sigma", type=float,
                        default=d.genetic_sigma)
 

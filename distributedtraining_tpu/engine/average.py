@@ -307,19 +307,39 @@ class ParameterizedMerge:
 class GeneticMerge:
     """Evolutionary weight search (GeneticAverager, averaging_logic.py:830-970):
     population of mixing-weight vectors, Gaussian mutation, elite selection by
-    eval loss. Slower than gradient meta-learning but derivative-free."""
+    eval loss. Slower than gradient meta-learning but derivative-free.
+
+    Cost shape: the reference evaluates every candidate on the FULL val
+    set every generation — up to population x generations eval passes per
+    round (~100 at the defaults). Here selection runs as successive
+    halving: candidates are RANKED on the first ``screen_batches`` val
+    batches (rank is all selection needs — crossing losses between
+    near-identical mixtures rarely reorders past the elite boundary with
+    a shared batch subset), and only the winning elites pay a full-set
+    eval. Per-generation cost drops from P full passes to P short passes
+    + elite full passes; ``screen_batches=None`` restores the reference's
+    exact full-set behavior."""
 
     def __init__(self, *, population: int = 10, generations: int = 10,
-                 sigma: float = 0.1, elite: int = 2, seed: int = 0):
+                 sigma: float = 0.1, elite: int = 2, seed: int = 0,
+                 screen_batches: int | None = 2):
         self.population = population
         self.generations = generations
         self.sigma = sigma
         self.elite = elite
         self.seed = seed
+        if screen_batches is not None and screen_batches < 1:
+            # 0 would islice an empty iterator -> NaN losses -> arbitrary
+            # selection with no error; fail eagerly like delta_density
+            raise ValueError("screen_batches must be >= 1 or None "
+                             f"(full-set fitness), got {screen_batches}")
+        self.screen_batches = screen_batches
 
     def merge(self, engine, base: Params, stacked: Params, miner_ids: list[str],
               *, val_batches: Callable[[], Iterable[dict]],
               consensus=None) -> tuple[Params, jax.Array]:
+        import itertools
+
         m = len(miner_ids)
         m_pad = delta_lib.miner_axis_size(stacked)
         rng = jax.random.PRNGKey(self.seed)
@@ -331,25 +351,35 @@ class GeneticMerge:
             return delta_lib.weighted_merge_jit(
                 base, stacked, delta_lib.pad_merge_weights(w, m_pad))
 
-        cache: dict[bytes, float] = {}
+        # elites recur across generations: memoize both tiers by
+        # weight-vector bytes
+        cache: dict[tuple[bytes, bool], float] = {}
 
-        def fitness(w) -> float:
-            # each fitness eval is a full val-set pass; elites recur across
-            # generations, so memoize by weight-vector bytes
-            key = np.asarray(w).tobytes()
+        def _eval(w, *, full: bool) -> float:
+            key = (np.asarray(w).tobytes(), full)
             if key not in cache:
+                batches = val_batches()
+                if not full and self.screen_batches is not None:
+                    batches = itertools.islice(batches, self.screen_batches)
                 loss, _ = engine.evaluate(merge_fn(base, stacked, w),
-                                          val_batches())
+                                          batches)
                 cache[key] = loss
             return cache[key]
+
+        def screen(w) -> float:   # cheap ranking tier
+            return _eval(w, full=self.screen_batches is None)
+
+        def fitness(w) -> float:  # full-set tier (elites, final winner)
+            return _eval(w, full=True)
 
         pop = [jnp.full((m,), 1.0 / m)]
         for i in range(self.population - 1):
             rng, k = jax.random.split(rng)
             pop.append(jax.nn.softmax(jax.random.normal(k, (m,))))
         for gen in range(self.generations):
-            scored = sorted(pop, key=fitness)
-            elites = scored[: self.elite]
+            scored = sorted(pop, key=screen)
+            elites = sorted(scored[: self.elite * 2],
+                            key=fitness)[: self.elite]
             children = list(elites)
             while len(children) < self.population:
                 rng, k1, k2 = jax.random.split(rng, 3)
@@ -358,8 +388,9 @@ class GeneticMerge:
                 children.append(jax.nn.softmax(child))
             pop = children
             logger.info("genetic gen %d best loss=%.4f", gen + 1,
-                        fitness(pop[0]))
-        best = min(pop, key=fitness)
+                        fitness(elites[0]))
+        best = min(sorted(pop, key=screen)[: max(self.elite, 2)],
+                   key=fitness)
         return merge_fn(base, stacked, best), best
 
 

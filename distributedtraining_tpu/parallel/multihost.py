@@ -170,14 +170,18 @@ def pod_mesh(*, dp: int = 0, fsdp: int = 1, sp: int = 1, tp: int = 1,
         # every device, so attribute presence alone is not the signal)
         slice_ids = {getattr(d, "slice_index", None) for d in devs}
         use_slices = None not in slice_ids and len(slice_ids) == dcn_dp
-        if not use_slices and jax.process_count() < dcn_dp:
-            # single-process dryrun (the driver's virtual CPU mesh): no
-            # slices and no process granules to split across, so emulate
-            # granules as contiguous blocks of the device list — the SAME
-            # axis layout the hybrid mesh produces (outer dp slowest-
-            # varying), just without real network-distance information.
-            # Validates that programs compile+run against the dcn_dp
-            # layout without a multi-slice pod.
+        if not use_slices and jax.process_count() == 1:
+            # single-process dryrun ONLY (the driver's virtual CPU mesh):
+            # no slices and no process granules to split across, so
+            # emulate granules as contiguous blocks of the device list —
+            # the SAME axis layout the hybrid mesh produces (outer dp
+            # slowest-varying), just without real network-distance
+            # information. Validates that programs compile+run against
+            # the dcn_dp layout without a multi-slice pod. A MULTI-process
+            # fleet whose granule count mismatches dcn_dp must still fail
+            # loudly below (create_hybrid_device_mesh raises) — silently
+            # reshaping there would route "ICI-local" collectives across
+            # the slow network.
             # contiguous blocks of the flat list = the granules, which is
             # exactly the row-major layout one reshape produces (the dp
             # axis varies slowest, so its outer dcn_dp groups are the

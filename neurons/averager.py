@@ -34,9 +34,11 @@ def make_strategy(cfg: RunConfig, model):
     if cfg.strategy == "weighted":
         strategy = WeightedAverage(chunk_size=cfg.merge_chunk)
     elif cfg.strategy == "genetic":
-        strategy = GeneticMerge(population=cfg.genetic_population,
-                                generations=cfg.genetic_generations,
-                                sigma=cfg.genetic_sigma)
+        strategy = GeneticMerge(
+            population=cfg.genetic_population,
+            generations=cfg.genetic_generations,
+            sigma=cfg.genetic_sigma,
+            screen_batches=cfg.genetic_screen_batches or None)
     else:
         strategy = ParameterizedMerge(model, meta_epochs=cfg.meta_epochs,
                                       meta_lr=cfg.meta_lr)

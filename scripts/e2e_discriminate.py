@@ -43,7 +43,7 @@ force_platform_from_env()
 def run(work_dir: str, *, model: str = "gpt2-124m",
         steps: tuple[int, int, int] = (60, 25, 8),
         eval_batches: int = 3, meta_epochs: int = 3,
-        record: str | None = None) -> dict:
+        record: str | None = None, skip_miners: bool = False) -> dict:
     import numpy as np
 
     from distributedtraining_tpu.config import RunConfig
@@ -67,19 +67,19 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
 
     t0 = time.time()
     miners = ["hotkey_0", "hotkey_1", "hotkey_2"]
-    for hotkey, n in zip(miners, steps):
-        rc = miner.main(common + [
-            "--hotkey", hotkey, "--max-steps", str(n),
-            "--send-interval", "1e9", "--checkpoint-interval", "0",
-            "--init-from", ckpt])
-        assert rc == 0, f"miner {hotkey} failed"
+    if not skip_miners:
+        for hotkey, n in zip(miners, steps):
+            rc = miner.main(common + [
+                "--hotkey", hotkey, "--max-steps", str(n),
+                "--send-interval", "1e9", "--checkpoint-interval", "0",
+                "--init-from", ckpt])
+            assert rc == 0, f"miner {hotkey} failed"
 
     # the poisoned identity: a REGISTERED chain hotkey publishing a
     # magnitude-poisoned artifact (loadgen mode "huge" -> max-abs screen)
     vcfg = RunConfig.from_args("validator", common + ["--hotkey",
                                                       "hotkey_91"])
     c = build(vcfg)
-    template = c.engine.model.init_params  # noqa: F841 (template below)
     import jax
     host_template = jax.tree_util.tree_map(
         lambda x: np.zeros(x.shape, np.float32),
@@ -149,6 +149,11 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
         f"poisoned identity not screened: {pois}"
     assert emitted.get(poisoned, 0) == 0, "poisoned identity got weight"
     assert max((raw[h] for h in miners), default=0) == s0
+    # the chain's emitted u16 weights preserve the order AND keep the
+    # weak-but-honest miner positive (the one-sided MAD screen; the
+    # two-sided spelling zeroed hotkey_2 here — chain/base.py)
+    e0, e1, e2 = (emitted.get(h, 0) for h in miners)
+    assert e0 > e1 > e2 > 0, f"chain weights not ordered-positive: {emitted}"
     # merge weights agree with the score ordering at the extremes: the
     # strong miner must not be out-weighed by the weak one
     assert mix[miners[0]] >= mix[miners[2]], \
@@ -173,12 +178,15 @@ def main() -> int:
     p.add_argument("--eval-batches", type=int, default=3)
     p.add_argument("--meta-epochs", type=int, default=3)
     p.add_argument("--record", default=None)
+    p.add_argument("--skip-miners", action="store_true",
+                   help="reuse the work dir's existing deltas (re-score "
+                        "and re-merge only)")
     a = p.parse_args()
     steps = tuple(int(x) for x in a.steps.split(","))
     assert len(steps) == 3
     run(a.work_dir, model=a.model, steps=steps,
         eval_batches=a.eval_batches, meta_epochs=a.meta_epochs,
-        record=a.record)
+        record=a.record, skip_miners=a.skip_miners)
     return 0
 
 

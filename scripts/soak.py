@@ -111,6 +111,10 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
             procs["miner0"].kill()
             procs["miner0"].wait()
             killed = True
+            # the append-mode log keeps pre-kill lines: snapshot the
+            # push count now so pushes AFTER restart are separable
+            pushes_before_kill = open(logs["miner0"]).read().count(
+                "pushed delta")
             time.sleep(5)
             procs["miner0"] = miner(0)
             restarted = True
@@ -140,7 +144,8 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
     if os.path.exists(logs["miner0"]):
         txt = open(logs["miner0"]).read()
         resumed = "resumed from checkpoint" in txt
-        pushes_after_restart = txt.count("pushed delta")
+        pushes_after_restart = (txt.count("pushed delta")
+                                - (pushes_before_kill if killed else 0))
     vrounds = 0
     vpath = os.path.join(work_dir, "validator_metrics.jsonl")
     if os.path.exists(vpath):
@@ -154,6 +159,7 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
         "validator_rounds": vrounds,
         "miner0_killed_and_restarted": killed and restarted,
         "miner0_resumed_from_checkpoint": resumed,
+        "miner0_pushes_after_restart": pushes_after_restart,
         "disk_samples": disk[:: max(1, len(disk) // 20)],
         "disk_first_bytes": disk[0]["bytes"] if disk else None,
         "disk_last_bytes": disk[-1]["bytes"] if disk else None,
@@ -170,6 +176,8 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
         f"merged loss did not improve: {ok_rounds[0]} -> {ok_rounds[-3:]}"
     assert killed and restarted and resumed, \
         (killed, restarted, resumed)
+    assert pushes_after_restart >= 1, \
+        f"restarted miner never pushed again ({pushes_after_restart})"
     assert disk and disk[-1]["bytes"] < 3 * max(disk[0]["bytes"], 1), \
         (disk[0], disk[-1])
     summary["passed"] = True

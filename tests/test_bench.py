@@ -61,9 +61,12 @@ def test_time_merge_reports_all_spellings(tiny):
     model, cfg = tiny
     out = bench._time_merge(model)
     for key in ("merge_wallclock_s", "merge_gbps", "merge_flat_wallclock_s",
-                "merge_bf16_wallclock_s", "merge_bf16_speedup"):
+                "merge_bf16_wallclock_s", "merge_bf16_speedup",
+                "sparse8_encode_s", "sparse8_decode_s",
+                "sparse8_artifact_bytes", "sparse8_vs_f32_bytes"):
         assert key in out, out
     assert out["merge_m"] == 3
+    assert out["sparse8_vs_f32_bytes"] > 4  # beats even dense int8's 4x
 
 
 def test_peak_flops_ladder(monkeypatch):

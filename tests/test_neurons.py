@@ -357,9 +357,14 @@ def test_round4_flags_parse_into_config():
     from distributedtraining_tpu.config import RunConfig
     v = RunConfig.from_args("validator", ["--no-accept-quant"])
     assert v.accept_quant is False
-    a = RunConfig.from_args("averager", ["--no-accept-quant"])
+    a = RunConfig.from_args("averager", ["--no-accept-quant",
+                                         "--genetic-screen-batches", "0"])
     assert a.accept_quant is False
+    assert a.genetic_screen_batches == 0
     assert RunConfig.from_args("validator", []).accept_quant is True
+    m = RunConfig.from_args("miner", ["--delta-dtype", "sparse8",
+                                      "--delta-density", "0.03125"])
+    assert m.delta_dtype == "sparse8" and m.delta_density == 0.03125
 
 
 def test_sparse8_delta_round(tmp_path):
@@ -393,3 +398,30 @@ def test_sparse8_delta_round(tmp_path):
         sp_dir, "hotkey_99", ["--rounds", "1", "--strategy", "weighted"]))
     assert rc == 0
     assert (sp_dir / "artifacts" / "base" / "averaged_model.msgpack").exists()
+
+
+def test_llama_family_offline_round(tmp_path):
+    """The full CLI round on the SECOND model family (tiny-llama: RoPE,
+    GQA, RMSNorm, SwiGLU, separate lm_head) — family coverage at the
+    protocol surface, not just the model-level tests."""
+    args = lambda hk, extra: [
+        "--backend", "local", "--work-dir", str(tmp_path),
+        "--model", "tiny-llama", "--dataset", "synthetic",
+        "--hotkey", hk, "--dp", "1",
+        "--batch-size", "4", "--seq-len", "32", "--eval-seq-len", "32",
+        "--eval-batches", "2", *extra,
+    ]
+    rc = miner.main(args("hotkey_0", [
+        "--max-steps", "25", "--send-interval", "1e9",
+        "--checkpoint-interval", "0", "--delta-dtype", "sparse8"]))
+    assert rc == 0
+    rc = validator.main(args("hotkey_91", ["--rounds", "1"]))
+    assert rc == 0
+    meta = json.loads((tmp_path / "chain" / "metagraph.json").read_text())
+    assert meta["weights"]["hotkey_91"].get("hotkey_0", 0) > 0, \
+        "validator rejected the llama sparse8 delta"
+    rc = averager.main(args("hotkey_99",
+                            ["--rounds", "1", "--strategy", "weighted"]))
+    assert rc == 0
+    assert (tmp_path / "artifacts" / "base"
+            / "averaged_model.msgpack").exists()

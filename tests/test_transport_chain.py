@@ -162,6 +162,12 @@ def test_score_math():
     assert q == [32768, 65535]
     flags = mad_anomaly_mask([1.0, 1.1, 0.9, 1.05, 50.0])
     assert flags == [False, False, False, False, True]
+    # one-sided: a weak-but-honest straggler far BELOW a tight leader
+    # cluster is kept (the gamed direction is up, not down) — the
+    # two-sided spelling zeroed the weak miner in the r4 discriminating
+    # round (E2E_r04_discriminate.json)
+    flags = mad_anomaly_mask([3.883, 3.642, 2.221])
+    assert flags == [False, False, False]
 
 
 # -- scheduler + timeout ----------------------------------------------------
