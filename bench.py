@@ -242,15 +242,22 @@ def _time_merge(model) -> dict:
         # plus the artifact bytes — the 7B/8B transport story in numbers
         from distributedtraining_tpu import serialization as ser
 
-        sparsify = jax.jit(delta_lib.sparsify_delta,
-                           static_argnames=("density",))
+        @jax.jit
+        def sparsify(d):
+            sp = delta_lib.sparsify_delta(d, density=1.0 / 64)
+            # scalar probe over EVERY leaf — same rule as timed() above
+            # (this backend's block_until_ready does not actually block)
+            probe = sum(l.reshape(-1)[0].astype(jnp.float32)
+                        for l in jax.tree_util.tree_leaves(sp))
+            return sp, probe
+
         d0 = deltas[0]
-        sp = sparsify(d0, density=1.0 / 64)
-        jax.block_until_ready(jax.tree_util.tree_leaves(sp)[0])
+        sp, probe = sparsify(d0)
+        float(probe)  # warm + full sync
         t0 = time.perf_counter()
         for _ in range(MERGE_ITERS):
-            sp = sparsify(d0, density=1.0 / 64)
-        float(jax.tree_util.tree_leaves(sp)[-1].reshape(-1)[0])
+            sp, probe = sparsify(d0)
+        float(probe)
         out["sparse8_encode_s"] = round(
             (time.perf_counter() - t0) / MERGE_ITERS, 4)
         blob = ser.to_msgpack(sp)

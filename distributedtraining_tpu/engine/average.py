@@ -389,8 +389,12 @@ class GeneticMerge:
             pop = children
             logger.info("genetic gen %d best loss=%.4f", gen + 1,
                         fitness(elites[0]))
-        best = min(sorted(pop, key=screen)[: max(self.elite, 2)],
-                   key=fitness)
+        # final selection: the screen-ranked survivors PLUS the last
+        # generation's elites — their full-set losses are already cached,
+        # so including them costs nothing and guarantees a noisy final
+        # screening batch can never discard the known full-eval best
+        finalists = sorted(pop, key=screen)[: max(self.elite, 2)] + elites
+        best = min(finalists, key=fitness)
         return merge_fn(base, stacked, best), best
 
 

@@ -132,9 +132,22 @@ def _fused_lm_loss(model, params, batch, impl: str = "auto", mesh=None):
         return_hidden=True)
     head = params["lm_head"] if "lm_head" in params else params["wte"]
     mask = batch.get("loss_mask")
-    return fused_linear_cross_entropy(
-        hidden[:, :-1, :], head, batch["input_ids"][:, 1:],
-        None if mask is None else mask[:, 1:], impl=impl, mesh=mesh)
+    if mesh is None:
+        return fused_linear_cross_entropy(
+            hidden[:, :-1, :], head, batch["input_ids"][:, 1:],
+            None if mask is None else mask[:, 1:], impl=impl)
+    # mesh spelling: same math WITHOUT slicing the sequence axis — the
+    # shift moves into the (tiny, global) labels/mask arrays, so hidden
+    # keeps its full [B, T, E] shape and the shard_map kernel composes
+    # with sp-sharded sequences (position t predicts token t+1; the last
+    # column is masked out instead of sliced off)
+    ids = batch["input_ids"]
+    labels = jnp.pad(ids[:, 1:], ((0, 0), (0, 1)))
+    m = (jnp.ones(ids.shape[:2], jnp.float32) if mask is None
+         else mask.astype(jnp.float32))
+    m = jnp.pad(m[:, 1:], ((0, 0), (0, 1)))
+    return fused_linear_cross_entropy(hidden, head, labels, m,
+                                      impl=impl, mesh=mesh)
 
 
 class TrainEngine:
@@ -184,19 +197,18 @@ class TrainEngine:
                 # pallas_call is not auto-partitionable under pjit.
                 # Explicit "pallas" on a mesh takes the shard_map spelling
                 # (ops/pallas_ce.fused_ce_loss_sharded: rows split across
-                # dp/fsdp AND tp, head all-gathered per device, totals
-                # psummed); "auto"/True stays on the lax.scan spelling,
-                # which GSPMD partitions without manual collectives.
+                # dp/fsdp/sp AND tp, head all-gathered per device, totals
+                # psummed — the label shift rides the global labels array,
+                # so sp/ring-attention meshes compose too);
+                # "auto"/True stays on the lax.scan spelling, which GSPMD
+                # partitions without manual collectives.
                 if impl == "pallas":
                     if any(mesh.shape.get(a, 1) > 1
                            for a in mesh.axis_names
-                           if a not in ("dp", "fsdp", "tp")):
-                        # the label shift in _fused_lm_loss crosses
-                        # sequence-shard boundaries — sp (ring attention)
-                        # runs take the scan spelling
+                           if a not in ("dp", "fsdp", "tp", "sp")):
                         raise ValueError(
-                            "fused_loss='pallas' composes with dp/fsdp/tp "
-                            "meshes; for sp/other axes use "
+                            "fused_loss='pallas' composes with "
+                            "dp/fsdp/tp/sp meshes; for other axes use "
                             "fused_loss=True/'scan'")
                     loss_mesh = mesh
                 else:

@@ -421,10 +421,12 @@ def fused_ce_loss_sharded(hidden: jax.Array, head_kernel: jax.Array,
 
     names = mesh.axis_names
     row_axes = tuple(a for a in ("dp", "fsdp") if a in names)
+    sp_ax = "sp" if "sp" in names else None
     tp_ax = "tp" if "tp" in names else None
     fsdp_ax = "fsdp" if "fsdp" in names else None
     tp = int(mesh.shape[tp_ax]) if tp_ax else 1
-    psum_axes = row_axes + ((tp_ax,) if tp_ax else ())
+    psum_axes = (row_axes + ((sp_ax,) if sp_ax else ())
+                 + ((tp_ax,) if tp_ax else ()))
 
     if loss_mask is None:
         loss_mask = jnp.ones(labels.shape, jnp.float32)
@@ -464,10 +466,13 @@ def fused_ce_loss_sharded(hidden: jax.Array, head_kernel: jax.Array,
 
     total, count = shard_map(
         local, mesh=mesh,
-        in_specs=(P(row_axes or None, None, None),
+        # sequence axis rides sp (the engine's mesh spelling shifts the
+        # LABELS, not the hidden states, so sequence shards carry no
+        # cross-shard dependency — see _fused_lm_loss)
+        in_specs=(P(row_axes or None, sp_ax, None),
                   P(tp_ax, fsdp_ax),
-                  P(row_axes or None, None),
-                  P(row_axes or None, None)),
+                  P(row_axes or None, sp_ax),
+                  P(row_axes or None, sp_ax)),
         out_specs=(P(), P()),
         check_rep=False,
     )(hidden, head_kernel, labels, loss_mask)

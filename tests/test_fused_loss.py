@@ -326,15 +326,59 @@ def test_pallas_engine_on_mesh_matches_scan(devices):
                                    rtol=1e-4, atol=1e-6)
 
 
-def test_pallas_on_sp_mesh_refused():
-    """The label shift crosses sequence-shard boundaries: explicit
-    fused_loss='pallas' on an sp (ring attention) mesh refuses loudly."""
-    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+def test_pallas_on_unknown_mesh_axis_refused(devices):
+    """The surviving refusal branch: explicit fused_loss='pallas' on a
+    mesh with an axis outside dp/fsdp/tp/sp must fail loudly (silently
+    accepting it would psum over the wrong axis set)."""
+    import numpy as _np
+    from jax.sharding import Mesh
 
     model, _ = gpt2.make_model("tiny")
-    mesh = make_mesh(MeshConfig(dp=2, sp=2))
-    with pytest.raises(ValueError, match="dp/fsdp/tp"):
+    mesh = Mesh(_np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "ep"))
+    with pytest.raises(ValueError, match="dp/fsdp/tp/sp"):
         TrainEngine(model, mesh=mesh, seq_len=16, fused_loss="pallas")
+
+
+@pytest.mark.filterwarnings("ignore:pallas fused-CE")
+def test_pallas_engine_on_sp_mesh_matches_scan(devices):
+    """fused_loss='pallas' on a dp x sp (ring attention) mesh: the mesh
+    spelling shifts the LABELS instead of slicing the hidden states, so
+    sequence shards carry no cross-shard dependency and the flagship
+    kernel composes with the long-context path too."""
+    import dataclasses
+
+    import optax
+
+    from distributedtraining_tpu.ops import ring_attention as ring
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = dataclasses.replace(gpt2.PRESETS["tiny"], n_embd=128, n_head=4,
+                              dtype="float32", attention_impl="ring",
+                              n_positions=32)
+    model, _ = gpt2.make_model(cfg)
+    mesh = make_mesh(MeshConfig(dp=2, sp=4))
+    try:
+        p = model.init_params(jax.random.PRNGKey(0), seq_len=32)
+        pal = TrainEngine(model, mesh=mesh, seq_len=32,
+                          fused_loss="pallas", optimizer=optax.sgd(1.0))
+        scn = TrainEngine(model, mesh=mesh, seq_len=32,
+                          fused_loss="scan", optimizer=optax.sgd(1.0))
+        s_pal = pal.init_state(params=p)
+        s_scn = scn.init_state(params=p)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)}
+        s_pal, m_pal = pal.train_step(s_pal, pal.place_batch(batch))
+        s_scn, m_scn = scn.train_step(s_scn, scn.place_batch(batch))
+        np.testing.assert_allclose(float(m_pal["loss"]),
+                                   float(m_scn["loss"]), rtol=1e-5)
+        assert float(m_pal["tokens"]) == float(m_scn["tokens"])
+        for a, b in zip(jax.tree_util.tree_leaves(s_pal.params),
+                        jax.tree_util.tree_leaves(s_scn.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+    finally:
+        ring.set_ring_mesh(None)
 
 
 def test_fused_auto_selects_scan_off_tpu():
