@@ -77,6 +77,7 @@ class RunConfig:
     score_metric: str = "loss"               # loss | perplexity (ref :93-97)
     max_delta_abs: float = 1e3               # admission magnitude cap (0=off)
     accept_quant: bool = True                # accept int8-wire submissions
+    stale_deltas: Optional[str] = None       # skip|accept (None = role default)
     learning_rate: float = 5e-4              # neurons/miner.py:121-128
     weight_decay: float = 0.01               # AdamW decoupled decay
     grad_clip: Optional[float] = None
@@ -249,6 +250,14 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        help="fleet is known all-float: reject int8-wire "
                             "submissions instead of dequantizing, and skip "
                             "the quant-template alloc on garbage")
+        g.add_argument("--stale-deltas", dest="stale_deltas",
+                       choices=("skip", "accept"), default=d.stale_deltas,
+                       help="submissions whose rider names a superseded "
+                            "base: 'skip' refuses them (averager default "
+                            "— merging one re-adds the previous merge's "
+                            "update on top of itself), 'accept' is the "
+                            "reference's behavior (validator default). "
+                            "Riderless submissions are always accepted")
     g.add_argument("--learning-rate", dest="learning_rate", type=float,
                    default=d.learning_rate)
     g.add_argument("--weight-decay", dest="weight_decay", type=float,

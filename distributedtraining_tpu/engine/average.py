@@ -421,7 +421,8 @@ class AveragerLoop:
                  max_delta_abs: float | None = 1e3,
                  metrics=None,
                  lora_cfg=None,
-                 accept_quant: bool = True):
+                 accept_quant: bool = True,
+                 stale_deltas: str = "skip"):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -434,6 +435,17 @@ class AveragerLoop:
         # False = all-float fleet: reject int8-wire submissions and skip
         # the quant-template alloc on garbage (see Validator.accept_quant)
         self.accept_quant = accept_quant
+        # "skip": a delta whose rider names a DIFFERENT base than the
+        # current one is not merged — applying it would re-add the part
+        # of the last merge the miner had already incorporated (stale
+        # double-apply; the reference silently does this,
+        # training_manager.py:417-422 vs averaging_logic.py:422-448).
+        # "accept" restores reference behavior; riderless deltas are
+        # always accepted either way.
+        if stale_deltas not in ("skip", "accept"):
+            raise ValueError(f"stale_deltas must be 'skip' or 'accept', "
+                             f"got {stale_deltas!r}")
+        self.stale_deltas = stale_deltas
         # accept adapter-tree submissions alongside full-param deltas;
         # template cached once (depends only on base shapes)
         self.lora_cfg = lora_cfg
@@ -520,6 +532,17 @@ class AveragerLoop:
                 self._host_template())
         return self._quant_template_cache
 
+    def _is_stale(self, hotkey: str) -> bool:
+        """Rider check BEFORE the (full-model-bytes) artifact fetch — the
+        rider is a tiny JSON read. Policy-gated OUTSIDE the collective is
+        safe: stale_deltas is constructor config, identical on every
+        process (unlike _base_revision — see stale_submission)."""
+        if self.stale_deltas != "skip":
+            return False
+        from .train import stale_submission
+        return stale_submission(self.transport, hotkey,
+                                self._base_revision, multi=self._multi())
+
     def gather_deltas(self) -> tuple[list[str], list[Params]]:
         if self._multi():
             from .train import broadcast_metagraph
@@ -530,6 +553,11 @@ class AveragerLoop:
         rejected = 0
         for hotkey in meta.hotkeys:
             if hotkey == getattr(self.chain, "my_hotkey", None):
+                continue
+            if self._is_stale(hotkey):
+                logger.info("averager: skipping %s (delta vs a superseded "
+                            "base)", hotkey)
+                rejected += 1
                 continue
             d = self._fetch_delta(hotkey)
             if d is None:

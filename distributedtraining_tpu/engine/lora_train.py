@@ -216,6 +216,7 @@ class LoRAMinerLoop(MinerLoop):
             from .train import wire_out
             self.transport.publish_delta(self.miner_id,
                                          wire_out(self.engine, adapters))
+            self._publish_meta()  # base-revision rider (MinerLoop)
             self.report.pushes += 1
         except Exception:
             logger.exception("lora miner %s: push failed", self.miner_id)

@@ -504,6 +504,44 @@ def broadcast_json(obj):
     return None if data is None else json.loads(data)
 
 
+def stale_submission(transport, hotkey: str, base_revision, *,
+                     multi: bool) -> bool:
+    """True when ``hotkey``'s delta rider names a base other than
+    ``base_revision`` (the stale double-apply hazard —
+    transport/base.py publish_delta_meta). Shared by Validator and
+    AveragerLoop so the two roles cannot drift.
+
+    Pod discipline: on ``multi`` EVERY process enters the broadcast
+    unconditionally and only the coordinator's verdict counts — the
+    averager's local ``base_revision`` is None on non-coordinators
+    (CoordinatorGatedTransport.publish_base returns the revision only to
+    the writer), so any locally-decided early return would diverge the
+    processes at their next collective and hang the pod."""
+    def local_verdict() -> bool:
+        if base_revision is None:
+            return False
+        fm = getattr(transport, "fetch_delta_meta", None)
+        if fm is None:
+            return False
+        try:
+            meta = fm(hotkey)
+        except Exception:
+            return False
+        if not meta:
+            return False
+        rev = meta.get("base_revision")
+        return rev is not None and rev != base_revision
+
+    if not multi:
+        return local_verdict()
+    import numpy as np
+    from jax.experimental import multihost_utils as mhu
+
+    from ..parallel import multihost
+    local = local_verdict() if multihost.is_coordinator() else False
+    return bool(mhu.broadcast_one_to_all(np.asarray(local, np.int32)))
+
+
 def broadcast_base_fetch(transport, host_template: Params,
                          current_revision) -> tuple[Params, str | None] | None:
     """Multi-host base pull: only the coordinator reads the transport
@@ -934,11 +972,43 @@ class MinerLoop:
             payload = self._sparsify(payload, density=self.delta_density)
         try:
             self.transport.publish_delta(self.miner_id, payload)
+            self._publish_meta()
             self.report.pushes += 1
             logger.info("miner %s: pushed delta #%d", self.miner_id,
                         self.report.pushes)
         except Exception:  # push failures must not kill training (ref :410-431)
             logger.exception("miner %s: delta push failed", self.miner_id)
+
+    def _publish_meta(self) -> None:
+        """Base-revision rider next to the delta: lets receivers detect a
+        STALE submission (computed vs a base that has since moved — the
+        averager merging it would re-add the previous merge's update on
+        top of itself). Best-effort and optional: transports without the
+        rider API, and deltas vs an unpublished genesis base, just skip
+        it — receivers treat an absent rider as the reference's
+        accept-anything.
+
+        The delta-THEN-rider order makes the only inconsistent window
+        false-STALE (fresh delta + old rider — skip-policy receivers
+        drop an honest push), never false-fresh (which would re-open the
+        double-apply). A failed rider upload is retried once here and
+        then heals at the next push cadence; the one-interval cost is
+        the same magnitude as ordinary push staleness."""
+        pm = getattr(self.transport, "publish_delta_meta", None)
+        if pm is None or self._base_revision is None:
+            return
+        meta = {"base_revision": self._base_revision}
+        for attempt in (1, 2):
+            try:
+                pm(self.miner_id, meta)
+                return
+            except Exception:
+                if attempt == 2:
+                    logger.warning(
+                        "miner %s: delta meta publish failed twice; "
+                        "skip-policy receivers may treat this push as "
+                        "stale until the next one", self.miner_id,
+                        exc_info=True)
 
     # -- the loop -----------------------------------------------------------
     def _train_one(self, batch) -> dict:

@@ -49,7 +49,8 @@ class Validator:
                  clock: Clock | None = None,
                  metrics=None,
                  lora_cfg=None,
-                 accept_quant: bool = True):
+                 accept_quant: bool = True,
+                 stale_deltas: str = "accept"):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -62,6 +63,18 @@ class Validator:
         # submissions are rejected instead of dequantized, and garbage
         # submissions skip the quarter-model quant-template alloc
         self.accept_quant = accept_quant
+        # staleness policy for submissions whose rider names a superseded
+        # base. Default "accept" (reference parity): scoring a stale
+        # delta vs the new base is noisy but informative, EMA smooths
+        # it, and zero-scoring every honest miner for one push interval
+        # after each merge would be harsher than the noise. "skip"
+        # zero-scores them with a named reason instead (the averager
+        # defaults to skip — see AveragerLoop.stale_deltas for why the
+        # MERGE must not ingest them).
+        if stale_deltas not in ("skip", "accept"):
+            raise ValueError(f"stale_deltas must be 'skip' or 'accept', "
+                             f"got {stale_deltas!r}")
+        self.stale_deltas = stale_deltas
         # accept adapter-tree submissions alongside full-param deltas
         # (engine/lora_train.py fetch_delta_any)
         self.lora_cfg = lora_cfg
@@ -208,7 +221,17 @@ class Validator:
                 self._host_template())
         return self._quant_template_cache
 
+    def _is_stale(self, hotkey: str) -> bool:
+        """Rider check before the full artifact fetch (tiny JSON read);
+        shared verdict logic + pod broadcast discipline in
+        train.stale_submission. Riderless submissions are never stale."""
+        from .train import stale_submission
+        return stale_submission(self.transport, hotkey,
+                                self._base_revision, multi=self._multi())
+
     def score_miner(self, hotkey: str) -> MinerScore:
+        if self.stale_deltas == "skip" and self._is_stale(hotkey):
+            return MinerScore(hotkey, 0.0, reason="stale_base")
         d = self._fetch_delta(hotkey)
         if d is None:
             return MinerScore(hotkey, 0.0, reason="no_delta")

@@ -253,6 +253,14 @@ class CoordinatorGatedTransport:
             return None
         return self._inner.publish_base(tree, *a, **kw)
 
+    def publish_delta_meta(self, miner_id, meta):
+        # same one-writer rule as the artifact itself (N processes
+        # committing the same rider file would conflict)
+        if not is_coordinator():
+            return None
+        pm = getattr(self._inner, "publish_delta_meta", None)
+        return pm(miner_id, meta) if pm is not None else None
+
     def gc(self, *a, **kw):
         if not is_coordinator():
             return None

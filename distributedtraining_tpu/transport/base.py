@@ -19,10 +19,37 @@ reference's commit-SHA polling.
 
 from __future__ import annotations
 
+import json
 from typing import Any, Optional, Protocol
 
 Params = Any
 Revision = Optional[str]
+
+META_MAX_BYTES = 4096
+
+
+def encode_delta_meta(meta: dict) -> bytes:
+    """Serialize a metadata rider (tiny JSON; size-capped on read)."""
+    return json.dumps(meta).encode()
+
+
+def parse_delta_meta(data: bytes | None) -> dict | None:
+    """Parse PEER-CONTROLLED rider bytes defensively: size-capped, must be
+    a JSON object, and the protocol-read key (``base_revision``) must be a
+    short string. Anything else reads as None (= no rider = reference
+    accept-anything behavior), never an exception."""
+    if data is None or len(data) > META_MAX_BYTES:
+        return None
+    try:
+        meta = json.loads(data)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(meta, dict):
+        return None
+    rev = meta.get("base_revision")
+    if rev is not None and not (isinstance(rev, str) and len(rev) <= 200):
+        return None
+    return meta
 
 
 class Transport(Protocol):
@@ -51,6 +78,22 @@ class Transport(Protocol):
         ...
 
     def delta_revision(self, miner_id: str) -> Revision:
+        ...
+
+    # -- delta metadata rider (optional; absent = reference behavior) ------
+    def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
+        """Small JSON rider next to the delta artifact. The one key the
+        protocol reads is ``base_revision`` — the base the delta was
+        computed against — which lets receivers detect STALE deltas (a
+        delta vs base N applied to base N+1 re-adds the part of the
+        N->N+1 update the miner had already incorporated; the reference
+        silently double-applies). Peer-controlled: readers must treat the
+        contents as untrusted."""
+        ...
+
+    def fetch_delta_meta(self, miner_id: str) -> dict | None:
+        """The rider for ``miner_id``, or None (absent/unparseable —
+        receivers then fall back to the reference's accept-anything)."""
         ...
 
     # -- base model (averager publishes, everyone pulls) -------------------

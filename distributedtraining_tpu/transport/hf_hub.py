@@ -28,6 +28,7 @@ from .base import Revision
 Params = Any
 
 DELTA_FILE = "weight_diff.msgpack"
+META_FILE = "weight_diff.meta.json"
 BASE_FILE = "averaged_model.msgpack"
 
 
@@ -78,9 +79,13 @@ class HFHubTransport:
             os.unlink(tmp)
         return getattr(info, "oid", None) or self._revision(repo_id)
 
-    def _download_bytes(self, repo_id: str, filename: str) -> bytes | None:
+    def _download_bytes(self, repo_id: str, filename: str,
+                        max_bytes: int | None = None) -> bytes | None:
         """One network download -> capped raw bytes; the cached blob is
-        deleted after reading to bound disk (hf_manager.py:195)."""
+        deleted after reading to bound disk (hf_manager.py:195).
+        ``max_bytes`` overrides the delta-sized default for small files
+        (the rider cap — a hostile GB-sized meta.json must die at the
+        size check, not get read into memory)."""
         from huggingface_hub.utils import EntryNotFoundError, RepositoryNotFoundError
         try:
             # routed through the api object (not the module function) so a
@@ -89,7 +94,7 @@ class HFHubTransport:
         except (EntryNotFoundError, RepositoryNotFoundError):
             return None
         try:
-            if os.path.getsize(path) > self.max_bytes:
+            if os.path.getsize(path) > (max_bytes or self.max_bytes):
                 return None
             with open(path, "rb") as f:
                 return f.read()
@@ -142,6 +147,16 @@ class HFHubTransport:
 
     def delta_revision(self, miner_id: str) -> Revision:
         return self._revision(miner_id)
+
+    def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
+        from .base import encode_delta_meta
+        repo = self.my_repo_id or miner_id
+        self._upload_bytes(repo, META_FILE, encode_delta_meta(meta))
+
+    def fetch_delta_meta(self, miner_id: str) -> dict | None:
+        from .base import META_MAX_BYTES, parse_delta_meta
+        return parse_delta_meta(self._download_bytes(
+            miner_id, META_FILE, max_bytes=META_MAX_BYTES))
 
     def _squash_base_repo(self) -> None:
         """Squash BEFORE publishing (reference order, hf_manager.py:73-136):

@@ -23,11 +23,13 @@ from typing import Any
 
 from .. import serialization as ser
 from .. import signing
-from .base import Revision
+from .base import (META_MAX_BYTES, Revision, encode_delta_meta,
+                   parse_delta_meta)
 
 Params = Any
 
 _DELTA_FMT = "%s.msgpack"
+_META_FMT = "%s.meta.json"
 _BASE_NAME = "averaged_model.msgpack"
 
 
@@ -65,9 +67,15 @@ class LocalFSTransport:
         os.makedirs(os.path.join(root, "deltas"), exist_ok=True)
         os.makedirs(os.path.join(root, "base"), exist_ok=True)
 
+    @staticmethod
+    def _safe_id(miner_id: str) -> str:
+        """One sanitizer for every per-miner path: the artifact and its
+        rider must always map to the SAME identity."""
+        return miner_id.replace("/", "_").replace("..", "_")
+
     def _delta_path(self, miner_id: str) -> str:
-        safe = miner_id.replace("/", "_").replace("..", "_")
-        return os.path.join(self.root, "deltas", _DELTA_FMT % safe)
+        return os.path.join(self.root, "deltas",
+                            _DELTA_FMT % self._safe_id(miner_id))
 
     @property
     def _base_path(self) -> str:
@@ -107,6 +115,17 @@ class LocalFSTransport:
 
     def delta_revision(self, miner_id: str) -> Revision:
         return _hash_file(self._delta_path(miner_id))
+
+    def _meta_path(self, miner_id: str) -> str:
+        return os.path.join(self.root, "deltas",
+                            _META_FMT % self._safe_id(miner_id))
+
+    def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
+        _write_atomic(self._meta_path(miner_id), encode_delta_meta(meta))
+
+    def fetch_delta_meta(self, miner_id: str) -> dict | None:
+        return parse_delta_meta(
+            _read_capped(self._meta_path(miner_id), META_MAX_BYTES))
 
     # -- base model ---------------------------------------------------------
     def publish_base(self, base: Params) -> Revision:

@@ -11,7 +11,7 @@ from typing import Any
 
 from .. import serialization as ser
 from .. import signing
-from .base import Revision
+from .base import Revision, encode_delta_meta, parse_delta_meta
 
 Params = Any
 
@@ -19,6 +19,7 @@ Params = Any
 class InMemoryTransport:
     def __init__(self):
         self._deltas: dict[str, bytes] = {}
+        self._delta_meta: dict[str, bytes] = {}
         self._base: bytes | None = None
 
     # -- miner side ---------------------------------------------------------
@@ -54,6 +55,12 @@ class InMemoryTransport:
     def delta_revision(self, miner_id: str) -> Revision:
         data = self._deltas.get(miner_id)
         return None if data is None else hashlib.sha256(data).hexdigest()
+
+    def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
+        self._delta_meta[miner_id] = encode_delta_meta(meta)
+
+    def fetch_delta_meta(self, miner_id: str) -> dict | None:
+        return parse_delta_meta(self._delta_meta.get(miner_id))
 
     # -- base model ---------------------------------------------------------
     def publish_base(self, base: Params) -> Revision:

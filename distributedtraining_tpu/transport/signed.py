@@ -112,6 +112,21 @@ class SignedTransport:
         except ser.PayloadError:
             return None
 
+    def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
+        """Rider passthrough. Not enveloped: a forged rider can at worst
+        (a) re-enable the reference's own accept-stale behavior for this
+        miner, or (b) mark the miner's fresh delta stale — self-harm that
+        skip-policy receivers answer by dropping it for one push
+        interval. The artifact itself stays signature-verified either
+        way."""
+        pm = getattr(self.inner, "publish_delta_meta", None)
+        if pm is not None:
+            pm(miner_id, meta)
+
+    def fetch_delta_meta(self, miner_id: str) -> dict | None:
+        fm = getattr(self.inner, "fetch_delta_meta", None)
+        return fm(miner_id) if fm is not None else None
+
     def delta_revision(self, miner_id: str) -> Revision:
         return self.inner.delta_revision(miner_id)
 

@@ -62,7 +62,8 @@ def main(argv=None) -> int:
                         address_store=c.address_store,
                         max_delta_abs=cfg.max_delta_abs,
                         metrics=c.metrics, lora_cfg=c.lora_cfg,
-                        accept_quant=cfg.accept_quant)
+                        accept_quant=cfg.accept_quant,
+                        stale_deltas=cfg.stale_deltas or "skip")
     loop.bootstrap(params=c.initial_params)
     try:
         merged = loop.run_periodic(interval=cfg.averaging_interval,

@@ -114,4 +114,7 @@ def test_two_process_miner_cli(tmp_path):
         assert p.returncode == 0, f"miner process {pid} failed:\n{out}"
     # exactly one delta artifact, written by the coordinator
     deltas = os.listdir(tmp_path / "artifacts" / "deltas")
-    assert deltas == ["hotkey_0.msgpack"]
+    # exactly ONE artifact + ONE base-revision rider: both written once,
+    # by the coordinator (CoordinatorGatedTransport gates publish_delta
+    # AND publish_delta_meta)
+    assert sorted(deltas) == ["hotkey_0.meta.json", "hotkey_0.msgpack"]

@@ -1,0 +1,170 @@
+"""Stale-delta detection via the base-revision rider.
+
+The reference applies whatever delta is published to whatever base is
+current (training_manager.py:417-422 -> averaging_logic.py:422-448): a
+delta computed against base N merged into base N+1 re-adds the part of
+the N->N+1 update the miner had already incorporated. The rider
+(transport.publish_delta_meta) plus receiver policy close that hole;
+these tests pin the full loop and the policy knobs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import delta as delta_lib
+from distributedtraining_tpu.engine import (MinerLoop, TrainEngine,
+                                            Validator, WeightedAverage)
+from distributedtraining_tpu.engine.average import AveragerLoop
+from distributedtraining_tpu.engine.scheduler import Clock
+from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.transport import (InMemoryTransport,
+                                               LocalFSTransport)
+from distributedtraining_tpu.transport.base import parse_delta_meta
+
+
+class FakeClock(Clock):
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+    def advance(self, s):
+        self.t += s
+
+
+class _Chain:
+    my_hotkey = "hotkey_95"
+
+    def sync(self):
+        import types
+        return types.SimpleNamespace(hotkeys=["m0"])
+
+    def should_set_weights(self):
+        return False
+
+
+def _setup(transport):
+    model, cfg = gpt2.make_model("tiny")
+    engine = TrainEngine(model, seq_len=16)
+    rng = np.random.default_rng(0)
+
+    def batches(n):
+        for _ in range(n):
+            yield {"input_ids": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)}
+
+    return model, engine, batches
+
+
+def test_meta_rider_roundtrip_all_transports(tmp_path):
+    for t in (InMemoryTransport(), LocalFSTransport(str(tmp_path))):
+        t.publish_delta_meta("m0", {"base_revision": "abc123"})
+        assert t.fetch_delta_meta("m0") == {"base_revision": "abc123"}
+        assert t.fetch_delta_meta("ghost") is None
+
+
+def test_parse_delta_meta_defensive():
+    assert parse_delta_meta(None) is None
+    assert parse_delta_meta(b"not json") is None
+    assert parse_delta_meta(b"[1,2]") is None
+    assert parse_delta_meta(b"x" * 5000) is None          # size cap
+    assert parse_delta_meta(b'{"base_revision": 7}') is None  # wrong type
+    long = '{"base_revision": "%s"}' % ("r" * 300)
+    assert parse_delta_meta(long.encode()) is None        # oversize value
+    assert parse_delta_meta(b'{"base_revision": "ok"}') == {
+        "base_revision": "ok"}
+
+
+def test_miner_publishes_rider_and_averager_skips_stale(tmp_path):
+    """Full loop: push (rider) -> merge -> the SAME un-repushed delta is
+    refused by the next round; a re-push after the pull is accepted."""
+    transport = InMemoryTransport()
+    model, engine, batches = _setup(transport)
+    clock = FakeClock()
+    miner = MinerLoop(engine, transport, "m0", clock=clock,
+                      send_interval=1e9, check_update_interval=1e9)
+    miner.bootstrap(jax.random.PRNGKey(0))
+    # miner genesis base is local-only: publish it so revisions exist
+    from distributedtraining_tpu.engine.train import wire_out
+    transport.publish_base(wire_out(engine, miner.base_params))
+    miner._base_revision = transport.base_revision()
+    miner.run(batches(4), max_steps=4)
+    miner.flush()
+    assert transport.fetch_delta_meta("m0") == {
+        "base_revision": miner._base_revision}
+
+    avg = AveragerLoop(engine, transport, _Chain(), WeightedAverage(),
+                       val_batches=lambda: batches(1), clock=clock)
+    avg.bootstrap()
+    assert avg.run_round() is True          # fresh: merged
+    assert avg.report.last_accepted == 1
+    # base moved; the same published delta is now stale
+    assert avg.run_round() is False
+    assert avg.report.last_rejected == 1
+    # miner pulls the new base and re-pushes -> accepted again
+    miner._check_pull()
+    miner.run(batches(2), max_steps=2)
+    miner.flush()
+    assert avg.run_round() is True
+    assert avg.report.last_accepted == 1
+
+    # policy off: the stale delta would have been merged (reference mode)
+    avg2 = AveragerLoop(engine, transport, _Chain(), WeightedAverage(),
+                        val_batches=lambda: batches(1), clock=clock,
+                        stale_deltas="accept")
+    avg2.bootstrap()
+    assert avg2.run_round() is True         # fresh right now
+    assert avg2.run_round() is True         # stale but accepted anyway
+
+
+def test_validator_stale_policy(tmp_path):
+    transport = InMemoryTransport()
+    model, engine, batches = _setup(transport)
+    base = model.init_params(jax.random.PRNGKey(0))
+    transport.publish_base(base)
+    rev1 = transport.base_revision()
+    d = jax.tree_util.tree_map(lambda x: 0.01 * jnp.ones_like(x), base)
+    transport.publish_delta("m0", d)
+    transport.publish_delta_meta("m0", {"base_revision": rev1})
+    # base moves
+    moved = delta_lib.apply_delta(base, d)
+    transport.publish_base(moved)
+
+    class Chain(_Chain):
+        my_hotkey = "hotkey_95"
+
+    v_skip = Validator(engine, transport, Chain(),
+                       eval_batches=lambda: batches(1),
+                       stale_deltas="skip")
+    v_skip.bootstrap()
+    s = v_skip.score_miner("m0")
+    assert s.score == 0 and s.reason == "stale_base"
+
+    v_accept = Validator(engine, transport, Chain(),
+                         eval_batches=lambda: batches(1))
+    v_accept.bootstrap()
+    s = v_accept.score_miner("m0")
+    assert s.reason in ("ok",) or s.score >= 0  # scored, not refused
+
+    # riderless submissions are never stale under either policy
+    transport2 = InMemoryTransport()
+    transport2.publish_base(base)
+    transport2.publish_delta("m0", d)
+    v2 = Validator(engine, transport2, Chain(),
+                   eval_batches=lambda: batches(1), stale_deltas="skip")
+    v2.bootstrap()
+    assert v2.score_miner("m0").reason != "stale_base"
+
+
+def test_stale_flag_parses():
+    from distributedtraining_tpu.config import RunConfig
+    a = RunConfig.from_args("averager", ["--stale-deltas", "accept"])
+    assert a.stale_deltas == "accept"
+    v = RunConfig.from_args("validator", ["--stale-deltas", "skip"])
+    assert v.stale_deltas == "skip"
+    assert RunConfig.from_args("validator", []).stale_deltas is None
