@@ -113,6 +113,7 @@ class RunConfig:
 
     # -- averager strategy --------------------------------------------------
     strategy: str = "parameterized"          # weighted | parameterized | genetic
+    publish_policy: str = "improved"         # improved | always (ref parity)
     merge_chunk: int = 8                     # weighted-merge device chunk
     meta_epochs: int = 7                     # averager.py:106
     genetic_population: int = 10             # averaging_logic.py:830-970
@@ -407,6 +408,15 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        type=int, default=d.genetic_population)
         g.add_argument("--genetic-generations", dest="genetic_generations",
                        type=int, default=d.genetic_generations)
+        g.add_argument("--publish-policy", dest="publish_policy",
+                       choices=("improved", "always"),
+                       default=d.publish_policy,
+                       help="'improved' (default) publishes the merged "
+                            "base only when it does not worsen the current "
+                            "base's eval loss (one extra eval pass; keeps "
+                            "the shared base monotone under noisy/short "
+                            "miner deltas); 'always' is the reference's "
+                            "publish-regardless behavior")
         g.add_argument("--genetic-screen-batches",
                        dest="genetic_screen_batches", type=int,
                        default=d.genetic_screen_batches,

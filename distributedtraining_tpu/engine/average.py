@@ -408,6 +408,7 @@ class AveragerReport:
     last_accepted: int = 0
     last_rejected: int = 0
     last_loss: float = float("nan")
+    skipped_publishes: int = 0
 
 
 class AveragerLoop:
@@ -422,7 +423,8 @@ class AveragerLoop:
                  metrics=None,
                  lora_cfg=None,
                  accept_quant: bool = True,
-                 stale_deltas: str = "skip"):
+                 stale_deltas: str = "skip",
+                 publish_policy: str = "improved"):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -446,6 +448,19 @@ class AveragerLoop:
             raise ValueError(f"stale_deltas must be 'skip' or 'accept', "
                              f"got {stale_deltas!r}")
         self.stale_deltas = stale_deltas
+        # "improved": publish the merged base only when its eval loss
+        # does not exceed the CURRENT base's on the same fixed batches —
+        # the 2-hour soak showed that always-publishing (the reference's
+        # behavior, averaging_logic.py:544-583) lets val-negative deltas
+        # (short training windows, train/val noise) compound the shared
+        # base upward round over round (docs/soak_r04_before_stale_fix
+        # .jsonl: 1.99 -> 2.71 over 62 rounds). One extra eval pass per
+        # round buys a monotone non-increasing base. "always" restores
+        # reference behavior.
+        if publish_policy not in ("improved", "always"):
+            raise ValueError(f"publish_policy must be 'improved' or "
+                             f"'always', got {publish_policy!r}")
+        self.publish_policy = publish_policy
         # accept adapter-tree submissions alongside full-param deltas;
         # template cached once (depends only on base shapes)
         self.lora_cfg = lora_cfg
@@ -453,6 +468,7 @@ class AveragerLoop:
         self.report = AveragerReport()
         self.base_params: Params | None = None
         self._base_revision = None
+        self._base_loss = None   # cached eval of base_params (publish guard)
         self._host_template_cache = None
         self._quant_template_cache = None
 
@@ -501,6 +517,7 @@ class AveragerLoop:
             self._base_revision = self.transport.publish_base(
                 wire_out(self.engine, template))
         self.base_params = self.engine.place_params(self.base_params)
+        self._base_loss = None   # new base: guard re-evaluates lazily
 
     def _fetch_delta(self, hotkey: str):
         from .lora_train import (adapter_template, fetch_delta_any,
@@ -575,7 +592,10 @@ class AveragerLoop:
         return ids, deltas
 
     def run_round(self) -> bool:
-        """One averaging cycle; returns False when there was nothing to merge."""
+        """One averaging cycle; returns True when deltas were gathered and
+        merged (whether or not the publish guard let the result replace
+        the base — see ``publish_policy``), False when there was nothing
+        to merge."""
         if self.base_params is None:
             self.bootstrap()
         ids, deltas = self.gather_deltas()
@@ -608,10 +628,36 @@ class AveragerLoop:
             self.engine, self.base_params, stacked, ids,
             val_batches=self.val_batches, consensus=consensus)
         loss, ppl = self.engine.evaluate(merged, self.val_batches())
+        if self.publish_policy == "improved":
+            if self._base_loss is None:
+                # once per base: the batch factory is fixed, so the
+                # comparison is exact; after a publish the new base's
+                # loss IS the merged loss just computed (no re-eval)
+                self._base_loss, _ = self.engine.evaluate(
+                    self.base_params, self.val_batches())
+            if loss > self._base_loss + 1e-6:
+                logger.info(
+                    "averager: merged loss %.4f would worsen the base "
+                    "(%.4f); keeping the current base", loss,
+                    self._base_loss)
+                # last_loss keeps the PUBLISHED base's loss — reporting
+                # the rejected candidate's would read as a regression
+                # the guard just prevented
+                self.report.skipped_publishes += 1
+                if self.metrics:
+                    self.metrics.log(
+                        {"merged_loss": loss, "merged_ppl": ppl,
+                         "base_loss": self._base_loss,
+                         "accepted": len(ids), "published": 0},
+                        step=self.report.rounds)
+                self.report.rounds += 1
+                # the round DID meaningful work (gathered + merged +
+                # evaluated); only the publish was declined
+                return True
         self.report.last_loss = loss
         if self.metrics:
             self.metrics.log({"merged_loss": loss, "merged_ppl": ppl,
-                              "accepted": len(ids)},
+                              "accepted": len(ids), "published": 1},
                              step=self.report.rounds)
         from .train import wire_out
         self._base_revision = self.transport.publish_base(
@@ -622,6 +668,7 @@ class AveragerLoop:
         if commit is not None:
             commit()
         self.base_params = merged
+        self._base_loss = loss
         self.transport.gc()
         self.report.rounds += 1
         return True

@@ -63,7 +63,8 @@ def main(argv=None) -> int:
                         max_delta_abs=cfg.max_delta_abs,
                         metrics=c.metrics, lora_cfg=c.lora_cfg,
                         accept_quant=cfg.accept_quant,
-                        stale_deltas=cfg.stale_deltas or "skip")
+                        stale_deltas=cfg.stale_deltas or "skip",
+                        publish_policy=cfg.publish_policy)
     loop.bootstrap(params=c.initial_params)
     try:
         merged = loop.run_periodic(interval=cfg.averaging_interval,

@@ -138,7 +138,8 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
             if "merged_loss" in rec:
                 merged.append({"round": rec.get("step"),
                                "loss": rec["merged_loss"],
-                               "accepted": rec.get("accepted")})
+                               "accepted": rec.get("accepted"),
+                               "published": rec.get("published", 1)})
     resumed = False
     pushes_after_restart = 0
     if os.path.exists(logs["miner0"]):
@@ -164,16 +165,22 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
         "disk_first_bytes": disk[0]["bytes"] if disk else None,
         "disk_last_bytes": disk[-1]["bytes"] if disk else None,
     }
-    ok_rounds = [m for m in merged if (m["accepted"] or 0) > 0]
-    assert len(ok_rounds) >= 3, f"only {len(ok_rounds)} merging rounds"
-    # compounding: the best of the last rounds beats the first round
-    # (round-to-round noise on a small eval shard is expected; a plateau
-    # at the corpus floor still satisfies this unless round 0 was
-    # already there — in which case training genuinely compounded before
-    # the first merge, which the train logs show)
-    tail_best = min(m["loss"] for m in ok_rounds[-3:])
-    assert tail_best < ok_rounds[0]["loss"], \
-        f"merged loss did not improve: {ok_rounds[0]} -> {ok_rounds[-3:]}"
+    ok_rounds = [m for m in merged if (m["accepted"] or 0) > 0
+                 and m["published"]]
+    assert len(ok_rounds) >= 3, f"only {len(ok_rounds)} publishing rounds"
+    # the publish guard (--publish-policy improved) makes the PUBLISHED
+    # base loss monotone non-increasing BY CONSTRUCTION (each publish is
+    # compared against the current base on the same fixed batches): pin
+    # the whole sequence, not just the endpoints
+    for prev, cur in zip(ok_rounds, ok_rounds[1:]):
+        assert cur["loss"] <= prev["loss"] + 1e-4, \
+            f"published base regressed: {prev} -> {cur}"
+    # ...and training must actually COMPOUND, not just hold: the first
+    # publish beats the random-init base (~6.25 for tiny) by a wide
+    # margin and the tail strictly beats the first publish
+    assert ok_rounds[0]["loss"] < 5.0, ok_rounds[0]
+    assert ok_rounds[-1]["loss"] < ok_rounds[0]["loss"], \
+        f"no compounding: {ok_rounds[0]} -> {ok_rounds[-1]}"
     assert killed and restarted and resumed, \
         (killed, restarted, resumed)
     assert pushes_after_restart >= 1, \

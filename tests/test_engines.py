@@ -610,3 +610,56 @@ def test_genetic_merge_successive_halving_cuts_full_evals(setup, tmp_path):
     # the real cost is batches evaluated: screening reads 1 batch per
     # candidate, full passes are reserved for elites + the winner
     assert halved < consumed["batches"], (halved, consumed["batches"])
+
+
+def test_averager_publish_policy_guards_regressions(setup, tmp_path):
+    """--publish-policy improved: a merge that would WORSEN the shared
+    base on the eval set is not published (the 2h soak showed
+    always-publish compounding val-negative deltas upward — the
+    reference's behavior, kept available as 'always')."""
+    from distributedtraining_tpu.engine.average import AveragerLoop
+
+    model, cfg, engine, train_batches, val_batches = setup
+    transport = InMemoryTransport()
+    chain = LocalChain(str(tmp_path), my_hotkey="hotkey_95", epoch_length=0,
+                       clock=FakeClock())
+    base = model.init_params(jax.random.PRNGKey(0))
+    transport.publish_base(base)
+    # a delta that HURTS: random noise, large enough to worsen eval loss
+    noise = jax.tree_util.tree_map(
+        lambda x: 0.3 * jax.random.normal(jax.random.PRNGKey(5), x.shape,
+                                          x.dtype), base)
+    transport.publish_delta("hotkey_1", noise)
+
+    avg = AveragerLoop(engine, transport, chain, WeightedAverage(),
+                       val_batches=val_batches, clock=FakeClock())
+    avg.bootstrap()
+    rev_before = transport.base_revision()
+    # the round did meaningful work (True) but declined the publish
+    assert avg.run_round() is True
+    assert avg.report.skipped_publishes == 1
+    assert transport.base_revision() == rev_before
+
+    # reference mode publishes regardless
+    avg2 = AveragerLoop(engine, transport, chain, WeightedAverage(),
+                        val_batches=val_batches, clock=FakeClock(),
+                        publish_policy="always")
+    avg2.bootstrap()
+    assert avg2.run_round() is True
+    assert transport.base_revision() != rev_before
+
+    # and a GOOD delta still publishes under the guard
+    transport2 = InMemoryTransport()
+    transport2.publish_base(base)
+    state = engine.init_state(params=base)
+    for i, b in enumerate(train_batches()):
+        if i >= 10:
+            break
+        state, _ = engine.train_step(state, b)
+    transport2.publish_delta("hotkey_1",
+                             delta.compute_delta(state.params, base))
+    avg3 = AveragerLoop(engine, transport2, chain, WeightedAverage(),
+                        val_batches=val_batches, clock=FakeClock())
+    avg3.bootstrap()
+    assert avg3.run_round() is True
+    assert avg3.report.skipped_publishes == 0
