@@ -469,6 +469,8 @@ class AveragerLoop:
         self.base_params: Params | None = None
         self._base_revision = None
         self._base_loss = None   # cached eval of base_params (publish guard)
+        self._declined_fp = None  # delta-revision set of the last declined
+        #                           merge (skip identical re-merges)
         self._host_template_cache = None
         self._quant_template_cache = None
 
@@ -591,6 +593,17 @@ class AveragerLoop:
         self.report.last_rejected = rejected
         return ids, deltas
 
+    def _delta_fingerprint(self, ids: list[str]):
+        """(hotkey, delta_revision) set — identifies an exact submission
+        set so a declined merge is not recomputed until something
+        changes. Single-host only (per-process revision reads would
+        diverge on a pod; pods just re-merge)."""
+        try:
+            return frozenset(
+                (h, self.transport.delta_revision(h)) for h in ids)
+        except Exception:
+            return None
+
     def run_round(self) -> bool:
         """One averaging cycle; returns True when deltas were gathered and
         merged (whether or not the publish guard let the result replace
@@ -602,6 +615,15 @@ class AveragerLoop:
         if not ids:
             logger.info("averager: no valid deltas this round")
             return False
+        if (self._declined_fp is not None and not self._multi()
+                and self._delta_fingerprint(ids) == self._declined_fp):
+            # the exact submission set we already merged and declined:
+            # re-running the (possibly meta-learning) merge would burn
+            # the same eval passes for the same verdict
+            logger.info("averager: submissions unchanged since the "
+                        "declined merge; skipping recompute")
+            self.report.rounds += 1
+            return True
         if getattr(self.engine, "mesh", None) is not None:
             # ingest-shard the miner axis: the full M x params stack never
             # materializes on one device, and every merge strategy's sum
@@ -635,14 +657,20 @@ class AveragerLoop:
                 # loss IS the merged loss just computed (no re-eval)
                 self._base_loss, _ = self.engine.evaluate(
                     self.base_params, self.val_batches())
-            if loss > self._base_loss + 1e-6:
+            # NOT-improved spelling, deliberately: a NaN merged loss must
+            # fail this test (``nan > x`` is False — the `>` spelling
+            # would publish the NaN base and then disable every future
+            # comparison), making the guard the NaN backstop BEHIND the
+            # per-delta screens too
+            if not (loss <= self._base_loss + 1e-6):
                 logger.info(
                     "averager: merged loss %.4f would worsen the base "
                     "(%.4f); keeping the current base", loss,
                     self._base_loss)
-                # last_loss keeps the PUBLISHED base's loss — reporting
-                # the rejected candidate's would read as a regression
-                # the guard just prevented
+                # last_loss reports the PUBLISHED base's loss — the
+                # rejected candidate's would read as a regression the
+                # guard just prevented
+                self.report.last_loss = self._base_loss
                 self.report.skipped_publishes += 1
                 if self.metrics:
                     self.metrics.log(
@@ -651,6 +679,8 @@ class AveragerLoop:
                          "accepted": len(ids), "published": 0},
                         step=self.report.rounds)
                 self.report.rounds += 1
+                self._declined_fp = self._delta_fingerprint(ids)
+                self.transport.gc()   # storage bounding must not stall
                 # the round DID meaningful work (gathered + merged +
                 # evaluated); only the publish was declined
                 return True
@@ -669,6 +699,7 @@ class AveragerLoop:
             commit()
         self.base_params = merged
         self._base_loss = loss
+        self._declined_fp = None
         self.transport.gc()
         self.report.rounds += 1
         return True

@@ -63,20 +63,42 @@ def _du(path: str) -> int:
 
 
 def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
+        dataset: str = "files:/usr/share/common-licenses/*",
+        tokenizer: str = "byte",
         record: str | None = None) -> dict:
     os.makedirs(work_dir, exist_ok=True)
     logs = {r: os.path.join(work_dir, f"{r}.log")
             for r in ("miner0", "miner1", "validator", "averager")}
+    # real local text by default: the synthetic corpus saturates within
+    # the first merge interval, after which honest deltas stop improving
+    # the base and the publish guard (correctly) freezes it — a soak that
+    # demonstrates COMPOUNDING needs a task with hours of runway
+    if dataset.startswith("files:"):
+        import glob as _glob
+        if not any(os.path.isfile(p) for p in _glob.glob(
+                dataset[len("files:"):], recursive=True)):
+            # non-Debian hosts: fail HERE with a clear story instead of
+            # letting every role die at boot and the driver burn the
+            # whole --minutes before reporting '0 publishing rounds'
+            print(f"soak: no files match {dataset!r}; falling back to "
+                  "the synthetic corpus (compounding phase will be "
+                  "short)", flush=True)
+            dataset = "synthetic"
     common = ["--backend", "local", "--work-dir", work_dir,
-              "--model", model, "--dataset", "synthetic",
+              "--model", model, "--dataset", dataset,
+              "--tokenizer", tokenizer,
               "--eval-batches", "2", "--batch-size", "4",
               "--seq-len", "32", "--eval-seq-len", "64"]
 
     def miner(i: int):
         return _spawn(
             "miner", *common, "--hotkey", f"hotkey_{i}",
-            "--send-interval", "45", "--check-update-interval", "20",
+            "--send-interval", "30", "--check-update-interval", "15",
             "--checkpoint-interval", "60", "--log-every", "50",
+            # a gentle LR stretches the descent across MANY merge windows
+            # (at the default 5e-4 a tiny model covers most of its drop
+            # inside one 45 s window — one publish, then saturation)
+            "--learning-rate", "1e-4",
             log=logs[f"miner{i}"])
 
     t0 = time.time()
@@ -88,9 +110,13 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
         "--validation-interval", "90",
         "--metrics-path", os.path.join(work_dir, "validator_metrics.jsonl"),
         log=logs["validator"])
+    # 45 s merges: several averaging rounds land during the model's early
+    # descent (the COMPOUNDING evidence — multiple improving publishes)
+    # before the small-corpus fit saturates and the publish guard switches
+    # to holding the best base (the PROTECTION evidence)
     procs["averager"] = _spawn(
         "averager", *common, "--hotkey", "hotkey_99",
-        "--averaging-interval", "120", "--strategy", "weighted",
+        "--averaging-interval", "45", "--strategy", "weighted",
         "--metrics-path", os.path.join(work_dir, "averager_metrics.jsonl"),
         log=logs["averager"])
 
@@ -200,9 +226,12 @@ def main() -> int:
     p.add_argument("--work-dir", default="./soak_run")
     p.add_argument("--minutes", type=float, default=120.0)
     p.add_argument("--model", default="tiny")
+    p.add_argument("--dataset", default="files:/usr/share/common-licenses/*")
+    p.add_argument("--tokenizer", default="byte")
     p.add_argument("--record", default=None)
     a = p.parse_args()
-    run(a.work_dir, minutes=a.minutes, model=a.model, record=a.record)
+    run(a.work_dir, minutes=a.minutes, model=a.model, dataset=a.dataset,
+        tokenizer=a.tokenizer, record=a.record)
     return 0
 
 

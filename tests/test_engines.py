@@ -663,3 +663,24 @@ def test_averager_publish_policy_guards_regressions(setup, tmp_path):
     avg3.bootstrap()
     assert avg3.run_round() is True
     assert avg3.report.skipped_publishes == 0
+
+    # a NaN/overflowing merged loss must be DECLINED, not published (the
+    # `not (loss <= base)` spelling — `loss > base` is False for NaN and
+    # would publish the NaN base and disable the guard forever)
+    transport3 = InMemoryTransport()
+    transport3.publish_base(base)
+    # finite wire values whose activations overflow in compute: the eval
+    # loss comes out inf/NaN, which only the not-improved spelling rejects
+    big = jax.tree_util.tree_map(lambda x: jnp.full_like(x, 1e30), base)
+    transport3.publish_delta("hotkey_1", big)
+    avg4 = AveragerLoop(engine, transport3, chain, WeightedAverage(),
+                        val_batches=val_batches, clock=FakeClock(),
+                        max_delta_abs=0)   # cap disabled: guard is last line
+    avg4.bootstrap()
+    rev = transport3.base_revision()
+    assert avg4.run_round() is True
+    assert avg4.report.skipped_publishes == 1
+    assert transport3.base_revision() == rev
+    # ...and the identical submission set is not re-merged next round
+    assert avg4.run_round() is True
+    assert avg4.report.skipped_publishes == 1  # recompute skipped
