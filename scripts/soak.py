@@ -211,8 +211,11 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
         (killed, restarted, resumed)
     assert pushes_after_restart >= 1, \
         f"restarted miner never pushed again ({pushes_after_restart})"
-    assert disk and disk[-1]["bytes"] < 3 * max(disk[0]["bytes"], 1), \
-        (disk[0], disk[-1])
+    # bounded disk vs the first POST-GENESIS sample (early samples can
+    # be 0 while roles are still compiling — v7 tripped on exactly that)
+    nonzero = [d for d in disk if d["bytes"] > 0]
+    assert nonzero and nonzero[-1]["bytes"] < 3 * nonzero[0]["bytes"], \
+        (nonzero[0] if nonzero else None, disk[-1])
     summary["passed"] = True
     if record:
         with open(record, "w") as f:
