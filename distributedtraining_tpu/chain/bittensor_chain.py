@@ -19,12 +19,16 @@ operates on the Local*/InMemory twins instead.
 
 from __future__ import annotations
 
+import logging
+import threading
 from typing import Optional
 
 from .. import spec_version
 from ..utils.timeout import ChainTimeout, run_with_timeout
 from .base import (EMA_ALPHA, Metagraph, ema_update, mad_anomaly_mask,
                    normalize_scores, quantize_u16)
+
+logger = logging.getLogger(__name__)
 
 CHAIN_OP_TIMEOUT = 60.0  # chain_manager.py:68,86,105
 
@@ -39,25 +43,70 @@ def _require_bittensor():
             "chain.LocalAddressStore for offline operation") from e
 
 
-class BittensorAddressStore:
-    """Chain commitments as the hotkey -> repo registry."""
+def _close_connection(obj) -> None:
+    """Best-effort kill of whatever socket/websocket ``obj`` holds, so a
+    worker thread parked on its recv unblocks and exits (utils/timeout.py
+    accounting). The bittensor SDK has grown/renamed its close surface
+    across versions; try the known spellings."""
+    for attr in ("close", "disconnect"):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                fn()
+                return
+            except Exception:  # a dead socket's close can itself raise
+                pass
+    ws = getattr(getattr(obj, "substrate", None), "websocket", None)
+    if ws is not None and callable(getattr(ws, "close", None)):
+        try:
+            ws.close()
+        except Exception:
+            pass
 
-    def __init__(self, subtensor, netuid: int, wallet=None):
-        self.subtensor = subtensor
+
+class BittensorAddressStore:
+    """Chain commitments as the hotkey -> repo registry.
+
+    ``subtensor`` may be the object itself or a zero-arg supplier. The
+    role wiring passes ``lambda: chain.subtensor`` plus the chain's
+    recycle hook so store and chain always share ONE live connection —
+    a fixed reference would go permanently stale the first time the
+    chain recycles its wedged subtensor out from under it."""
+
+    def __init__(self, subtensor, netuid: int, wallet=None, *,
+                 on_timeout=None):
+        self._subtensor = subtensor
         self.netuid = netuid
         self.wallet = wallet
+        self._recycle = on_timeout
+
+    @property
+    def subtensor(self):
+        return self._subtensor() if callable(self._subtensor) \
+            else self._subtensor
+
+    def _on_timeout(self) -> None:
+        if self._recycle is not None:
+            self._recycle()  # shared-connection owner kills AND replaces
+        # Without a recycle path (legacy fixed-subtensor construction),
+        # leave the connection alone: closing it would unpark the worker
+        # but permanently break every later op — there is no
+        # reconstruction machinery here. The abandoned worker is
+        # accounted by utils/timeout.py either way.
 
     def store_repo(self, hotkey: str, repo_id: str) -> None:
         def op():
             self.subtensor.commit(self.wallet, self.netuid, repo_id)
-        run_with_timeout(op, CHAIN_OP_TIMEOUT, name="store_repo")
+        run_with_timeout(op, CHAIN_OP_TIMEOUT, name="store_repo",
+                         on_timeout=self._on_timeout)
 
     def retrieve_repo(self, hotkey: str) -> Optional[str]:
         def op():
             meta = self.subtensor.get_commitment(self.netuid, hotkey)
             return meta or None
         try:
-            return run_with_timeout(op, CHAIN_OP_TIMEOUT, name="retrieve_repo")
+            return run_with_timeout(op, CHAIN_OP_TIMEOUT, name="retrieve_repo",
+                                    on_timeout=self._on_timeout)
         except ChainTimeout:
             return None
 
@@ -71,8 +120,16 @@ class BittensorAddressStore:
         return None
 
 
+# reconnects are rare (one per recycled connection) and short of a wedge
+# they don't contend — one process-wide lock keeps lazy reconstruction
+# single-flight without per-instance state
+_RECONNECT_LOCK = threading.Lock()
+
+
 class BittensorChain:
     """Network impl over a live subtensor."""
+
+    _needs_reconnect = False  # instance attr after the first recycle
 
     def __init__(self, *, netuid: int, wallet_name: str, wallet_hotkey: str,
                  network: str = "finney", epoch_length: int = 100,
@@ -90,6 +147,7 @@ class BittensorChain:
         self._last_sync_block = -(10**9)
         self.vpermit_stake_limit = vpermit_stake_limit
         self.wallet = bt.wallet(name=wallet_name, hotkey=wallet_hotkey)
+        self._network = network
         self.subtensor = bt.subtensor(network=network)
         self.metagraph = self.subtensor.metagraph(netuid)
         self._ema: dict[str, float] = {}
@@ -102,6 +160,42 @@ class BittensorChain:
     def my_hotkey(self) -> str:
         return self.wallet.hotkey.ss58_address
 
+    def _recycle_connection(self) -> None:
+        """On an RPC deadline: kill the wedged connection (unparking the
+        abandoned worker — see utils/timeout.py) and mark it for lazy
+        reconstruction. The reconnect happens INSIDE the next
+        deadline-wrapped op (_ensure_connected) — reconstructing here on
+        the caller thread could block unboundedly on the same dead
+        endpoint, which is exactly what run_with_timeout exists to
+        prevent. The reference gets both effects by killing its forked
+        child (chain_manager.py:36-46)."""
+        _close_connection(self.subtensor)
+        self._needs_reconnect = True
+
+    def _ensure_connected(self):
+        """Current subtensor, reconnecting first when the last one was
+        recycled. MUST be called from inside a deadline-wrapped op (every
+        RPC closure here and in the address store does) so a hanging
+        reconnect surfaces as ChainTimeout instead of stalling the
+        engine loop.
+
+        The blocking constructor runs OUTSIDE the lock: a reconnect that
+        hangs on a wedged endpoint then parks only its own worker (the
+        caller gets ChainTimeout and later workers retry their own
+        reconnects) instead of holding a lock every RPC needs. The lock
+        only guards the compare-and-swap; a losing racer's connection is
+        closed and discarded."""
+        if not self._needs_reconnect:
+            return self.subtensor
+        fresh = self.bt.subtensor(network=self._network)
+        with _RECONNECT_LOCK:
+            if self._needs_reconnect:
+                self.subtensor = fresh
+                self._needs_reconnect = False
+                return fresh
+        _close_connection(fresh)  # another worker won the race
+        return self.subtensor
+
     def sync(self) -> Metagraph:
         block = self.current_block()
         if (self.resync_blocks > 0
@@ -109,17 +203,20 @@ class BittensorChain:
             m = self.metagraph  # cached within the resync window
         else:
             def op():
-                self.metagraph.sync(subtensor=self.subtensor, lite=True)
+                self.metagraph.sync(subtensor=self._ensure_connected(),
+                                    lite=True)
                 return self.metagraph
-            m = run_with_timeout(op, CHAIN_OP_TIMEOUT, name="metagraph_sync")
+            m = run_with_timeout(op, CHAIN_OP_TIMEOUT, name="metagraph_sync",
+                                 on_timeout=self._recycle_connection)
             self._last_sync_block = block
         return Metagraph(hotkeys=list(m.hotkeys), uids=list(range(len(m.hotkeys))),
                          stakes=[float(s) for s in m.S],
                          block=block)
 
     def current_block(self) -> int:
-        return int(run_with_timeout(lambda: self.subtensor.block,
-                                    CHAIN_OP_TIMEOUT, name="block"))
+        return int(run_with_timeout(lambda: self._ensure_connected().block,
+                                    CHAIN_OP_TIMEOUT, name="block",
+                                    on_timeout=self._recycle_connection))
 
     def should_set_weights(self) -> bool:
         return (self.current_block() - self._last_weight_block) >= self.epoch_length
@@ -138,11 +235,12 @@ class BittensorChain:
         endpoint (e.g. the peer registry) can publish it the reference way."""
         def op():
             axon = self.bt.axon(wallet=self.wallet, ip=ip, port=port)
-            return bool(self.subtensor.serve_axon(netuid=self.netuid,
+            return bool(self._ensure_connected().serve_axon(netuid=self.netuid,
                                                   axon=axon))
         try:
             return bool(run_with_timeout(op, CHAIN_OP_TIMEOUT,
-                                         name="serve_axon"))
+                                         name="serve_axon",
+                                         on_timeout=self._recycle_connection))
         except ChainTimeout:
             return False
 
@@ -161,11 +259,12 @@ class BittensorChain:
         weights = quantize_u16([norm[hotkeys[u]] for u in uids])
 
         def op():
-            return self.subtensor.set_weights(
+            return self._ensure_connected().set_weights(
                 wallet=self.wallet, netuid=self.netuid, uids=uids,
                 weights=weights, version_key=spec_version(),
                 wait_for_inclusion=False)
-        ok = bool(run_with_timeout(op, CHAIN_OP_TIMEOUT, name="set_weights"))
+        ok = bool(run_with_timeout(op, CHAIN_OP_TIMEOUT, name="set_weights",
+                                   on_timeout=self._recycle_connection))
         if ok:
             self._last_weight_block = self.current_block()
         return ok

@@ -477,8 +477,19 @@ def densify_sparse_delta(sparse: Params, template: Params) -> Params:
 
     if not isinstance(sparse, dict):
         return None
+    # The marker is attacker-controlled bytes: a string/array/NaN marker
+    # must read as "not sparse8", not raise out of the decoder (a raised
+    # TypeError here used to escape the fetch try-chain and abort the
+    # whole scoring round — one hostile artifact silencing every miner).
     marker = sparse.get(SPARSE_FORMAT_KEY)
-    if marker is None or int(np.asarray(marker)) != SPARSE_FORMAT_TOPK8:
+    try:
+        marker_arr = np.asarray(marker)
+        if marker_arr.shape != () or not np.issubdtype(
+                marker_arr.dtype, np.integer):
+            return None
+        if int(marker_arr) != SPARSE_FORMAT_TOPK8:
+            return None
+    except (TypeError, ValueError):
         return None
     leaves = sparse.get("leaves")
     if not isinstance(leaves, dict) or set(sparse) != {
@@ -534,4 +545,10 @@ def sparse_delta_from_bytes(data: bytes, template: Params,
         raw = ser.from_msgpack(data, None, **kw)
     except ser.PayloadError:
         return None
-    return densify_sparse_delta(raw, template)
+    # Belt-and-braces: densify validates field-by-field and returns None,
+    # but hostile bytes must fail per-miner even if a validation gap lets
+    # an exception through (same contract as the other decoders).
+    try:
+        return densify_sparse_delta(raw, template)
+    except (TypeError, ValueError, KeyError, IndexError):
+        return None

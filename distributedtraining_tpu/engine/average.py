@@ -376,7 +376,8 @@ class GeneticMerge:
         for i in range(self.population - 1):
             rng, k = jax.random.split(rng)
             pop.append(jax.nn.softmax(jax.random.normal(k, (m,))))
-        for gen in range(self.generations):
+        elites: list = []  # --genetic-generations 0 = pick best of the
+        for gen in range(self.generations):  # initial population below
             scored = sorted(pop, key=screen)
             elites = sorted(scored[: self.elite * 2],
                             key=fitness)[: self.elite]

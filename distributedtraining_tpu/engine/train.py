@@ -32,6 +32,7 @@ from flax import struct
 from .. import delta as delta_lib
 from ..ops.losses import causal_lm_loss
 from ..parallel.sharding import batch_sharding, mesh_shardings, opt_state_shardings
+from ..utils.metrics import device_metrics
 from .scheduler import Clock, PeriodicAction, RealClock
 
 logger = logging.getLogger(__name__)
@@ -1042,7 +1043,8 @@ class MinerLoop:
                     self.report.last_loss = float(self._last_loss_dev)
                     self.metrics.log(
                         {"train_loss": self.report.last_loss,
-                         "staleness_s": self.clock.now() - self._last_base_time},
+                         "staleness_s": self.clock.now() - self._last_base_time,
+                         **device_metrics()},
                         step=self.report.steps)
                 self._push_action.poll()
                 if self._ckpt_action is not None:

@@ -25,6 +25,7 @@ from typing import Any, Callable, Iterable, Optional
 import jax
 
 from .. import delta as delta_lib
+from ..utils.metrics import device_metrics
 from .scheduler import Clock, RealClock
 
 logger = logging.getLogger(__name__)
@@ -279,6 +280,7 @@ class Validator:
             with_loss = [s for s in results if s.loss is not None]
             positive = [s for s in results if s.score > 0]
             self.metrics.log({
+                **device_metrics(),
                 "scored": len(results),
                 "rejected": len(results) - len(with_loss),
                 "score_positive": len(positive),

@@ -399,9 +399,10 @@ def fused_ce_loss_sharded(hidden: jax.Array, head_kernel: jax.Array,
     shard_map transposes the all-gathers into psum_scatters, landing dW
     shards exactly where the optimizer expects them.
 
-    sp meshes are refused by the engine routing (the label shift in
-    _fused_lm_loss crosses sequence-shard boundaries); ring-attention runs
-    take the scan spelling instead.
+    sp meshes compose too: the engine shifts labels GLOBALLY before
+    sharding (labels-carry-the-shift, engine/train.py), so each sequence
+    shard is self-contained and the kernel never reads across a
+    sequence-shard boundary; the sp axis simply joins the row split.
     """
     from jax.sharding import PartitionSpec as P
     try:  # moved in newer jax

@@ -93,6 +93,7 @@ class AutoUpdater:
         self.repo_dir = repo_dir
         self.restart = restart if restart is not None else self._reexec
         self.hard_recovery_ref = hard_recovery_ref
+        self._clean_failures = 0  # consecutive clean-tree update failures
 
     def _run(self, cmd: Sequence[str]) -> bool:
         try:
@@ -102,20 +103,76 @@ class AutoUpdater:
         except (subprocess.SubprocessError, OSError):
             return False
 
+    def _dirty_or_diverged(self) -> Optional[bool]:
+        """True when the tree has local edits or history that is not an
+        ancestor of the recovery ref — the two states the destructive
+        fallback exists for. None when git itself can't answer (never
+        destroy state on an unknown)."""
+        try:
+            status = subprocess.run(
+                ["git", "status", "--porcelain"], cwd=self.repo_dir,
+                check=True, timeout=60, capture_output=True,
+                text=True).stdout.strip()
+            if status:
+                return True
+            ancestor = subprocess.run(
+                ["git", "merge-base", "--is-ancestor", "HEAD",
+                 self.hard_recovery_ref], cwd=self.repo_dir,
+                timeout=60, capture_output=True)
+            return ancestor.returncode != 0
+        except (subprocess.SubprocessError, OSError):
+            return None
+
     def _update(self) -> bool:
         if self._run(self.update_cmd):
+            self._clean_failures = 0
             return True
         if self.hard_recovery_ref is None:
             logger.error("auto-update: update command failed and hard "
                          "recovery is disabled; not restarting")
             return False
-        logger.warning("auto-update: %s failed (dirty/diverged tree?); "
-                       "hard-recovering to %s",
-                       " ".join(self.update_cmd), self.hard_recovery_ref)
-        ok = (self._run(("git", "fetch", "--quiet"))
-              and self._run(("git", "reset", "--hard",
-                             self.hard_recovery_ref)))
-        if not ok:
+        # Distinguish a transient failure (unreachable remote mid-pull)
+        # from the states hard recovery is for: a fetch that fails now is
+        # transient — retry next poll rather than discard operator edits.
+        if not self._run(("git", "fetch", "--quiet")):
+            logger.warning("auto-update: %s failed and fetch is failing "
+                           "too (transient network?); will retry next "
+                           "poll, not hard-recovering",
+                           " ".join(self.update_cmd))
+            return False
+        culprit = self._dirty_or_diverged()
+        if culprit is None:
+            logger.warning("auto-update: %s failed and the tree state is "
+                           "undeterminable; not hard-recovering",
+                           " ".join(self.update_cmd))
+            return False
+        if not culprit:
+            # Clean + not diverged usually means the failure was transient
+            # — but some clean states (detached HEAD at an old commit, a
+            # branch with no upstream) fail the polite command on EVERY
+            # poll. One failure with a working fetch gets a retry; a
+            # second consecutive one is persistent and recovers hard.
+            self._clean_failures += 1
+            if self._clean_failures < 2:
+                logger.warning(
+                    "auto-update: %s failed but the tree is clean and not "
+                    "diverged (transient failure?); retrying next poll",
+                    " ".join(self.update_cmd))
+                return False
+            logger.warning(
+                "auto-update: %s failed %d consecutive polls with a "
+                "reachable remote and a clean tree (detached HEAD / no "
+                "upstream?); hard-recovering to %s",
+                " ".join(self.update_cmd), self._clean_failures,
+                self.hard_recovery_ref)
+        else:
+            logger.warning("auto-update: %s failed on a dirty/diverged "
+                           "tree; hard-recovering to %s",
+                           " ".join(self.update_cmd), self.hard_recovery_ref)
+        ok = self._run(("git", "reset", "--hard", self.hard_recovery_ref))
+        if ok:
+            self._clean_failures = 0
+        else:
             logger.error("auto-update: hard recovery failed; not restarting")
         return ok
 

@@ -148,6 +148,12 @@ def device_metrics() -> dict[str, float]:
             if lim:
                 out[f"device{i}_mem_fraction"] = (
                     float(stats.get("bytes_in_use", 0)) / float(lim))
+    # chain-RPC hygiene: live parked workers + lifetime timeouts
+    # (utils/timeout.py) — a flaky substrate shows up here instead of as a
+    # silent thread/socket leak
+    from .timeout import abandoned_total, abandoned_workers
+    out["chain_abandoned_workers"] = float(abandoned_workers())
+    out["chain_abandoned_total"] = float(abandoned_total())
     try:
         import psutil
         out["cpu_percent"] = psutil.cpu_percent()

@@ -6,23 +6,71 @@ wedge. Forking breaks under JAX (the child inherits TPU handles), so the
 same hygiene is a daemon worker thread + deadline: the caller gets
 ChainTimeout and moves on; an abandoned thread parks on dead IO and never
 touches device state.
+
+Unlike the reference's fork, a parked Python thread cannot be killed — so
+abandonment is *accounted for* instead of ignored:
+
+- every timeout registers the worker in a live-abandoned set;
+  ``abandoned_workers()`` (live, self-pruning) and ``abandoned_total()``
+  (monotonic) ride ``utils.metrics.device_metrics()`` into every role's
+  metric stream, so a long-lived validator on a flaky substrate shows
+  the leak instead of silently accumulating it;
+- callers can pass ``on_timeout`` to kill the IO object the worker is
+  parked on (closing a dead websocket unblocks the blocked recv, the
+  worker raises and exits, and the "leak" resolves itself — the
+  reference gets the same effect by killing the forked child);
+- past ``ABANDON_WARN_THRESHOLD`` live abandoned workers a warning logs
+  on every further timeout, naming the remedy.
 """
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
-from typing import Any, Callable, TypeVar
+from typing import Callable, Optional, TypeVar
+
+logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
+
+# Above this many LIVE parked workers the wrapper complains loudly: the
+# caller is timing out repeatedly without an on_timeout that unblocks the
+# dead connection, and each hang costs a thread + socket until then.
+ABANDON_WARN_THRESHOLD = 8
+
+_abandoned_lock = threading.Lock()
+_abandoned: list[threading.Thread] = []
+_abandoned_total = 0
 
 
 class ChainTimeout(TimeoutError):
     pass
 
 
+def abandoned_workers() -> int:
+    """Live worker threads abandoned by a past timeout (self-pruning:
+    workers whose IO eventually returned — or was killed via
+    ``on_timeout`` — drop out). Exported as a gauge by the role loops."""
+    with _abandoned_lock:
+        _abandoned[:] = [t for t in _abandoned if t.is_alive()]
+        return len(_abandoned)
+
+
+def abandoned_total() -> int:
+    """Monotonic count of timeouts that abandoned a worker (a counter
+    metric; live leakage is ``abandoned_workers()``)."""
+    return _abandoned_total
+
+
 def run_with_timeout(fn: Callable[[], T], timeout: float, *,
-                     name: str = "op") -> T:
+                     name: str = "op",
+                     on_timeout: Optional[Callable[[], None]] = None) -> T:
+    """Run ``fn`` on a daemon thread; raise ChainTimeout after ``timeout``
+    seconds. ``on_timeout`` (optional) runs on the CALLER's thread right
+    after the deadline fires — close/kill the connection object ``fn`` is
+    blocked on there so the abandoned worker can actually exit."""
+    global _abandoned_total
     q: queue.Queue = queue.Queue(maxsize=1)
 
     def worker():
@@ -36,6 +84,22 @@ def run_with_timeout(fn: Callable[[], T], timeout: float, *,
     try:
         kind, val = q.get(timeout=timeout)
     except queue.Empty:
+        with _abandoned_lock:
+            _abandoned[:] = [x for x in _abandoned if x.is_alive()]
+            _abandoned.append(t)
+            _abandoned_total += 1
+            live = len(_abandoned)
+        if on_timeout is not None:
+            try:
+                on_timeout()
+            except Exception:
+                logger.exception("%s: on_timeout hook failed", name)
+        if live > ABANDON_WARN_THRESHOLD:
+            logger.warning(
+                "%s timed out; %d abandoned worker threads are still "
+                "parked (total timeouts: %d) — pass on_timeout to kill "
+                "the wedged connection so they can exit", name, live,
+                _abandoned_total)
         raise ChainTimeout(f"{name} exceeded {timeout}s") from None
     if kind == "err":
         raise val

@@ -188,8 +188,13 @@ def build(cfg: RunConfig) -> Components:
                                epoch_length=cfg.epoch_length,
                                resync_blocks=cfg.resync_blocks,
                                vpermit_stake_limit=cfg.vpermit_stake_limit)
-        address_store = BittensorAddressStore(chain.subtensor, cfg.netuid,
-                                              wallet=chain.wallet)
+        # the supplier is called INSIDE the store's deadline-wrapped ops,
+        # so a reconnect after a recycle is itself bounded by the RPC
+        # deadline; the shared on_timeout keeps store and chain recycling
+        # the same connection instead of desynchronizing
+        address_store = BittensorAddressStore(
+            chain._ensure_connected, cfg.netuid, wallet=chain.wallet,
+            on_timeout=chain._recycle_connection)
     else:
         if cfg.backend == "hf":
             # deltas would flow through the Hub while scores stay in a

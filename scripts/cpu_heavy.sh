@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Gate for host-heavy CPU jobs (the pytest suite, parallel builds).
+#
+# Round-4 lesson 2 (TUNNEL_r04.md): host CPU contention starved the
+# on-chip test lane into its timeout, and the timeout kill wedged the
+# tunnel. watch_and_measure.sh holds $TPU_BUSY_FLAG (same env var, same
+# default) while any TPU client is in flight; run every heavy CPU job
+# through this wrapper so it waits for the window to close instead of
+# racing the chip:
+#
+#   scripts/cpu_heavy.sh python -m pytest tests/ -x -q
+#
+# The flag contains the holder's pid. A flag whose holder is no longer
+# alive (watcher SIGKILLed before its traps ran) is stale and ignored,
+# so a dead watcher can never deadlock this gate.
+set -uo pipefail
+
+BUSY="${TPU_BUSY_FLAG:-/tmp/tpu_busy}"
+
+while [ -e "$BUSY" ]; do
+  owner="$(cat "$BUSY" 2>/dev/null || true)"
+  if [ -n "$owner" ] && ! kill -0 "$owner" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) cpu_heavy: stale flag (holder $owner dead); ignoring" >&2
+    break
+  fi
+  echo "$(date -u +%FT%TZ) cpu_heavy: waiting for TPU window to close ($BUSY held by ${owner:-?})" >&2
+  sleep 30
+done
+exec "$@"

@@ -14,9 +14,21 @@ LOG="${3:-/tmp/watch_measure_${ROUND}.log}"
 
 say() { echo "$(date -u +%FT%TZ) $*" >>"$LOG"; }
 
+# Coordination with host-side CPU work (round-4 lesson 2): while a TPU
+# client is in flight we hold $BUSY; heavy CPU jobs go through
+# scripts/cpu_heavy.sh, which waits for the flag to clear. (This script
+# itself never reads the flag — it IS the holder.) The flag records the
+# holder's pid so cpu_heavy.sh can detect a stale flag from a killed
+# watcher; INT/TERM are trapped because bash skips the EXIT trap on an
+# untrapped fatal signal.
+BUSY="${TPU_BUSY_FLAG:-/tmp/tpu_busy}"
+trap 'rm -f "$BUSY"' EXIT
+trap 'exit 129' INT TERM
+
 say "watcher start (round=$ROUND period=${PERIOD}s)"
 while true; do
   if scripts/measure.sh probe >>"$LOG" 2>&1; then
+    echo "$$" > "$BUSY"
     say "probe OK — running bench"
     if scripts/measure.sh bench "$ROUND" >/tmp/bench_${ROUND}_raw.log 2>&1; then
       say "bench OK"

@@ -376,3 +376,24 @@ def test_sparse8_hostile_payloads_rejected():
     with _pytest.raises(ser.PayloadError):
         ser.validated_load(data, delta.quantized_template(template),
                            check_dtypes=True)
+
+
+def test_sparse8_hostile_marker_types_return_none():
+    """The format marker is attacker bytes: string/array/float/NaN markers
+    must read as not-sparse8 (None), never raise out of the decoder — a
+    raised TypeError used to escape the fetch try-chain and abort the
+    whole validator round (round-4 advisor, high)."""
+    from distributedtraining_tpu import serialization as ser
+
+    _, template = _sparse_case()
+    for marker in ("1", b"1", np.asarray([1, 1], np.int32),
+                   np.float32(np.nan), np.float32(1.0), None, [1], {"x": 1}):
+        tree = {"__delta_format__": marker, "leaves": {}}
+        try:
+            data = ser.to_msgpack(tree)
+        except Exception:
+            continue  # unencodable marker can't arrive over the wire
+        assert delta.sparse_delta_from_bytes(data, template) is None, marker
+    # and densify itself obeys the return-None contract on direct calls
+    assert delta.densify_sparse_delta(
+        {"__delta_format__": "sparse8", "leaves": {}}, template) is None

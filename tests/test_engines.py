@@ -250,6 +250,21 @@ def test_merge_strategies_improve_or_match_base(setup, tmp_path, strategy_name):
         assert merged_loss <= uniform_loss + 1e-4
 
 
+def test_genetic_merge_zero_generations_picks_best_of_population(setup):
+    """--genetic-generations 0 degrades to best-of-initial-population
+    (round-4 advisor: `elites` used to be unbound and raise NameError)."""
+    model, cfg, engine, train_batches, val_batches = setup
+    base = model.init_params(jax.random.PRNGKey(0))
+    deltas = [jax.tree_util.tree_map(
+        lambda x, s=s: 0.01 * s * jnp.ones_like(x), base) for s in (1, 2)]
+    stacked = delta.stack_deltas(deltas)
+    strat = GeneticMerge(population=3, generations=0, sigma=0.2)
+    merged, w = strat.merge(engine, base, stacked, ["a", "b"],
+                            val_batches=val_batches)
+    assert np.asarray(w).shape == (2,)
+    assert np.isfinite(engine.evaluate(merged, val_batches())[0])
+
+
 def test_parameterized_merge_downweights_noise(setup):
     model, cfg, engine, train_batches, val_batches = setup
     base = model.init_params(jax.random.PRNGKey(0))

@@ -340,3 +340,56 @@ def test_autoupdater_hard_recovery_converges_dirty_tree(tmp_path):
         restart=lambda: calls.append("restart2"))
     assert upd2.check() is False
     assert calls == ["restart"]
+
+
+def test_autoupdater_transient_failure_never_hard_resets(tmp_path):
+    """A failing update command on a CLEAN, non-diverged tree is treated
+    as transient — no `git reset --hard`, no restart, retry next poll —
+    so a network blip can never silently discard operator state
+    (round-4 advisor: the fallback used to fire on ANY failure)."""
+    from distributedtraining_tpu.utils.auto_update import git_remote_version
+
+    vf = "distributedtraining_tpu/__init__.py"
+    origin = tmp_path / "origin"
+    (origin / "distributedtraining_tpu").mkdir(parents=True)
+    (origin / vf).write_text('__version__ = "1.0.0"\n')
+    _git(origin, "init", "-q", "-b", "main")
+    _git(origin, "add", "-A")
+    _git(origin, "commit", "-qm", "v1")
+    clone = tmp_path / "clone"
+    _git(tmp_path, "clone", "-q", str(origin), str(clone))
+    (origin / vf).write_text('__version__ = "2.0.0"\n')
+    _git(origin, "add", "-A")
+    _git(origin, "commit", "-qm", "v2")
+
+    calls = []
+    upd = AutoUpdater(
+        "1.0.0", lambda: git_remote_version(str(clone)),
+        update_cmd=("false",),  # simulated mid-pull failure
+        repo_dir=str(clone), restart=lambda: calls.append("restart"))
+    assert upd.check() is False
+    assert calls == []
+    # the clean clone is untouched (still at v1, history intact)
+    assert (clone / vf).read_text() == '__version__ = "1.0.0"\n'
+
+    # a SECOND consecutive clean failure with a reachable remote is
+    # persistent (detached HEAD / missing upstream look exactly like
+    # this) and recovers hard — lossless here, since clean+not-diverged
+    # means the reset is a fast-forward
+    assert upd.check() is True
+    assert calls == ["restart"]
+    assert (clone / vf).read_text() == '__version__ = "2.0.0"\n'
+
+    # and on a DIRTY tree the first failing poll already recovers hard:
+    # the fallback still exists for the state it was built for
+    (origin / vf).write_text('__version__ = "3.0.0"\n')
+    _git(origin, "add", "-A")
+    _git(origin, "commit", "-qm", "v3")
+    (clone / vf).write_text('__version__ = "0.0.0-dirty"\n')
+    upd_dirty = AutoUpdater(
+        "2.0.0", lambda: git_remote_version(str(clone)),
+        update_cmd=("false",),
+        repo_dir=str(clone), restart=lambda: calls.append("restart2"))
+    assert upd_dirty.check() is True
+    assert calls == ["restart", "restart2"]
+    assert (clone / vf).read_text() == '__version__ = "3.0.0"\n'

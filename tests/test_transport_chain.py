@@ -198,6 +198,51 @@ def test_run_with_timeout():
         run_with_timeout(boom, 5.0)
 
 
+def test_run_with_timeout_abandonment_is_bounded_and_observable():
+    """N consecutive hangs must not grow the live thread count unboundedly
+    when the caller's on_timeout hook can unblock the worker (the
+    connection-kill pattern in chain/bittensor_chain.py), and every
+    abandonment is counted (round-4 verdict #8: the old wrapper parked a
+    thread forever per hang with no cap or metric)."""
+    import threading
+    import time
+    from distributedtraining_tpu.utils import timeout as to
+
+    # other tests in this session park their own workers (a 10s sleeper
+    # in test_run_with_timeout, the wedged-sync fake) -- measure relative
+    # to the live count at entry, which can only shrink on its own
+    baseline = to.abandoned_workers()
+    start_total = to.abandoned_total()
+    events = []
+    for _ in range(5):
+        ev = threading.Event()
+        events.append(ev)
+        with pytest.raises(ChainTimeout):
+            # the worker parks on the event (stand-in for a dead socket);
+            # on_timeout "kills the connection" by setting it
+            run_with_timeout(ev.wait, 0.05, name="hang",
+                             on_timeout=ev.set)
+    assert to.abandoned_total() - start_total == 5  # every hang counted
+    # all five workers were unblocked by the hook -> the live-abandoned
+    # gauge drains back to the entry level instead of accumulating
+    deadline = time.time() + 5.0
+    while to.abandoned_workers() > baseline and time.time() < deadline:
+        time.sleep(0.02)
+    assert to.abandoned_workers() <= baseline
+
+    # without a hook the worker genuinely leaks -- and the gauge says so
+    ev = threading.Event()
+    with pytest.raises(ChainTimeout):
+        run_with_timeout(ev.wait, 0.05, name="hang-noresc")
+    assert to.abandoned_total() == start_total + 6
+    leaked = [t for t in threading.enumerate()
+              if t.name == "timeout-hang-noresc"]
+    assert leaked and leaked[0].is_alive()
+    ev.set()  # clean up for other tests
+    leaked[0].join(timeout=5.0)
+    assert not leaked[0].is_alive()
+
+
 def test_bittensor_chain_weight_pipeline_screens_anomalies():
     """BittensorChain.set_weights runs the same EMA->MAD->normalize->u16
     pipeline as LocalChain, without needing the SDK (faked subtensor)."""
