@@ -67,46 +67,35 @@ def _close_connection(obj) -> None:
 class BittensorAddressStore:
     """Chain commitments as the hotkey -> repo registry.
 
-    ``subtensor`` may be the object itself or a zero-arg supplier. The
-    role wiring passes ``lambda: chain.subtensor`` plus the chain's
-    recycle hook so store and chain always share ONE live connection —
-    a fixed reference would go permanently stale the first time the
-    chain recycles its wedged subtensor out from under it."""
+    ``rpc`` (optional) is a deadline-wrapped executor with signature
+    ``rpc(name, fn) -> fn(subtensor)`` — the role wiring passes
+    ``chain._rpc`` so store and chain share one live connection AND one
+    recycle discipline (per-call connection capture; a wedged connection
+    is killed and lazily replaced). Without ``rpc`` (legacy fixed
+    ``subtensor``), ops still run under the deadline but the connection
+    is never closed on timeout: there is no reconstruction machinery
+    here, and killing the only connection would turn one transient stall
+    into a permanently broken store."""
 
-    def __init__(self, subtensor, netuid: int, wallet=None, *,
-                 on_timeout=None):
-        self._subtensor = subtensor
+    def __init__(self, subtensor, netuid: int, wallet=None, *, rpc=None):
+        self.subtensor = subtensor
         self.netuid = netuid
         self.wallet = wallet
-        self._recycle = on_timeout
+        self._rpc = rpc if rpc is not None else self._plain_rpc
 
-    @property
-    def subtensor(self):
-        return self._subtensor() if callable(self._subtensor) \
-            else self._subtensor
-
-    def _on_timeout(self) -> None:
-        if self._recycle is not None:
-            self._recycle()  # shared-connection owner kills AND replaces
-        # Without a recycle path (legacy fixed-subtensor construction),
-        # leave the connection alone: closing it would unpark the worker
-        # but permanently break every later op — there is no
-        # reconstruction machinery here. The abandoned worker is
-        # accounted by utils/timeout.py either way.
+    def _plain_rpc(self, name, fn):
+        return run_with_timeout(lambda: fn(self.subtensor),
+                                CHAIN_OP_TIMEOUT, name=name)
 
     def store_repo(self, hotkey: str, repo_id: str) -> None:
-        def op():
-            self.subtensor.commit(self.wallet, self.netuid, repo_id)
-        run_with_timeout(op, CHAIN_OP_TIMEOUT, name="store_repo",
-                         on_timeout=self._on_timeout)
+        self._rpc("store_repo",
+                  lambda sub: sub.commit(self.wallet, self.netuid, repo_id))
 
     def retrieve_repo(self, hotkey: str) -> Optional[str]:
-        def op():
-            meta = self.subtensor.get_commitment(self.netuid, hotkey)
-            return meta or None
         try:
-            return run_with_timeout(op, CHAIN_OP_TIMEOUT, name="retrieve_repo",
-                                    on_timeout=self._on_timeout)
+            return self._rpc(
+                "retrieve_repo",
+                lambda sub: sub.get_commitment(self.netuid, hotkey) or None)
         except ChainTimeout:
             return None
 
@@ -160,17 +149,39 @@ class BittensorChain:
     def my_hotkey(self) -> str:
         return self.wallet.hotkey.ss58_address
 
-    def _recycle_connection(self) -> None:
-        """On an RPC deadline: kill the wedged connection (unparking the
-        abandoned worker — see utils/timeout.py) and mark it for lazy
-        reconstruction. The reconnect happens INSIDE the next
-        deadline-wrapped op (_ensure_connected) — reconstructing here on
-        the caller thread could block unboundedly on the same dead
-        endpoint, which is exactly what run_with_timeout exists to
-        prevent. The reference gets both effects by killing its forked
-        child (chain_manager.py:36-46)."""
-        _close_connection(self.subtensor)
-        self._needs_reconnect = True
+    def _rpc(self, name, fn):
+        """Run ``fn(subtensor)`` under the RPC deadline with per-call
+        connection capture. On timeout, ONLY the connection this call was
+        actually using is killed (unparking its abandoned worker — see
+        utils/timeout.py) and, if it is still the current one, marked for
+        lazy reconstruction; a late-firing deadline can never shoot down
+        a healthy replacement another caller already installed. The
+        reconnect itself happens INSIDE the next call's deadline
+        (_ensure_connected) — reconstructing on the caller thread could
+        block unboundedly on the same dead endpoint, which is exactly
+        what run_with_timeout exists to prevent. The reference gets the
+        same semantics by killing its forked child per call
+        (chain_manager.py:36-46)."""
+        used = {}
+
+        def op():
+            sub = self._ensure_connected()
+            used["conn"] = sub
+            return fn(sub)
+
+        def on_timeout():
+            conn = used.get("conn")
+            if conn is None:
+                # hung inside the reconnect itself: nothing to close; the
+                # stale flag is still set, so the next call retries
+                return
+            _close_connection(conn)
+            with _RECONNECT_LOCK:
+                if conn is self.subtensor:
+                    self._needs_reconnect = True
+
+        return run_with_timeout(op, CHAIN_OP_TIMEOUT, name=name,
+                                on_timeout=on_timeout)
 
     def _ensure_connected(self):
         """Current subtensor, reconnecting first when the last one was
@@ -202,21 +213,17 @@ class BittensorChain:
                 and block - self._last_sync_block < self.resync_blocks):
             m = self.metagraph  # cached within the resync window
         else:
-            def op():
-                self.metagraph.sync(subtensor=self._ensure_connected(),
-                                    lite=True)
+            def op(sub):
+                self.metagraph.sync(subtensor=sub, lite=True)
                 return self.metagraph
-            m = run_with_timeout(op, CHAIN_OP_TIMEOUT, name="metagraph_sync",
-                                 on_timeout=self._recycle_connection)
+            m = self._rpc("metagraph_sync", op)
             self._last_sync_block = block
         return Metagraph(hotkeys=list(m.hotkeys), uids=list(range(len(m.hotkeys))),
                          stakes=[float(s) for s in m.S],
                          block=block)
 
     def current_block(self) -> int:
-        return int(run_with_timeout(lambda: self._ensure_connected().block,
-                                    CHAIN_OP_TIMEOUT, name="block",
-                                    on_timeout=self._recycle_connection))
+        return int(self._rpc("block", lambda sub: sub.block))
 
     def should_set_weights(self) -> bool:
         return (self.current_block() - self._last_weight_block) >= self.epoch_length
@@ -233,14 +240,11 @@ class BittensorChain:
         btt_connector.py:99-260). This framework's artifact plane is HF/
         LocalFS rather than axon RPC, but participants that also expose an
         endpoint (e.g. the peer registry) can publish it the reference way."""
-        def op():
+        def op(sub):
             axon = self.bt.axon(wallet=self.wallet, ip=ip, port=port)
-            return bool(self._ensure_connected().serve_axon(netuid=self.netuid,
-                                                  axon=axon))
+            return bool(sub.serve_axon(netuid=self.netuid, axon=axon))
         try:
-            return bool(run_with_timeout(op, CHAIN_OP_TIMEOUT,
-                                         name="serve_axon",
-                                         on_timeout=self._recycle_connection))
+            return bool(self._rpc("serve_axon", op))
         except ChainTimeout:
             return False
 
@@ -258,13 +262,12 @@ class BittensorChain:
         uids = [i for i, h in enumerate(hotkeys) if h in norm]
         weights = quantize_u16([norm[hotkeys[u]] for u in uids])
 
-        def op():
-            return self._ensure_connected().set_weights(
+        def op(sub):
+            return sub.set_weights(
                 wallet=self.wallet, netuid=self.netuid, uids=uids,
                 weights=weights, version_key=spec_version(),
                 wait_for_inclusion=False)
-        ok = bool(run_with_timeout(op, CHAIN_OP_TIMEOUT, name="set_weights",
-                                   on_timeout=self._recycle_connection))
+        ok = bool(self._rpc("set_weights", op))
         if ok:
             self._last_weight_block = self.current_block()
         return ok

@@ -235,9 +235,25 @@ class TrainEngine:
                 from ..ops.ring_attention import set_ring_mesh
                 set_ring_mesh(mesh)
 
-        task_loss = loss_fn or _default_lm_loss
+        base_task_loss = loss_fn or _default_lm_loss
+        if mesh is not None:
+            import flax.linen as nn
+
+            from ..parallel.sharding import DEFAULT_RULES
+
+            def task_loss(model_, params, batch, _inner=base_task_loss):
+                # trace with the mesh + logical-axis rules ambient so
+                # in-model activation constraints
+                # (nn.with_logical_constraint, models/gpt2.py) and the
+                # mesh-aware embed backward (ops/embed.py) engage; inert
+                # no-ops without a mesh
+                with self.mesh, nn.logical_axis_rules(DEFAULT_RULES):
+                    return _inner(model_, params, batch)
+        else:
+            task_loss = base_task_loss
         # resolved model-level loss — subclasses (LoRAEngine) reuse this so
-        # fused/custom-loss resolution lives in exactly one place
+        # fused/custom-loss resolution AND the mesh/rules activation live
+        # in exactly one place
         self._task_loss = task_loss
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")

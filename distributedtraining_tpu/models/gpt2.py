@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.attention import causal_attention
+from ..ops.embed import embed_lookup
 
 
 def pad_vocab(n: int, multiple: int) -> int:
@@ -187,9 +188,27 @@ class GPT2(nn.Module):
                                          (None, "embed")),
             (cfg.n_positions, cfg.n_embd), cfg.storage_dtype())
 
+        # embed_lookup (ops/embed.py): gather forward everywhere; on
+        # dp x fsdp meshes the backward switches to the one-hot einsum so
+        # the cotangent never pays GSPMD's involuntary full
+        # rematerialization resharding onto the table's fsdp axis.
+        # Positions index with the 1-D arange (NOT [None, :]): a
+        # [1, T, E] intermediate would carry a degenerately batch-sharded
+        # size-1 axis. [T, E] broadcasts identically and stays replicated.
         if position_ids is None:
-            position_ids = jnp.arange(T)[None, :]
-        x = wte[input_ids] + wpe[position_ids]
+            x = embed_lookup(wte, input_ids) + embed_lookup(
+                wpe, jnp.arange(T))
+        else:
+            x = embed_lookup(wte, input_ids) + embed_lookup(
+                wpe, position_ids)
+        # pin the embedding output (and, critically, its COTANGENT — the
+        # constraint applies to both) to batch sharding: on hybrid
+        # (dcn_dp) meshes the partitioner otherwise reshards dx onto the
+        # embed/fsdp axis for the wte/wpe scatter backward, a transfer
+        # that is inexpressible on the hybrid device order and falls back
+        # to involuntary full rematerialization. No-op without ambient
+        # logical_axis_rules (single-device paths).
+        x = nn.with_logical_constraint(x, ("batch", None, None))
         x = x.astype(cfg.compute_dtype())
         if cfg.dropout > 0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
@@ -225,9 +244,16 @@ class GPT2(nn.Module):
                          name="ln_f")(x)
         if return_hidden:
             return x
-        # tied lm head: logits accumulate fp32 on the MXU
+        # tied lm head: logits accumulate fp32 on the MXU. The logical
+        # constraint pins logits to batch x vocab(tp) sharding so the
+        # partitioner all-gathers the (small) head over fsdp rather than
+        # resharding the [B, T, E] hidden states onto the embed axis — on
+        # hybrid (dcn_dp) meshes that reshard is inexpressible and falls
+        # back to involuntary full rematerialization. No-op without an
+        # ambient logical_axis_rules context (single-device paths).
         logits = jnp.einsum("bte,ve->btv", x, wte.astype(cfg.compute_dtype()),
                             preferred_element_type=jnp.float32)
+        logits = nn.with_logical_constraint(logits, ("batch", None, "vocab"))
         # the astype fuses into the matmul epilogue, so "bfloat16" means the
         # stored buffer (not the accumulation) shrinks
         return logits.astype(jnp.dtype(cfg.logits_dtype))

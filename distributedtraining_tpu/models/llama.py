@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import causal_attention
+from ..ops.embed import embed_lookup
 from .gpt2 import pad_vocab
 
 
@@ -173,7 +174,9 @@ class Llama(nn.Module):
             (cfg.padded_vocab, cfg.n_embd), cfg.storage_dtype())
         if position_ids is None:
             position_ids = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-        x = wte[input_ids].astype(cfg.compute_dtype())
+        # mesh-aware backward: see ops/embed.py (dp x fsdp meshes would
+        # otherwise fully rematerialize the cotangent in the wte scatter)
+        x = embed_lookup(wte, input_ids).astype(cfg.compute_dtype())
 
         if cfg.scan_blocks:
             scan = nn.scan(

@@ -188,13 +188,12 @@ def build(cfg: RunConfig) -> Components:
                                epoch_length=cfg.epoch_length,
                                resync_blocks=cfg.resync_blocks,
                                vpermit_stake_limit=cfg.vpermit_stake_limit)
-        # the supplier is called INSIDE the store's deadline-wrapped ops,
-        # so a reconnect after a recycle is itself bounded by the RPC
-        # deadline; the shared on_timeout keeps store and chain recycling
-        # the same connection instead of desynchronizing
+        # chain._rpc carries the deadline + per-call connection capture +
+        # lazy-recycle discipline; injecting it keeps store and chain on
+        # ONE live connection instead of desynchronizing after a recycle
         address_store = BittensorAddressStore(
-            chain._ensure_connected, cfg.netuid, wallet=chain.wallet,
-            on_timeout=chain._recycle_connection)
+            chain.subtensor, cfg.netuid, wallet=chain.wallet,
+            rpc=chain._rpc)
     else:
         if cfg.backend == "hf":
             # deltas would flow through the Hub while scores stay in a

@@ -27,8 +27,10 @@ trap 'exit 129' INT TERM
 
 say "watcher start (round=$ROUND period=${PERIOD}s)"
 while true; do
+  # the probe is itself a TPU client: hold the flag across it, and drop
+  # it before sleeping when the probe fails
+  echo "$$" > "$BUSY"
   if scripts/measure.sh probe >>"$LOG" 2>&1; then
-    echo "$$" > "$BUSY"
     say "probe OK — running bench"
     if scripts/measure.sh bench "$ROUND" >/tmp/bench_${ROUND}_raw.log 2>&1; then
       say "bench OK"
@@ -47,6 +49,7 @@ while true; do
       exit 1
     fi
   fi
+  rm -f "$BUSY"
   say "probe failed; sleeping ${PERIOD}s"
   sleep "$PERIOD"
 done

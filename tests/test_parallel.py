@@ -289,3 +289,30 @@ def test_parameterized_mesh_merge_lowers_to_allreduce(devices):
     txt_host = mixture_host.lower(
         w, base, host_stack).compile().as_text()
     assert "all-reduce" not in txt_host
+
+
+def test_embed_lookup_matmul_backward(devices):
+    """On dp x fsdp meshes the embedding backward takes the one-hot
+    einsum spelling (no GSPMD involuntary-remat reshard of the cotangent
+    — see ops/embed.py); gradients must equal the scatter spelling
+    exactly, including duplicate-id accumulation, and routing must stay
+    on the plain gather without an ambient dp x fsdp mesh."""
+    from distributedtraining_tpu.ops import embed
+
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, 64, (4, 8)), jnp.int32)
+    ids = ids.at[0, 0].set(ids[0, 1])  # force a duplicate (accumulation)
+    ct = jnp.asarray(rng.normal(size=(4, 8, 16)), jnp.float32)
+
+    assert not embed._ambient_mesh_needs_matmul_bwd()
+    with make_mesh(MeshConfig(dp=2, fsdp=2, tp=2)):
+        assert embed._ambient_mesh_needs_matmul_bwd()
+    with make_mesh(MeshConfig(dp=8)):
+        assert not embed._ambient_mesh_needs_matmul_bwd()
+
+    take = embed._take_matmul_bwd(64, "float32")
+    g_ref = jax.grad(lambda t: (jnp.take(t, ids, axis=0) * ct).sum())(table)
+    g_new = jax.grad(lambda t: (take(t, ids) * ct).sum())(table)
+    np.testing.assert_allclose(np.asarray(g_new), np.asarray(g_ref),
+                               rtol=1e-6, atol=1e-6)
