@@ -85,6 +85,7 @@ class RunConfig:
     lora_rank: int = 0                       # >0: LoRA-delta mode (config 4)
     lora_alpha: float = 16.0
     dataset: str = "auto"                    # auto | wikitext | synthetic
+    n_docs: int = 256                        # corpus cap fed to text_corpus
     tokenizer: str = "auto"                  # auto | byte | <hf name>
     fused_loss: bool = False                 # tiled-head CE (no [B,T,V] logits)
     scan_blocks: bool = False                # lax.scan the block stack
@@ -106,6 +107,14 @@ class RunConfig:
     # -- cadences (seconds) -------------------------------------------------
     send_interval: float = 800.0             # miner.py:125
     check_update_interval: float = 300.0
+    # miner self-validation guard: the miner scores its own candidate on
+    # the held-out shard every ``self_eval_interval`` seconds and reverts
+    # to its best-seen state after ``self_eval_patience`` non-improving
+    # evals (engine/train.py MinerLoop._val_guard). -1 = follow
+    # send_interval (default on); 0 disables (reference-parity blind
+    # training, training_manager.py:380-392)
+    self_eval_interval: float = -1.0
+    self_eval_patience: int = 3
     checkpoint_interval: float = 600.0       # 0 disables local checkpointing
     checkpoint_dir: Optional[str] = None     # default: <work_dir>/checkpoints/<hotkey>
     validation_interval: float = 1800.0      # validator.py:112
@@ -121,6 +130,7 @@ class RunConfig:
     genetic_sigma: float = 0.1
     genetic_screen_batches: int = 2          # 0 = full-set fitness
     meta_lr: float = 0.01
+    meta_optimizer: str = "adam"             # adam | sgd (ref spelling)
     outer_momentum: float = 0.0              # >0 wraps strategy in OuterOptMerge
     outer_lr: float = 0.7                    # DiLoCo-style outer Nesterov step
 
@@ -280,6 +290,9 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="auto | wikitext | synthetic | files:<glob> (local "
                         "text files as the corpus; real data with zero "
                         "egress)")
+    g.add_argument("--n-docs", dest="n_docs", type=int, default=d.n_docs,
+                   help="document cap for the corpus loader (train split; "
+                        "runway for long soaks)")
     g.add_argument("--tokenizer", default=d.tokenizer,
                    help="auto | byte | word (corpus-fit word vocab, "
                         "deterministic per corpus) | bpe (byte-level BPE "
@@ -369,6 +382,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g = p.add_argument_group("cadence")
     g.add_argument("--send-interval", dest="send_interval", type=float,
                    default=d.send_interval)
+    g.add_argument("--self-eval-interval", dest="self_eval_interval",
+                   type=float, default=d.self_eval_interval,
+                   help="miner self-validation cadence in seconds; -1 = "
+                        "follow --send-interval, 0 = disable the guard")
+    g.add_argument("--self-eval-patience", dest="self_eval_patience",
+                   type=int, default=d.self_eval_patience)
     if role == "miner":  # only the miner wires a CheckpointStore today
         g.add_argument("--checkpoint-interval", dest="checkpoint_interval",
                        type=float, default=d.checkpoint_interval,
@@ -404,6 +423,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        default=d.outer_lr)
         g.add_argument("--meta-lr", dest="meta_lr", type=float,
                        default=d.meta_lr)
+        g.add_argument("--meta-optimizer", dest="meta_optimizer",
+                       choices=("adam", "sgd"), default=d.meta_optimizer,
+                       help="meta-learning optimizer for the merge "
+                            "weights; sgd is the reference's spelling, "
+                            "adam actually separates the weights")
         g.add_argument("--genetic-population", dest="genetic_population",
                        type=int, default=d.genetic_population)
         g.add_argument("--genetic-generations", dest="genetic_generations",

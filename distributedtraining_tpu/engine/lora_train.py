@@ -109,8 +109,13 @@ class LoRAEngine(TrainEngine):
         return self.place_adapters(opt_state)
 
     def init_state(self, rng: jax.Array, base) -> TrainState:
-        lp = self.place_adapters(
+        return self.init_state_from(
             lora_lib.init_lora(rng, base, self.lora_cfg))
+
+    def init_state_from(self, adapters) -> TrainState:
+        """Fresh train state over an EXISTING adapter tree (val-guard
+        reverts, checkpoint-less warm starts)."""
+        lp = self.place_adapters(adapters)
         return TrainState(step=self.place_step(0), params=lp,
                           opt_state=jax.jit(self.tx.init)(lp))
 
@@ -197,7 +202,28 @@ class LoRAMinerLoop(MinerLoop):
         self.state = self.engine.init_state(self._rng, self.base_params)
         self._base_revision = rev
         self._last_base_time = self.clock.now()
+        self._reset_val_guard()
         self.report.base_pulls += 1
+
+    # -- self-validation guard (hooks; see MinerLoop._val_guard) ------------
+    def _guard_eval(self) -> float:
+        """Candidate = frozen base + current adapters: the 3-arg LoRA
+        eval_step already computes exactly that without materializing
+        full params."""
+        total = count = None
+        for b in self.val_batches():
+            l, c = self.engine.eval_step(self.state.params, self.base_params,
+                                         self.engine.place_batch(b))
+            total = l if total is None else total + l
+            count = c if count is None else count + c
+        if count is None or float(count) == 0:
+            return float("nan")
+        return float(total) / float(count)
+
+    def _guard_revert(self) -> None:
+        from .train import _snapshot
+        self.state = self.engine.init_state_from(
+            _snapshot(self._best_params))
 
     # -- the artifact -------------------------------------------------------
     def _push_delta(self) -> None:

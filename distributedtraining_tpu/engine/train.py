@@ -195,25 +195,22 @@ class TrainEngine:
                                  "expected True, 'auto', 'pallas' or 'scan'")
             loss_mesh = None
             if mesh is not None:
-                # pallas_call is not auto-partitionable under pjit.
-                # Explicit "pallas" on a mesh takes the shard_map spelling
+                # EVERY fused impl takes the shard_map spelling on a mesh
                 # (ops/pallas_ce.fused_ce_loss_sharded: rows split across
                 # dp/fsdp/sp AND tp, head all-gathered per device, totals
                 # psummed — the label shift rides the global labels array,
-                # so sp/ring-attention meshes compose too);
-                # "auto"/True stays on the lax.scan spelling, which GSPMD
-                # partitions without manual collectives.
-                if impl == "pallas":
-                    if any(mesh.shape.get(a, 1) > 1
-                           for a in mesh.axis_names
-                           if a not in ("dp", "fsdp", "tp", "sp")):
-                        raise ValueError(
-                            "fused_loss='pallas' composes with "
-                            "dp/fsdp/tp/sp meshes; for other axes use "
-                            "fused_loss=True/'scan'")
-                    loss_mesh = mesh
-                else:
-                    impl = "scan"
+                # so sp/ring-attention meshes compose too). The inner tile
+                # engine is pallas (TPU kernels) or the portable lax scan;
+                # "auto" resolves per backend. Leaving the scan spelling
+                # to GSPMD instead re-materializes full-vocab buffers at
+                # 8B scale (measured, scripts/scale_aot.py).
+                if any(mesh.shape.get(a, 1) > 1
+                       for a in mesh.axis_names
+                       if a not in ("dp", "fsdp", "tp", "sp")):
+                    raise ValueError(
+                        "fused_loss composes with dp/fsdp/tp/sp meshes "
+                        "only; run other axes unfused")
+                loss_mesh = mesh
             loss_fn = functools.partial(_fused_lm_loss, impl=impl,
                                         mesh=loss_mesh)
         self.model = model
@@ -664,6 +661,7 @@ class MinerReport:
     steps: int = 0
     pushes: int = 0
     base_pulls: int = 0
+    val_reverts: int = 0
     last_loss: float = float("nan")
 
 
@@ -682,6 +680,9 @@ class MinerLoop:
                  delta_density: float = 1.0 / 64.0,   # sparse8 top-k density
                  checkpoint_store=None,
                  checkpoint_interval: float = 600.0,
+                 val_batches=None,
+                 val_guard_interval: float | None = None,
+                 val_guard_patience: int = 3,
                  trace=None):
         self.engine = engine
         self.transport = transport
@@ -729,6 +730,32 @@ class MinerLoop:
                                            decide=decide)
         self._push_action = PeriodicAction(send_interval, self._push_delta,
                                            self.clock, decide=decide)
+        # Self-validation guard (round-5 soak finding): a miner training
+        # blind on a saturated task compounds an OVERFIT cumulative delta
+        # against a frozen base — its train loss falls while every merge
+        # candidate degrades, and the publish guard (correctly) freezes
+        # the subnet. With ``val_batches`` the miner periodically scores
+        # its own candidate on held-out data, keeps the best-seen params,
+        # and after ``val_guard_patience`` consecutive non-improving
+        # evals REVERTS to the best state (fresh optimizer — the same
+        # semantics as a base pull). The published delta then tracks the
+        # miner's best-known state within one eval interval instead of
+        # drifting unboundedly. The reference trains blind
+        # (training_manager.py:380-392 has no eval in the miner loop).
+        self.val_batches = val_batches
+        self.val_guard_patience = val_guard_patience
+        self._best_val: float | None = None
+        self._best_params: Params | None = None
+        self._val_strikes = 0
+        self._val_guard_action = None
+        if val_batches is not None:
+            if val_guard_patience < 1:
+                raise ValueError(f"val_guard_patience must be >= 1, "
+                                 f"got {val_guard_patience}")
+            self._val_guard_action = PeriodicAction(
+                val_guard_interval if val_guard_interval is not None
+                else send_interval,
+                self._val_guard, self.clock, decide=decide)
         self._last_ckpt_key = None
         self._ckpt_action = None
         if checkpoint_store is not None and self._multi():
@@ -824,7 +851,57 @@ class MinerLoop:
         self.base_params = _snapshot(self.state.params)
         self._base_revision = rev
         self._last_base_time = self.clock.now()
+        self._reset_val_guard()
         self.report.base_pulls += 1
+
+    def _reset_val_guard(self) -> None:
+        """New base => fresh tracking (the old best was relative to the
+        superseded base)."""
+        self._best_val = None
+        self._best_params = None
+        self._val_strikes = 0
+
+    def _guard_eval(self) -> float:
+        """Held-out loss of the current candidate (hook: LoRAMinerLoop
+        evaluates adapters against the frozen base instead)."""
+        loss, _ = self.engine.evaluate(self.state.params, self.val_batches())
+        return loss
+
+    def _guard_revert(self) -> None:
+        """Rebuild the train state from the best-seen params with a fresh
+        optimizer — the same semantics as a base pull."""
+        self.state = self.engine.init_state(
+            params=_snapshot(self._best_params))
+
+    def _val_guard(self) -> None:
+        if self.state is None or self.val_batches is None:
+            return
+        import math
+        loss = self._guard_eval()
+        if not math.isfinite(loss):
+            logger.warning("miner %s: self-eval non-finite, ignoring",
+                           self.miner_id)
+            return
+        if self._best_val is None or loss < self._best_val:
+            self._best_val = loss
+            self._best_params = _snapshot(self.state.params)
+            self._val_strikes = 0
+        else:
+            self._val_strikes += 1
+            if (self._val_strikes >= self.val_guard_patience
+                    and self._best_params is not None):
+                logger.info(
+                    "miner %s: val loss %.4f has not beaten %.4f for %d "
+                    "evals — reverting to best state (fresh optimizer)",
+                    self.miner_id, loss, self._best_val, self._val_strikes)
+                self._guard_revert()
+                self._val_strikes = 0
+                self.report.val_reverts += 1
+        if self.metrics:
+            self.metrics.log({"self_eval_loss": loss,
+                              "self_eval_best": self._best_val,
+                              "val_reverts": self.report.val_reverts},
+                             step=self.report.steps)
 
     def _wire_template(self):
         if self._wire_template_cache is None:
@@ -1062,6 +1139,10 @@ class MinerLoop:
                          "staleness_s": self.clock.now() - self._last_base_time,
                          **device_metrics()},
                         step=self.report.steps)
+                if self._val_guard_action is not None:
+                    # before push: a revert must land before publishing, so
+                    # the pushed delta is never the known-degraded state
+                    self._val_guard_action.poll()
                 self._push_action.poll()
                 if self._ckpt_action is not None:
                     self._ckpt_action.poll()

@@ -38,7 +38,11 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     remat: bool = True
-    attention_impl: str = "dense"
+    # flash = Pallas kernel on TPU; declining backends fall back to the
+    # blockwise lax spelling at long T (ops/attention.py), so no path
+    # materializes [T, T] scores. GQA kv heads are broadcast to query
+    # heads before the call either way.
+    attention_impl: str = "flash"
     vocab_multiple: int = 128
     # lax.scan over the block stack (see gpt2.GPT2Config.scan_blocks): at
     # 32-80 layers this is the difference between minutes and seconds of
@@ -139,7 +143,13 @@ class LlamaBlock(nn.Module):
         gate = _dense(cfg.intermediate_size, "w_gate", ("embed", "mlp"), cfg)(h)
         up = _dense(cfg.intermediate_size, "w_up", ("embed", "mlp"), cfg)(h)
         down = _dense(E, "w_down", ("mlp", "embed"), cfg)(nn.silu(gate) * up)
-        return x + down
+        # pin the residual stream to batch sharding at the block boundary:
+        # with fsdp-sharded params GSPMD otherwise reshards activations
+        # off the batch axis (B-fold activation blowup at 8B/seq 8k);
+        # the pin forces the ZeRO-3 strategy — params all-gather, batch
+        # stays sharded. No-op without ambient logical_axis_rules.
+        return nn.with_logical_constraint(x + down,
+                                          ("batch", "seq", None))
 
 
 class _BlockScan(nn.Module):
@@ -177,6 +187,7 @@ class Llama(nn.Module):
         # mesh-aware backward: see ops/embed.py (dp x fsdp meshes would
         # otherwise fully rematerialize the cotangent in the wte scatter)
         x = embed_lookup(wte, input_ids).astype(cfg.compute_dtype())
+        x = nn.with_logical_constraint(x, ("batch", "seq", None))
 
         if cfg.scan_blocks:
             scan = nn.scan(
@@ -196,6 +207,7 @@ class Llama(nn.Module):
                 x = block(cfg, name=f"layer_{i}")(x, attention_mask,
                                                   segment_ids, position_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.param_dtype, name="final_norm")(x)
+        x = nn.with_logical_constraint(x, ("batch", "seq", None))
         if return_hidden:
             return x
         lm_head = self.param(
@@ -205,6 +217,8 @@ class Llama(nn.Module):
             (cfg.padded_vocab, cfg.n_embd), cfg.storage_dtype())
         logits = jnp.einsum("bte,ve->btv", x, lm_head.astype(cfg.compute_dtype()),
                             preferred_element_type=jnp.float32)
+        # same pin as gpt2: head all-gathers over fsdp, hidden stays put
+        logits = nn.with_logical_constraint(logits, ("batch", None, "vocab"))
         return logits.astype(jnp.dtype(cfg.logits_dtype))
 
     def init_params(self, rng, *, seq_len: int = 8):

@@ -78,6 +78,132 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out
 
 
+# dense materializes [B, H, T, T] scores; above this length a declined
+# flash kernel falls back to the blockwise spelling instead, whose temp
+# memory is O(B*H*bq*bk) — the same profile as the Pallas kernel
+BLOCKWISE_FALLBACK_LEN = 1024
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        *,
+                        attention_mask: Optional[jax.Array] = None,
+                        segment_ids: Optional[jax.Array] = None,
+                        block_q: int = 512,
+                        block_kv: int = 512) -> jax.Array:
+    """Causal attention as a double lax.scan over query/key blocks with an
+    online softmax — the FlashAttention algorithm in portable lax (same
+    streaming math as ring_attention._ring_body, but blocks come from a
+    local reshape instead of an ICI ring).
+
+    No [T, T] score matrix ever exists: peak temp is one [B, H, bq, bkv]
+    tile, and ``jax.checkpoint`` on the inner step keeps the backward at
+    the same profile (tiles recompute instead of being stashed per
+    block). This is the memory-honest fallback when the Pallas flash
+    kernel declines (CPU backends, odd shapes) and the spelling the AOT
+    scale artifacts compile so their XLA memory analysis reflects the
+    flash-kernel profile rather than a dense [T, T] blowup the TPU never
+    pays. Masking matches combine_masks: causal + optional key padding
+    mask + optional segment equality (packed sequences).
+    """
+    B, T, H, D = q.shape
+    bq, bkv = min(block_q, T), min(block_kv, T)
+    pad_q = (-T) % bq
+    pad_kv = (-T) % bkv
+    nq, nkv = (T + pad_q) // bq, (T + pad_kv) // bkv
+    scale = D ** -0.5
+
+    qf = (q.astype(jnp.float32) * scale)
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # key validity: padding-mask AND in-bounds (scan blocks are static)
+    kvalid = jnp.ones((B, T), bool) if attention_mask is None \
+        else attention_mask.astype(bool)
+    if pad_kv:
+        kf = jnp.pad(kf, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
+        kvalid = jnp.pad(kvalid, ((0, 0), (0, pad_kv)))
+    seg = segment_ids
+    if seg is not None:
+        qseg = jnp.pad(seg, ((0, 0), (0, pad_q)), constant_values=-1)
+        kseg = jnp.pad(seg, ((0, 0), (0, pad_kv)), constant_values=-2)
+        qseg = qseg.reshape(B, nq, bq).transpose(1, 0, 2)    # [nq, B, bq]
+        kseg = kseg.reshape(B, nkv, bkv).transpose(1, 0, 2)  # [nkv, B, bkv]
+    qb = qf.reshape(B, nq, bq, H, D).transpose(1, 0, 2, 3, 4)
+    kb = kf.reshape(B, nkv, bkv, H, D).transpose(1, 0, 2, 3, 4)
+    vb = vf.reshape(B, nkv, bkv, H, D).transpose(1, 0, 2, 3, 4)
+    kvalid_b = kvalid.reshape(B, nkv, bkv).transpose(1, 0, 2)  # [nkv, B, bkv]
+
+    def kv_tile_update(qi, q_tile, q_seg_tile, carry, kv):
+        acc, m_prev, l_prev = carry
+        ki, k_tile, v_tile, kv_ok, k_seg_tile = kv
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q_tile, k_tile)
+        q_pos = qi * bq + jnp.arange(bq)
+        k_pos = ki * bkv + jnp.arange(bkv)
+        mask = q_pos[:, None] >= k_pos[None, :]          # causal
+        mask = mask[None, :, :] & kv_ok[:, None, :]      # key padding
+        if q_seg_tile is not None:
+            mask = mask & (q_seg_tile[:, :, None] == k_seg_tile[:, None, :])
+        scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new[..., None])
+        # exp(NEG_INF - m) underflows to 0 for any real m, but a FULLY
+        # masked running max (m_new == NEG_INF) would turn masked entries
+        # into exp(0) = 1 — zero them explicitly so dead rows (no visible
+        # key after causal+padding+segment masking) emit exact 0, the
+        # flash-kernel convention
+        p = p * mask[:, None, :, :]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_tile)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+        return acc, m_new, l_new
+
+    def kv_step(qi, q_tile, q_seg_tile, carry, kv):
+        # skip causally-dead blocks (every key strictly in the future of
+        # every query of this tile): about half the tiles at long T. The
+        # predicate is a per-iteration scalar, so lax.cond executes only
+        # one branch instead of lowering to a select
+        ki = kv[0]
+        dead = ki * bkv > qi * bq + (bq - 1)
+        new_carry = jax.lax.cond(
+            dead, lambda c, _kv: c,
+            lambda c, kv_: kv_tile_update(qi, q_tile, q_seg_tile, c, kv_),
+            carry, kv)
+        return new_carry, None
+
+    kv_step = jax.checkpoint(kv_step, static_argnums=())
+
+    def q_step(_, q_in):
+        qi, q_tile, q_seg_tile = q_in
+        acc0 = jnp.zeros((B, bq, H, D), jnp.float32)
+        m0 = jnp.full((B, H, bq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, bq), jnp.float32)
+        kvs = (jnp.arange(nkv), kb, vb, kvalid_b,
+               kseg if seg is not None else jnp.zeros((nkv,)))
+        (acc, m, l), _ = jax.lax.scan(
+            lambda c, kv: kv_step(qi, q_tile, q_seg_tile, c, kv),
+            (acc0, m0, l0), kvs)
+        l = jnp.maximum(l, 1e-30)
+        return None, acc / l.transpose(0, 2, 1)[..., None]
+
+    q_in = (jnp.arange(nq), qb, qseg if seg is not None else jnp.zeros((nq,)))
+
+    def q_step_wrap(c, q_in_):
+        qi, q_tile, q_seg_tile = q_in_
+        return q_step(c, (qi, q_tile,
+                          q_seg_tile if seg is not None else None))
+
+    # checkpoint the WHOLE q block: without it the outer scan stashes
+    # every inner-scan carry for every q block (nq x nkv x [B,bq,H,D]);
+    # with it the backward recomputes one q block's inner scan at a time
+    _, out = jax.lax.scan(jax.checkpoint(q_step_wrap), None, q_in)
+    out = out.transpose(1, 0, 2, 3, 4).reshape(B, T + pad_q, H, D)
+    return out[:, :T].astype(q.dtype)
+
+
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      *,
                      attention_mask: Optional[jax.Array] = None,
@@ -85,9 +211,11 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      impl: str = "dense") -> jax.Array:
     """Causal self-attention entry point used by the models.
 
-    impl: "dense" (XLA), "flash" (Pallas kernel when available, falls back to
-    dense on non-TPU backends), "ring" (sequence-parallel over the sp mesh
-    axis; needs set_ring_mesh and unmasked/unpacked inputs).
+    impl: "dense" (XLA), "flash" (Pallas kernel when available, falls back
+    to blockwise at long T / dense at short T on non-TPU backends),
+    "blockwise" (portable lax flash — O(block^2) temps everywhere), "ring"
+    (sequence-parallel over the sp mesh axis; needs set_ring_mesh and
+    unmasked/unpacked inputs).
     """
     B, T, H, D = q.shape
     if impl == "ring" and attention_mask is None and segment_ids is None:
@@ -96,12 +224,22 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if mesh is not None:
             return ring.ring_attention(q, k, v)
         # no mesh installed -> dense fallback below
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, attention_mask=attention_mask,
+                                   segment_ids=segment_ids)
     if impl == "flash":
         from . import flash_attention
         out = flash_attention.flash_attention(
             q, k, v, attention_mask=attention_mask, segment_ids=segment_ids)
         if out is not None:
             return out
-        # fall through to dense when the kernel declines (e.g. CPU backend)
+        if T >= BLOCKWISE_FALLBACK_LEN:
+            # kernel declined (CPU backend): at long T the dense [T, T]
+            # fallback would blow temp memory the TPU path never pays —
+            # stream blocks instead
+            return blockwise_attention(
+                q, k, v, attention_mask=attention_mask,
+                segment_ids=segment_ids)
+        # short T: dense is faster off-TPU and the temps are tiny
     mask = combine_masks(make_causal_mask(T), attention_mask, segment_ids)
     return dot_product_attention(q, k, v, mask)

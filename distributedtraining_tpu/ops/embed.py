@@ -34,8 +34,14 @@ def _ambient_mesh_needs_matmul_bwd() -> bool:
     """True when the mesh active during tracing has both dp>1 and fsdp>1 —
     the configuration whose gather-backward reshard GSPMD cannot express
     (see module docstring)."""
-    from jax.interpreters import pxla
-    mesh = pxla.thread_resources.env.physical_mesh
+    try:
+        # the `with mesh:` context reader; public spelling
+        # (jax.interpreters.pxla.thread_resources) deprecated in 0.8.2
+        # with no public replacement for the legacy context
+        from jax._src.mesh import thread_resources
+    except ImportError:  # pragma: no cover — older jax
+        from jax.interpreters.pxla import thread_resources
+    mesh = thread_resources.env.physical_mesh
     if mesh.empty:
         return False
     shape = dict(mesh.shape)
@@ -51,7 +57,7 @@ def _take_matmul_bwd(vocab: int, dtype_name: str):
 
     @jax.custom_vjp
     def take(table, ids):
-        return jnp.take(table, ids, axis=0)
+        return jnp.take(table, ids, axis=0, mode="clip")
 
     def fwd(table, ids):
         return take(table, ids), ids
@@ -71,4 +77,9 @@ def embed_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
     mesh. Forward is a gather either way."""
     if _ambient_mesh_needs_matmul_bwd():
         return _take_matmul_bwd(table.shape[0], str(table.dtype))(table, ids)
-    return jnp.take(table, ids, axis=0)
+    # mode="clip" preserves `table[ids]` getitem semantics: jnp.take's
+    # default is "fill", which turns an out-of-range index (e.g. eval at
+    # T > n_positions) into NaN rows instead of the clamped lookup the
+    # indexing spelling always did. (The matmul backward's one-hot zeroes
+    # OOB rows' gradients; OOB positions are a config error either way.)
+    return jnp.take(table, ids, axis=0, mode="clip")

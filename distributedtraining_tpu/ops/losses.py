@@ -84,51 +84,73 @@ def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
     if impl == "auto":
         from .pallas_ce import pallas_ce_available
         impl = "pallas" if pallas_ce_available(hidden, head_kernel) else "scan"
+    if impl not in ("pallas", "scan"):
+        raise ValueError(f"unknown fused-CE impl {impl!r}")
+    if impl == "scan" and interpret is not None:
+        # interpret is a Pallas-only knob; silently dropping it would let
+        # an off-TPU cross-check (impl left at "auto" -> scan) compare
+        # the scan path against itself and prove nothing — same guard on
+        # both the mesh and single-device routes
+        raise ValueError("interpret= applies only to impl='pallas'; "
+                         f"this call resolved to impl={impl!r}")
+    if mesh is not None:
+        # EVERY mesh run goes through the shard_map wrapper: local shapes
+        # keep the vocab tiling intact under partitioning (GSPMD undoes
+        # the plain scan's tiling at scale — full-vocab [N, V] buffers
+        # measured at 8B, scripts/scale_aot.py) and the collectives are
+        # explicit. ``inner`` picks pallas kernels (TPU) or the lax scan.
+        from .pallas_ce import fused_ce_loss_sharded
+        return fused_ce_loss_sharded(hidden, head_kernel, labels,
+                                     loss_mask, mesh=mesh,
+                                     interpret=interpret, inner=impl)
     if impl == "pallas":
         # ``interpret=True`` acknowledges a deliberate off-TPU run (numeric
         # cross-checks); None lets the kernel resolve the backend and warn
         # if that lands it in interpret mode
-        if mesh is not None:
-            from .pallas_ce import fused_ce_loss_sharded
-            return fused_ce_loss_sharded(hidden, head_kernel, labels,
-                                         loss_mask, mesh=mesh,
-                                         interpret=interpret)
         from .pallas_ce import fused_ce_loss
         return fused_ce_loss(hidden, head_kernel, labels, loss_mask,
                              interpret=interpret)
-    if impl != "scan":
-        raise ValueError(f"unknown fused-CE impl {impl!r}")
-    if interpret is not None:
-        # interpret is a Pallas-only knob; silently dropping it here would
-        # let an off-TPU cross-check (impl left at "auto" -> scan) compare
-        # the scan path against itself and prove nothing
-        raise ValueError("interpret= applies only to impl='pallas'; "
-                         f"this call resolved to impl={impl!r}")
-    E = hidden.shape[-1]
-    V = head_kernel.shape[0]
+    h = hidden.reshape(-1, hidden.shape[-1])
+    y = labels.reshape(-1)
+    m = (jnp.ones_like(y, jnp.float32) if loss_mask is None
+         else loss_mask.reshape(-1))
+    total, count = _scan_ce_totals(h, head_kernel, y, m, chunk=chunk)
+    count = jnp.maximum(count, 1.0)
+    return total / count, count
+
+
+def _scan_ce_totals(h: jax.Array, w: jax.Array, y: jax.Array,
+                    m: jax.Array, *, chunk: int = 4096
+                    ) -> tuple[jax.Array, jax.Array]:
+    """(masked total CE, masked token count) of ``h @ w.T`` vs ``y`` by
+    the vocab-tiled online softmax — the lax.scan twin of
+    pallas_ce._fused_ce_totals, shaped for shard_map bodies: everything
+    here is LOCAL (no collectives; the caller psums). h: [N, E], w:
+    [V, E] (already gathered), y/m: [N]. Inside shard_map the shapes XLA
+    sees are per-device, so GSPMD cannot undo the tiling the way it does
+    when this scan is left to the partitioner at 8B scale (the round-5
+    SCALE artifact measured full-vocab [N, V] buffers materializing)."""
+    E = h.shape[-1]
+    V = w.shape[0]
     n_chunks = -(-V // chunk)
     v_pad = n_chunks * chunk
-
-    h = hidden.reshape(-1, E)
-    y = labels.reshape(-1)
-    N = h.shape[0]
-    wt = head_kernel
+    wt = w
     if v_pad > V:
         wt = jnp.concatenate(
             [wt, jnp.zeros((v_pad - V, E), wt.dtype)], axis=0)
-    wt = wt.reshape(n_chunks, chunk, E).astype(hidden.dtype)
-
-    neg = jnp.float32(-1e30)  # effectively -inf without nan hazards
+    wt = wt.reshape(n_chunks, chunk, E).astype(h.dtype)
+    N = h.shape[0]
+    neg = jnp.float32(-1e30)
 
     def tile(carry, xs):
-        m, s, ll = carry
+        mx, s, ll = carry
         idx, w_c = xs
         logits = jnp.einsum("ne,ce->nc", h, w_c,
                             preferred_element_type=jnp.float32)
         col = idx * chunk + jnp.arange(chunk)
         logits = jnp.where(col[None, :] < V, logits, neg)
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-        s = s * jnp.exp(m - m_new) + jnp.sum(
+        m_new = jnp.maximum(mx, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(mx - m_new) + jnp.sum(
             jnp.exp(logits - m_new[:, None]), axis=-1)
         ll = ll + jnp.sum(
             jnp.where(col[None, :] == y[:, None], logits, 0.0), axis=-1)
@@ -137,17 +159,11 @@ def fused_linear_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
     init = (jnp.full((N,), neg, jnp.float32),
             jnp.zeros((N,), jnp.float32),
             jnp.zeros((N,), jnp.float32))
-    (m, s, ll), _ = jax.lax.scan(
+    (mx, s, ll), _ = jax.lax.scan(
         jax.checkpoint(tile), init, (jnp.arange(n_chunks), wt))
-
-    per_tok = (m + jnp.log(s) - ll).reshape(labels.shape)
-    if loss_mask is not None:
-        msk = loss_mask.astype(per_tok.dtype)
-    else:
-        msk = jnp.ones_like(per_tok)
-    total = jnp.sum(per_tok * msk)
-    count = jnp.maximum(jnp.sum(msk), 1.0)
-    return total / count, count
+    per_tok = mx + jnp.log(s) - ll
+    msk = m.astype(per_tok.dtype)
+    return jnp.sum(per_tok * msk), jnp.sum(msk)
 
 
 def classification_loss(logits: jax.Array, labels: jax.Array

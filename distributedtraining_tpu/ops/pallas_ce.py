@@ -382,11 +382,20 @@ def fused_ce_loss_sharded(hidden: jax.Array, head_kernel: jax.Array,
                           labels: jax.Array,
                           loss_mask: Optional[jax.Array] = None,
                           *, mesh, block_n: int = 1024, block_v: int = 512,
-                          interpret: Optional[bool] = None
+                          interpret: Optional[bool] = None,
+                          inner: str = "pallas"
                           ) -> tuple[jax.Array, jax.Array]:
     """``fused_ce_loss`` on a dp/fsdp/tp mesh (shard_map over the Pallas
     kernels — pallas_call is not auto-partitionable under GSPMD, which is
     why the plain spelling is single-device).
+
+    ``inner`` selects the per-device tile engine: "pallas" (the Mosaic
+    kernels, TPU) or "scan" (losses._scan_ce_totals — portable lax with
+    the identical collective structure). The shard_map wrapper is the
+    same either way: inside it XLA sees LOCAL shapes, so the vocab
+    tiling survives partitioning at any scale — left to GSPMD, the
+    plain scan spelling re-materializes full-vocab buffers at 8B
+    (measured, scripts/scale_aot.py).
 
     Layout (parallel/sharding.py rules): hidden [B, T, E] rides the batch
     sharding P(('dp','fsdp'), None, None); the head [V, E] is a param
@@ -410,7 +419,9 @@ def fused_ce_loss_sharded(hidden: jax.Array, head_kernel: jax.Array,
     except ImportError:  # pragma: no cover
         from jax.shard_map import shard_map
 
-    if interpret is None:
+    if inner not in ("pallas", "scan"):
+        raise ValueError(f"unknown inner tile engine {inner!r}")
+    if inner == "pallas" and interpret is None:
         interpret = _interpret()
         if interpret:
             warnings.warn(
@@ -459,8 +470,13 @@ def fused_ce_loss_sharded(hidden: jax.Array, head_kernel: jax.Array,
             h2 = jax.lax.dynamic_slice_in_dim(h2, i * per, per, 0)
             y2 = jax.lax.dynamic_slice_in_dim(y2, i * per, per, 0)
             m2 = jax.lax.dynamic_slice_in_dim(m2, i * per, per, 0)
-        total, count = _fused_ce_totals(h2, w, y2, m2, block_n=block_n,
-                                        block_v=block_v, interpret=interpret)
+        if inner == "scan":
+            from .losses import _scan_ce_totals
+            total, count = _scan_ce_totals(h2, w, y2, m2, chunk=block_v)
+        else:
+            total, count = _fused_ce_totals(h2, w, y2, m2, block_n=block_n,
+                                            block_v=block_v,
+                                            interpret=interpret)
         total = jax.lax.psum(total, psum_axes)
         count = jax.lax.psum(count, psum_axes)
         return total, count

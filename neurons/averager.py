@@ -41,7 +41,8 @@ def make_strategy(cfg: RunConfig, model):
             screen_batches=cfg.genetic_screen_batches or None)
     else:
         strategy = ParameterizedMerge(model, meta_epochs=cfg.meta_epochs,
-                                      meta_lr=cfg.meta_lr)
+                                      meta_lr=cfg.meta_lr,
+                                      meta_optimizer=cfg.meta_optimizer)
     if cfg.outer_momentum > 0:
         strategy = OuterOptMerge(
             strategy, outer_lr=cfg.outer_lr, momentum=cfg.outer_momentum,

@@ -45,7 +45,8 @@ class Components:
     def train_batches(self, *, repeat: bool = True) -> Iterable[dict]:
         import jax
 
-        docs = text_corpus(split="train", source=self.cfg.dataset)
+        docs = text_corpus(split="train", source=self.cfg.dataset,
+                           n_docs=self.cfg.n_docs)
         bs = self.cfg.batch_size
         if jax.process_count() > 1:
             # --batch-size is the GLOBAL batch on a pod: each process feeds
@@ -88,7 +89,8 @@ class Components:
     def eval_batches(self) -> Callable[[], Iterable[dict]]:
         """Factory over a fixed held-out shard (the reference evaluates the
         first ~100 test texts, neurons/validator.py:49,98)."""
-        docs = text_corpus(split="test", source=self.cfg.dataset)
+        docs = text_corpus(split="test", source=self.cfg.dataset,
+                           n_docs=max(64, self.cfg.n_docs // 8))
         cfg = self.cfg
 
         def factory():

@@ -28,6 +28,22 @@ from distributedtraining_tpu.engine import MinerLoop   # noqa: E402
 from neurons.common import build                       # noqa: E402
 
 
+def _guard_kwargs(cfg, c) -> dict:
+    """Self-validation-guard wiring, shared by the full-param and LoRA
+    branches. 0 disables; negative follows --send-interval (and disables
+    when that is non-positive — push-every-step runs would eval every
+    step and revert on per-step noise)."""
+    if cfg.self_eval_interval == 0:
+        return {}
+    interval = (cfg.self_eval_interval if cfg.self_eval_interval > 0
+                else cfg.send_interval)
+    if interval <= 0:
+        return {}
+    return dict(val_batches=c.eval_batches(),
+                val_guard_interval=interval,
+                val_guard_patience=cfg.self_eval_patience)
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -60,7 +76,7 @@ def main(argv=None) -> int:
                              metrics=c.metrics, log_every=cfg.log_every,
                              checkpoint_store=store,
                              checkpoint_interval=cfg.checkpoint_interval,
-                             trace=trace)
+                             trace=trace, **_guard_kwargs(cfg, c))
     else:
         loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
                          send_interval=cfg.send_interval,
@@ -71,7 +87,7 @@ def main(argv=None) -> int:
                          delta_density=cfg.delta_density,
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval,
-                         trace=trace)
+                         trace=trace, **_guard_kwargs(cfg, c))
     try:
         loop.bootstrap(params=c.initial_params)
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)

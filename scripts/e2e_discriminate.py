@@ -12,12 +12,19 @@ DISCRIMINATION:
   (per-hotkey shuffle seeds, neurons/common.py),
 - one additional chain identity publishes a loadgen-poisoned artifact
   (mode "huge" -> the max-abs admission screen),
+- one MORE identity publishes a mediocre-but-screen-passing artifact
+  (small benign noise): every admission screen accepts it, so only the
+  MERGE can defend against it,
 - the validator's RAW scores (base_loss - candidate_loss, pre-EMA,
   pre-u16) must be strictly ordered strong > medium > weak > 0 and the
   poisoned identity must be rejected with a named reason,
-- ParameterizedMerge (scalar per-miner weights, softmax) must learn
-  mixing weights whose ordering agrees with the validator's scores,
-- the merged base must beat the pre-round base on the eval set.
+- ParameterizedMerge (scalar per-miner weights, softmax, adam
+  meta-optimizer) must learn mixing weights whose ordering agrees with
+  the validator's scores AND land the mediocre identity's weight below
+  HALF the strong miner's (round-4 verdict weak #3: the sgd spelling
+  left a ~1% spread),
+- the merged base must beat the pre-round base AND the uniform merge on
+  the eval set.
 
 Runs everything through the real components (RunConfig/build, the role
 CLI for miners, library Validator/ParameterizedMerge for raw access to
@@ -42,7 +49,8 @@ force_platform_from_env()
 
 def run(work_dir: str, *, model: str = "gpt2-124m",
         steps: tuple[int, int, int] = (60, 25, 8),
-        eval_batches: int = 3, meta_epochs: int = 3,
+        eval_batches: int = 3, meta_epochs: int = 7,
+        meta_lr: float = 0.05,
         record: str | None = None, skip_miners: bool = False) -> dict:
     import numpy as np
 
@@ -90,6 +98,14 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
         poisoned,
         loadgen.poisoned_delta(host_template, "huge",
                                np.random.default_rng(7)))
+    # the mediocre identity: small benign noise — passes EVERY admission
+    # screen (finite, right shapes, tiny magnitude) but contributes
+    # nothing; only the learned merge weights can down-rank it
+    mediocre = "hotkey_4"
+    c.transport.publish_delta(
+        mediocre,
+        loadgen.benign_delta(host_template, np.random.default_rng(8),
+                             scale=1e-4))
 
     validator = Validator(c.engine, c.transport, c.chain,
                           eval_batches=c.eval_batches(),
@@ -104,7 +120,7 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
                                                      "hotkey_99"])
     ca = build(acfg)
     strategy = ParameterizedMerge(ca.model, meta_epochs=meta_epochs,
-                                  per_tensor=False)
+                                  meta_lr=meta_lr, per_tensor=False)
     loop = AveragerLoop(ca.engine, ca.transport, ca.chain, strategy,
                         val_batches=ca.eval_batches(),
                         max_delta_abs=acfg.max_delta_abs)
@@ -112,6 +128,8 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
     base_loss, _ = ca.engine.evaluate(loop.base_params, ca.eval_batches()())
     ids, deltas = loop.gather_deltas()
     assert poisoned not in ids, "averager accepted the poisoned artifact"
+    assert mediocre in ids, "screen rejected the benign-noise artifact " \
+        "(it must reach the merge for this scenario to mean anything)"
     from distributedtraining_tpu import delta as delta_lib
     stacked = delta_lib.stack_deltas(deltas)
     merged, w = strategy.merge(ca.engine, loop.base_params, stacked, ids,
@@ -120,6 +138,11 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
     mix = {h: float(x) for h, x in zip(ids, jnp.asarray(
         jax.nn.softmax(w)))}
     merged_loss, _ = ca.engine.evaluate(merged, ca.eval_batches()())
+    from distributedtraining_tpu.engine import WeightedAverage
+    uniform, _ = WeightedAverage(uniform=True).merge(
+        ca.engine, loop.base_params, stacked, ids,
+        val_batches=ca.eval_batches())
+    uniform_loss, _ = ca.engine.evaluate(uniform, ca.eval_batches()())
     wall = time.time() - t0
 
     chain_meta = json.loads(open(os.path.join(
@@ -137,8 +160,12 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
         "chain_weights_u16": {h: emitted.get(h, 0)
                               for h in miners + [poisoned]},
         "merge_weights_softmax": mix,
+        "mediocre": {"hotkey": mediocre,
+                     "score": results[mediocre].score,
+                     "merge_weight": mix.get(mediocre)},
         "base_loss": float(base_loss),
         "merged_loss": float(merged_loss),
+        "uniform_merged_loss": float(uniform_loss),
         "wall_seconds": round(wall, 1),
     }
 
@@ -158,6 +185,13 @@ def run(work_dir: str, *, model: str = "gpt2-124m",
     # strong miner must not be out-weighed by the weak one
     assert mix[miners[0]] >= mix[miners[2]], \
         f"merge weights contradict scores: {mix} vs {raw}"
+    # the round-5 bar: the production merge must discriminate MEASURABLY —
+    # the screen-passing-but-useless delta lands below HALF the strong
+    # miner's weight, and the learned mixture beats the uniform one
+    assert mix[mediocre] < 0.5 * mix[miners[0]], \
+        f"merge barely discriminates: {mix}"
+    assert merged_loss <= uniform_loss + 1e-3, \
+        f"learned merge no better than uniform: {merged_loss} vs {uniform_loss}"
     assert merged_loss <= base_loss, (merged_loss, base_loss)
     # non-saturated evidence: raw scores are loss deltas, not u16 caps
     assert all(0 < raw[h] < 20 for h in miners), raw
@@ -176,7 +210,8 @@ def main() -> int:
     p.add_argument("--steps", default="60,25,8",
                    help="strong,medium,weak miner step budgets")
     p.add_argument("--eval-batches", type=int, default=3)
-    p.add_argument("--meta-epochs", type=int, default=3)
+    p.add_argument("--meta-epochs", type=int, default=7)
+    p.add_argument("--meta-lr", type=float, default=0.05)
     p.add_argument("--record", default=None)
     p.add_argument("--skip-miners", action="store_true",
                    help="reuse the work dir's existing deltas (re-score "
@@ -186,7 +221,7 @@ def main() -> int:
     assert len(steps) == 3
     run(a.work_dir, model=a.model, steps=steps,
         eval_batches=a.eval_batches, meta_epochs=a.meta_epochs,
-        record=a.record, skip_miners=a.skip_miners)
+        meta_lr=a.meta_lr, record=a.record, skip_miners=a.skip_miners)
     return 0
 
 

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""AOT compile + HBM budget evidence for BASELINE configs 4/5.
+
+Round-4 verdict #3: `tests/validate_7b_worker.py` is shape-level only —
+nothing *compiles* the 7B/8B step on the target mesh shapes, and nothing
+shows params + AdamW(+bf16 mu) + activations actually fit per chip.
+
+This script `.lower().compile()`s the full train step on virtual CPU
+meshes shaped like the target pods and records XLA's buffer-assignment
+memory analysis per device against the chip HBM budgets:
+
+  config 4: Llama-2-7B LoRA(r=8), v4-32  (dp=2 x fsdp=8 x tp=2),
+            seq 4096, global batch 16, scan_blocks      — 32 GiB/chip
+  config 5: Llama-3-8B full delta, v5e-64 (dp=2 x fsdp=16 x tp=2),
+            seq 8192, global batch 32, scan_blocks + remat + fused CE,
+            bf16 first moment                            — 16 GiB/chip
+
+What AOT compilation catches that eval_shape cannot: collective
+layouts, GSPMD resharding choices (incl. the involuntary-remat class
+fixed in round 5), actual buffer sizes and aliasing, and the real
+per-device argument/temp split after partitioning.
+
+Caveats recorded in the artifact: buffer assignment on the CPU backend
+approximates TPU HBM (fusion decisions differ); attention compiles the
+blockwise lax spelling (ops/attention.py) whose temp profile matches the
+flash kernel the TPU runs (block-bounded, never [T, T]).
+
+Usage: python scripts/scale_aot.py [--out SCALE_r05.json] [--config 4|5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GIB = 1024 ** 3
+
+
+def _budget_checks(name, comp, n_devices, hbm_gib):
+    ma = comp.memory_analysis()
+    # sizes are per participating device (SPMD: one executable per chip)
+    args_b = int(ma.argument_size_in_bytes)
+    out_b = int(ma.output_size_in_bytes)
+    temp_b = int(ma.temp_size_in_bytes)
+    alias_b = int(ma.alias_size_in_bytes)
+    code_b = int(ma.generated_code_size_in_bytes)
+    # donated state aliases input<->output; aliased bytes exist once
+    peak_b = args_b + temp_b + (out_b - alias_b)
+    rec = {
+        "argument_gib": round(args_b / GIB, 3),
+        "output_gib": round(out_b / GIB, 3),
+        "alias_gib": round(alias_b / GIB, 3),
+        "temp_gib": round(temp_b / GIB, 3),
+        "generated_code_mib": round(code_b / 1024 ** 2, 2),
+        "peak_estimate_gib": round(peak_b / GIB, 3),
+        "hbm_budget_gib": hbm_gib,
+        "headroom_gib": round(hbm_gib - peak_b / GIB, 3),
+        "fits": peak_b < hbm_gib * GIB,
+    }
+    try:
+        ca = comp.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if ca and "flops" in ca:
+            rec["flops_per_step_per_device"] = float(ca["flops"])
+    except Exception:
+        pass
+    return rec
+
+
+def config4():
+    """Llama-2-7B LoRA on a v4-32-shaped mesh."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from distributedtraining_tpu.engine import LoRAEngine
+    from distributedtraining_tpu.models import llama
+    from distributedtraining_tpu.models.lora import LoRAConfig
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+    model, cfg = llama.make_model("llama2-7b")
+    # remat is load-bearing: without it the 32-layer activation stash is
+    # ~56 GiB/chip at this batch (measured by this script) — config 4
+    # deploys with per-block rematerialization like config 5
+    model, cfg = llama.make_model(
+        dataclasses.replace(cfg, scan_blocks=True, remat=True))
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=8, tp=2))
+    seq, batch = 4096, 16
+    from distributedtraining_tpu.parallel.sharding import batch_sharding
+    eng = LoRAEngine(model, LoRAConfig(rank=8), mesh=mesh, seq_len=seq)
+    state_abs = eng.abstract_state()
+    base_abs = eng.abstract_params()
+    # the batch abstract must carry the batch sharding: the engines place
+    # concrete batches with device_put, so an unannotated ShapeDtypeStruct
+    # would compile an unsharded-batch program (B-fold activation blowup)
+    batch_abs = {"input_ids": jax.ShapeDtypeStruct(
+        (batch, seq), np.int32, sharding=batch_sharding(mesh))}
+    t0 = time.time()
+    comp = eng.train_step.lower(state_abs, base_abs, batch_abs).compile()
+    compile_s = time.time() - t0
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(base_abs))
+    rec = {
+        "config": "BASELINE config 4",
+        "model": "llama2-7b + LoRA r=8 (scan_blocks, remat)",
+        "n_params": n_params,
+        "mesh": "v4-32: dp=2 x fsdp=8 x tp=2",
+        "devices": 32,
+        "seq_len": seq,
+        "global_batch": batch,
+        "compile_seconds": round(compile_s, 1),
+        "per_device": _budget_checks("7b-lora", comp, 32, 32),
+    }
+    return rec
+
+
+def config5():
+    """Llama-3-8B full-param AdamW on a v5e-64-shaped mesh."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.engine.train import default_optimizer
+    from distributedtraining_tpu.models import llama
+    from distributedtraining_tpu.parallel import MeshConfig, make_mesh
+
+    model, cfg = llama.make_model("llama3-8b")
+    model, cfg = llama.make_model(
+        dataclasses.replace(cfg, scan_blocks=True, remat=True))
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=16, tp=2))
+    seq, batch = 8192, 32
+    from distributedtraining_tpu.parallel.sharding import batch_sharding
+    eng = TrainEngine(model, mesh=mesh, seq_len=seq, fused_loss=True,
+                      optimizer=default_optimizer(mu_dtype="bfloat16"))
+    state_abs = eng.abstract_state()
+    batch_abs = {"input_ids": jax.ShapeDtypeStruct(
+        (batch, seq), np.int32, sharding=batch_sharding(mesh))}
+    t0 = time.time()
+    comp = eng.train_step.lower(state_abs, batch_abs).compile()
+    compile_s = time.time() - t0
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(state_abs.params))
+    rec = {
+        "config": "BASELINE config 5",
+        "model": "llama3-8b full delta (scan_blocks, remat, fused scan-CE, "
+                 "bf16 mu)",
+        "n_params": n_params,
+        "mesh": "v5e-64: dp=2 x fsdp=16 x tp=2",
+        "devices": 64,
+        "seq_len": seq,
+        "global_batch": batch,
+        "compile_seconds": round(compile_s, 1),
+        "per_device": _budget_checks("8b-full", comp, 64, 16),
+    }
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="SCALE_r05.json")
+    ap.add_argument("--config", choices=["4", "5", "both"], default="both")
+    args = ap.parse_args()
+
+    n_dev = 64 if args.config in ("5", "both") else 32
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count={n_dev}"
+                               ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    results = {
+        "generated_by": "scripts/scale_aot.py",
+        "backend": "cpu (virtual devices; buffer assignment approximates "
+                   "TPU HBM — fusion differs; attention uses the blockwise "
+                   "lax spelling whose temp profile matches the flash "
+                   "kernel's block-bounded memory)",
+        "configs": [],
+    }
+    if args.config in ("4", "both"):
+        results["configs"].append(config4())
+    if args.config in ("5", "both"):
+        results["configs"].append(config5())
+
+    ok = all(c["per_device"]["fits"] for c in results["configs"])
+    results["all_fit"] = ok
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results, indent=1))
+    print(f"wrote {args.out}; all_fit={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,7 +58,9 @@ def main() -> int:
     #    loss paths (the default [B,T,V]-logits loss and the fused
     #    no-logits loss config 4/5 would actually run) — eval_shape is
     #    allocation-free, so validating both costs nothing
-    batch_abs = {"input_ids": jax.ShapeDtypeStruct((4, seq), np.int32)}
+    # global batch must divide dp*fsdp=16 (the shard_map fused-CE spelling
+    # enforces what place_batch enforces at runtime)
+    batch_abs = {"input_ids": jax.ShapeDtypeStruct((16, seq), np.int32)}
     for fused in (False, True):
         engine = TrainEngine(model, mesh=mesh, seq_len=seq,
                              fused_loss=fused)
