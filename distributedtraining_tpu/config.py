@@ -115,6 +115,7 @@ class RunConfig:
     # training, training_manager.py:380-392)
     self_eval_interval: float = -1.0
     self_eval_patience: int = 3
+    self_eval_margin: float = 0.1
     checkpoint_interval: float = 600.0       # 0 disables local checkpointing
     checkpoint_dir: Optional[str] = None     # default: <work_dir>/checkpoints/<hotkey>
     validation_interval: float = 1800.0      # validator.py:112
@@ -388,6 +389,10 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "follow --send-interval, 0 = disable the guard")
     g.add_argument("--self-eval-patience", dest="self_eval_patience",
                    type=int, default=d.self_eval_patience)
+    g.add_argument("--self-eval-margin", dest="self_eval_margin",
+                   type=float, default=d.self_eval_margin,
+                   help="held-out loss may exceed the best-seen by this "
+                        "much before an eval counts as a strike")
     if role == "miner":  # only the miner wires a CheckpointStore today
         g.add_argument("--checkpoint-interval", dest="checkpoint_interval",
                        type=float, default=d.checkpoint_interval,

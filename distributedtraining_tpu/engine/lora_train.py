@@ -220,11 +220,6 @@ class LoRAMinerLoop(MinerLoop):
             return float("nan")
         return float(total) / float(count)
 
-    def _guard_revert(self) -> None:
-        from .train import _snapshot
-        self.state = self.engine.init_state_from(
-            _snapshot(self._best_params))
-
     # -- the artifact -------------------------------------------------------
     def _push_delta(self) -> None:
         if self.state is None:

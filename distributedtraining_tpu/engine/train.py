@@ -683,6 +683,7 @@ class MinerLoop:
                  val_batches=None,
                  val_guard_interval: float | None = None,
                  val_guard_patience: int = 3,
+                 val_guard_margin: float = 0.1,
                  trace=None):
         self.engine = engine
         self.transport = transport
@@ -744,8 +745,25 @@ class MinerLoop:
         # (training_manager.py:380-392 has no eval in the miner loop).
         self.val_batches = val_batches
         self.val_guard_patience = val_guard_patience
+        # strikes accrue only when the candidate is WORSE than best by
+        # more than this margin: a miner crawling down a flat loss curve
+        # fails to beat its best on most evals from noise alone, and
+        # reverting there resets Adam's moments exactly when they are
+        # warming up — the guard would then pin the miner at the base
+        # (measured in the first r05 soak). The r04 runaway this guard
+        # exists for drifted +3.0; a 0.1 margin catches it within one
+        # eval interval while tolerating plateau noise.
+        self.val_guard_margin = val_guard_margin
         self._best_val: float | None = None
-        self._best_params: Params | None = None
+        # the ENTIRE TrainState at the best eval — params AND optimizer
+        # moments. Reverting with a fresh optimizer (the first spelling)
+        # cold-restarts Adam each time, and on the flat part of the loss
+        # curve the resulting warmup transient is larger than the
+        # progress a push window makes — the fleet then hovers just
+        # above the published base forever (measured in the first r05
+        # soak). Restoring the exact state resumes descent instead.
+        # Costs one extra state copy (~3x params with AdamW).
+        self._best_state: TrainState | None = None
         self._val_strikes = 0
         self._val_guard_action = None
         if val_batches is not None:
@@ -858,7 +876,7 @@ class MinerLoop:
         """New base => fresh tracking (the old best was relative to the
         superseded base)."""
         self._best_val = None
-        self._best_params = None
+        self._best_state = None
         self._val_strikes = 0
 
     def _guard_eval(self) -> float:
@@ -867,11 +885,15 @@ class MinerLoop:
         loss, _ = self.engine.evaluate(self.state.params, self.val_batches())
         return loss
 
+    def _guard_snapshot(self) -> None:
+        self._best_state = _snapshot(self.state)
+
     def _guard_revert(self) -> None:
-        """Rebuild the train state from the best-seen params with a fresh
-        optimizer — the same semantics as a base pull."""
-        self.state = self.engine.init_state(
-            params=_snapshot(self._best_params))
+        """Restore the exact best-seen TrainState (params + optimizer
+        moments + step). The stored copy is re-copied on the way out:
+        train_step donates its input state, so handing the kept tree to
+        the step would free the guard's only snapshot."""
+        self.state = _snapshot(self._best_state)
 
     def _val_guard(self) -> None:
         if self.state is None or self.val_batches is None:
@@ -884,16 +906,24 @@ class MinerLoop:
             return
         if self._best_val is None or loss < self._best_val:
             self._best_val = loss
-            self._best_params = _snapshot(self.state.params)
+            self._guard_snapshot()
+            self._val_strikes = 0
+        elif loss <= self._best_val + self.val_guard_margin:
+            # plateau / noise band: not a new best, and it clears the
+            # strike count — patience means CONSECUTIVE over-margin
+            # evals, so scattered noise spikes on a long plateau can
+            # never accumulate into a spurious revert
             self._val_strikes = 0
         else:
             self._val_strikes += 1
             if (self._val_strikes >= self.val_guard_patience
-                    and self._best_params is not None):
+                    and self._best_state is not None):
                 logger.info(
-                    "miner %s: val loss %.4f has not beaten %.4f for %d "
-                    "evals — reverting to best state (fresh optimizer)",
-                    self.miner_id, loss, self._best_val, self._val_strikes)
+                    "miner %s: val loss %.4f exceeded best %.4f by more "
+                    "than the %.2f margin for %d consecutive evals — "
+                    "reverting to best state (params + optimizer)",
+                    self.miner_id, loss, self._best_val,
+                    self.val_guard_margin, self._val_strikes)
                 self._guard_revert()
                 self._val_strikes = 0
                 self.report.val_reverts += 1

@@ -90,6 +90,11 @@ PRESETS: dict[str, GPT2Config] = {
     "gpt2-1.5b": GPT2Config(n_embd=1600, n_layer=48, n_head=25),
     "tiny": GPT2Config(vocab_size=512, n_positions=128, n_embd=64,
                        n_layer=2, n_head=4, vocab_multiple=128),
+    # soak-scale: enough capacity that a multi-hour CPU soak keeps
+    # descending instead of hitting tiny's ~2.4 byte-LM ceiling in the
+    # first minutes (scripts/soak.py)
+    "mini": GPT2Config(vocab_size=512, n_positions=128, n_embd=128,
+                       n_layer=4, n_head=4, vocab_multiple=128),
 }
 
 

@@ -30,3 +30,23 @@ def force_platform_from_env(var: str = "DT_FORCE_PLATFORM",
 
         jax.config.update("jax_platforms", val)
     return val
+
+
+def ensure_virtual_devices(n: int) -> None:
+    """Guarantee XLA_FLAGS requests >= ``n`` host-platform devices.
+
+    Must run before the first backend touch. An existing smaller count
+    (stale operator env) is RAISED in place — appending a second flag
+    instance would rely on unspecified last-wins parsing, and keeping
+    the stale value fails later with a mesh-size error that never
+    mentions the env var. Shared by the AOT scale artifact and the
+    sharded E2E runners."""
+    import re
+
+    flag = "--xla_force_host_platform_device_count"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{flag}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {flag}={n}".strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(m.group(0), f"{flag}={n}")

@@ -90,7 +90,7 @@ class Components:
         """Factory over a fixed held-out shard (the reference evaluates the
         first ~100 test texts, neurons/validator.py:49,98)."""
         docs = text_corpus(split="test", source=self.cfg.dataset,
-                           n_docs=max(64, self.cfg.n_docs // 8))
+                           n_docs=max(256, self.cfg.n_docs // 8))
         cfg = self.cfg
 
         def factory():

@@ -41,7 +41,8 @@ def _guard_kwargs(cfg, c) -> dict:
         return {}
     return dict(val_batches=c.eval_batches(),
                 val_guard_interval=interval,
-                val_guard_patience=cfg.self_eval_patience)
+                val_guard_patience=cfg.self_eval_patience,
+                val_guard_margin=cfg.self_eval_margin)
 
 
 def main(argv=None) -> int:

@@ -74,7 +74,15 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         corpus: str = "files:/usr/share/common-licenses/*",
         eval_batches: int = 2, record: str | None = None,
         delta_dtype: str | None = None, signed: bool = False,
-        tokenizer: str = "word", fused_loss: bool = False) -> dict:
+        tokenizer: str = "word", fused_loss: bool = False,
+        fsdp: int = 1, tp: int = 1) -> dict:
+    if fsdp * tp > 1:
+        # sharded E2E (the everything-on composition run): stand up the
+        # virtual device mesh BEFORE any backend touch; an existing
+        # smaller count (stale env) is raised, not silently kept
+        from distributedtraining_tpu.utils.platform import (
+            ensure_virtual_devices)
+        ensure_virtual_devices(fsdp * tp)
     from neurons import averager, miner, validator
 
     # per-preset directory: a reused --work-dir with a different --model
@@ -85,7 +93,8 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
     common = [
         "--backend", "local", "--work-dir", work_dir,
         "--model", model, "--dataset", corpus, "--tokenizer", tokenizer,
-        "--dp", "1", "--batch-size", "8", "--seq-len", "64",
+        "--dp", "1", "--fsdp", str(fsdp), "--tp", str(tp),
+        "--batch-size", "8", "--seq-len", "64",
         "--eval-seq-len", "128", "--eval-batches", str(eval_batches),
     ]
     if fused_loss:
@@ -143,6 +152,7 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         "tokenizer_vocab": tok_vocab,
         "delta_dtype": delta_dtype or "float32",
         "signed_artifacts": signed,
+        "mesh": {"fsdp": fsdp, "tp": tp},
         "delta_artifact_bytes": (os.path.getsize(delta_art)
                                  if os.path.exists(delta_art) else None),
         "steps": steps, "wall_seconds": round(wall, 1),
@@ -185,6 +195,8 @@ def main() -> int:
     p.add_argument("--tokenizer", default="word",
                    help="word (default) | bpe (locally trained 32k "
                         "byte-level BPE) | byte")
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
     p.add_argument("--fused-loss", action="store_true",
                    help="run the miner/validator/averager with the "
                         "logits-free fused CE (the big-vocab path)")
@@ -192,7 +204,8 @@ def main() -> int:
     run(a.work_dir, steps=a.steps, model=a.model, corpus=a.corpus,
         eval_batches=a.eval_batches, record=a.record,
         delta_dtype=a.delta_dtype, signed=a.signed,
-        tokenizer=a.tokenizer, fused_loss=a.fused_loss)
+        tokenizer=a.tokenizer, fused_loss=a.fused_loss,
+        fsdp=a.fsdp, tp=a.tp)
     return 0
 
 

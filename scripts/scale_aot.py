@@ -41,7 +41,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 GIB = 1024 ** 3
 
 
-def _budget_checks(name, comp, n_devices, hbm_gib):
+def _budget_checks(comp, hbm_gib):
     ma = comp.memory_analysis()
     # sizes are per participating device (SPMD: one executable per chip)
     args_b = int(ma.argument_size_in_bytes)
@@ -115,7 +115,7 @@ def config4():
         "seq_len": seq,
         "global_batch": batch,
         "compile_seconds": round(compile_s, 1),
-        "per_device": _budget_checks("7b-lora", comp, 32, 32),
+        "per_device": _budget_checks(comp, 32),
     }
     return rec
 
@@ -158,7 +158,7 @@ def config5():
         "seq_len": seq,
         "global_batch": batch,
         "compile_seconds": round(compile_s, 1),
-        "per_device": _budget_checks("8b-full", comp, 64, 16),
+        "per_device": _budget_checks(comp, 16),
     }
     return rec
 
@@ -170,9 +170,8 @@ def main() -> int:
     args = ap.parse_args()
 
     n_dev = 64 if args.config in ("5", "both") else 32
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                               f" --xla_force_host_platform_device_count={n_dev}"
-                               ).strip()
+    from distributedtraining_tpu.utils.platform import ensure_virtual_devices
+    ensure_virtual_devices(n_dev)
     import jax
     jax.config.update("jax_platforms", "cpu")
 

