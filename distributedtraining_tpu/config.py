@@ -116,6 +116,7 @@ class RunConfig:
     self_eval_interval: float = -1.0
     self_eval_patience: int = 3
     self_eval_margin: float = 0.1
+    keep_optimizer_on_pull: bool = False     # ref parity: reset on pull
     checkpoint_interval: float = 600.0       # 0 disables local checkpointing
     checkpoint_dir: Optional[str] = None     # default: <work_dir>/checkpoints/<hotkey>
     validation_interval: float = 1800.0      # validator.py:112
@@ -393,6 +394,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    type=float, default=d.self_eval_margin,
                    help="held-out loss may exceed the best-seen by this "
                         "much before an eval counts as a strike")
+    g.add_argument("--keep-optimizer-on-pull",
+                   dest="keep_optimizer_on_pull", action="store_true",
+                   default=d.keep_optimizer_on_pull,
+                   help="carry Adam moments across base pulls instead of "
+                        "the reference's reset — removes the per-pull "
+                        "warmup transient on short merge cadences")
     if role == "miner":  # only the miner wires a CheckpointStore today
         g.add_argument("--checkpoint-interval", dest="checkpoint_interval",
                        type=float, default=d.checkpoint_interval,

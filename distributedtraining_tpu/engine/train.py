@@ -684,6 +684,7 @@ class MinerLoop:
                  val_guard_interval: float | None = None,
                  val_guard_patience: int = 3,
                  val_guard_margin: float = 0.1,
+                 keep_optimizer_on_pull: bool = False,
                  trace=None):
         self.engine = engine
         self.transport = transport
@@ -702,6 +703,16 @@ class MinerLoop:
             raise ValueError(f"delta_density must be in (0, 1], "
                              f"got {delta_density}")
         self.delta_density = delta_density
+        # Reference semantics discard optimizer state on every base pull
+        # (training_manager.py:371-377). ``keep_optimizer_on_pull=True``
+        # carries the Adam moments across pulls instead (the standard
+        # federated-practice continuation): on short merge cadences the
+        # post-pull warmup transient otherwise eats most of each window's
+        # progress and the fleet stops publishing once the loss curve
+        # flattens (measured, scripts/soak.py). The moments were computed
+        # against the pre-merge params — a mild approximation that decays
+        # within a few steps and beats a cold start.
+        self.keep_optimizer_on_pull = keep_optimizer_on_pull
         self.checkpoint_store = checkpoint_store
         self.report = MinerReport()
         # device-resident copy of the newest step's loss; fetched to
@@ -860,12 +871,20 @@ class MinerLoop:
         if fetched is None:
             return
         params, rev = fetched
-        logger.info("miner %s: new base model %s — resetting optimizer",
-                    self.miner_id, rev and rev[:8])
-        # protocol semantics: optimizer state is discarded on base update
-        # (training_manager.py:371-377)
-        self.state = self.engine.init_state(
-            params=wire_in(self.engine, params))
+        new_params = wire_in(self.engine, params)
+        if self.keep_optimizer_on_pull and self.state is not None:
+            logger.info("miner %s: new base model %s — keeping optimizer "
+                        "moments", self.miner_id, rev and rev[:8])
+            self.state = TrainState(
+                step=self.state.step,
+                params=self.engine.place_params(new_params),
+                opt_state=self.state.opt_state)
+        else:
+            logger.info("miner %s: new base model %s — resetting optimizer",
+                        self.miner_id, rev and rev[:8])
+            # protocol semantics: optimizer state is discarded on base
+            # update (training_manager.py:371-377)
+            self.state = self.engine.init_state(params=new_params)
         self.base_params = _snapshot(self.state.params)
         self._base_revision = rev
         self._last_base_time = self.clock.now()

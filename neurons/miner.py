@@ -67,6 +67,14 @@ def main(argv=None) -> int:
         # --grad-clip apply to adapters too; the mesh shards the frozen
         # base (fsdp/tp) while adapters replicate.
         from distributedtraining_tpu.engine import LoRAEngine, LoRAMinerLoop
+        if cfg.keep_optimizer_on_pull:
+            # adapters are re-initialized on every base change (they are
+            # defined RELATIVE to the base), so there is no state to
+            # carry — refuse silently doing nothing
+            logging.warning(
+                "--keep-optimizer-on-pull has no effect for LoRA miners "
+                "(adapters and their optimizer reset with the base); "
+                "ignoring")
         engine = LoRAEngine(c.model, c.lora_cfg, optimizer=c.engine.tx,
                             mesh=c.engine.mesh, seq_len=cfg.seq_len,
                             accum_steps=cfg.accum_steps,
@@ -86,6 +94,7 @@ def main(argv=None) -> int:
                          delta_dtype=(None if cfg.delta_dtype == "float32"
                                       else cfg.delta_dtype),
                          delta_density=cfg.delta_density,
+                         keep_optimizer_on_pull=cfg.keep_optimizer_on_pull,
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval,
                          trace=trace, **_guard_kwargs(cfg, c))

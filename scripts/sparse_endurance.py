@@ -98,7 +98,7 @@ def run(work_dir: str, *, rounds: int = 12, steps: int = 40,
         "tolerance": tolerance,
         "wall_seconds": round(time.time() - t0, 1),
     }
-    assert len(diffs) >= 10, f"only {len(diffs)} rounds"
+    assert len(diffs) >= rounds, f"only {len(diffs)} of {rounds} rounds"
     assert max(diffs) <= tolerance, \
         (f"sparse8 diverged from f32: max |loss diff| {max(diffs):.4f} "
          f"> {tolerance}")

@@ -762,3 +762,33 @@ def test_averager_publish_policy_guards_regressions(setup, tmp_path):
     # ...and the identical submission set is not re-merged next round
     assert avg4.run_round() is True
     assert avg4.report.skipped_publishes == 1  # recompute skipped
+
+
+def test_miner_keep_optimizer_on_pull(setup):
+    """--keep-optimizer-on-pull carries Adam moments across a base pull
+    (the federated continuation deviation); the default resets them
+    (reference parity, training_manager.py:371-377)."""
+    model, cfg, engine, train_batches, _ = setup
+    for keep in (False, True):
+        clock = FakeClock()
+        transport = InMemoryTransport()
+        miner = MinerLoop(engine, transport, "m0", clock=clock,
+                          send_interval=1000.0, check_update_interval=1.0,
+                          log_every=100, keep_optimizer_on_pull=keep)
+        miner.bootstrap(jax.random.PRNGKey(0))
+        it = train_batches()
+        for _ in range(4):
+            clock.advance(1.0)
+            miner.state, _ = engine.train_step(miner.state, next(it))
+        mu_before = jax.tree_util.tree_leaves(miner.state.opt_state)
+        nonzero_before = any(float(jnp.abs(l).max()) > 0
+                             for l in mu_before if l.ndim > 0)
+        assert nonzero_before  # moments accumulated
+        transport.publish_base(model.init_params(jax.random.PRNGKey(3)))
+        clock.advance(10.0)
+        miner._pull_action.poll()
+        assert miner.report.base_pulls == 1
+        leaves = [l for l in jax.tree_util.tree_leaves(miner.state.opt_state)
+                  if hasattr(l, "ndim") and l.ndim > 0]
+        nonzero_after = any(float(jnp.abs(l).max()) > 0 for l in leaves)
+        assert nonzero_after == keep, (keep, nonzero_after)
