@@ -20,7 +20,7 @@ Unlike the PyTorch reference, all compute here is JAX/XLA:
 Reference layer map: see SURVEY.md at the repo root.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 # Spec version emitted with chain weight-sets (reference:
 # template/__init__.py:24-27 encodes version -> int for set_weights).

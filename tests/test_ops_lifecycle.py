@@ -393,3 +393,22 @@ def test_autoupdater_transient_failure_never_hard_resets(tmp_path):
     assert upd_dirty.check() is True
     assert calls == ["restart", "restart2"]
     assert (clone / vf).read_text() == '__version__ = "3.0.0"\n'
+
+
+def test_ensure_virtual_devices_env(monkeypatch):
+    """ensure_virtual_devices raises an existing smaller count in place
+    (appending a duplicate flag would rely on unspecified last-wins
+    parsing) and leaves larger counts alone."""
+    from distributedtraining_tpu.utils.platform import ensure_virtual_devices
+
+    flag = "--xla_force_host_platform_device_count"
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    ensure_virtual_devices(8)
+    assert os.environ["XLA_FLAGS"] == f"{flag}=8"
+    ensure_virtual_devices(64)
+    assert os.environ["XLA_FLAGS"] == f"{flag}=64"
+    ensure_virtual_devices(32)  # smaller: no change
+    assert os.environ["XLA_FLAGS"] == f"{flag}=64"
+    monkeypatch.setenv("XLA_FLAGS", f"--xla_cpu_foo=1 {flag}=2")
+    ensure_virtual_devices(16)
+    assert os.environ["XLA_FLAGS"] == f"--xla_cpu_foo=1 {flag}=16"
