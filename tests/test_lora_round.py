@@ -281,3 +281,42 @@ def test_fetch_delta_any_accept_quant_gate(setup):
         assert got is not None, type(t).__name__
         rej = fetch_delta_any(t, "m", base, accept_quant=False)
         assert rej is None, type(t).__name__
+
+
+def test_lora_miner_val_guard(setup):
+    """The self-validation guard on the LoRA loop: _guard_eval scores
+    base+adapters via the 3-arg eval_step, the best full TrainState
+    (adapters + optimizer) is snapshotted, and a margin-0 patience-1
+    configuration reverts on the first non-improving eval."""
+    model, cfg, train_batches, val_batches = setup
+    engine = LoRAEngine(model, LCFG)
+    transport = InMemoryTransport()
+    clock = FakeClock()
+    miner = LoRAMinerLoop(engine, transport, "lm0", clock=clock,
+                          send_interval=1e9, check_update_interval=1e9,
+                          val_batches=val_batches,
+                          val_guard_interval=2.0, val_guard_patience=1,
+                          val_guard_margin=0.0)
+    miner.bootstrap(jax.random.PRNGKey(0))
+
+    def timed(it):
+        for b in it:
+            clock.advance(1.0)
+            yield b
+
+    miner.run(timed(train_batches()), max_steps=20)
+    # the guard evaluated and tracked a best full state
+    assert miner._best_val is not None and np.isfinite(miner._best_val)
+    assert miner._best_state is not None
+    # eval path scores the CANDIDATE (base + adapters), not raw adapters
+    direct = miner._guard_eval()
+    assert np.isfinite(direct)
+    # force a revert: corrupt current adapters so the next eval is worse
+    bad = jax.tree_util.tree_map(lambda x: x + 1.0, miner.state.params)
+    miner.state = miner.state.replace(params=bad)
+    before = miner.report.val_reverts
+    miner._val_guard()
+    assert miner.report.val_reverts == before + 1
+    # reverted adapters evaluate near the best again
+    after = miner._guard_eval()
+    assert abs(after - miner._best_val) < 0.2, (after, miner._best_val)
