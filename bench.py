@@ -36,6 +36,8 @@ WARMUP = 3
 ITERS = 20
 MERGE_M = 8           # miners in the merge bench (BASELINE config 3 scale)
 MERGE_ITERS = 5
+VAL_K = 8             # cohort size in the validator-round A/B
+VAL_EVAL_BATCHES = 4
 BASELINE_TOKENS_PER_SEC = 92843.0   # BENCH_r01.json, this rig, r01 code
 
 # peak dense bf16 FLOP/s per chip by TPU generation (public spec sheets);
@@ -162,6 +164,78 @@ def _time_loop_vs_engine(model, cfg, base_burst, *, trials: int = 2,
     loop_tps, loop_ratio = _pair_stats(pairs)
     return {"loop_tokens_per_sec": round(loop_tps, 1),
             "loop_vs_engine": round(loop_ratio, 3)}
+
+
+def _time_validator_round(model, cfg, *, k: int = VAL_K,
+                          n_batches: int = VAL_EVAL_BATCHES,
+                          trials: int = 2) -> dict:
+    """Validator-round A/B: the sequential score_miner spelling (one full
+    eval pass per candidate, engine.evaluate) vs the batched cohort
+    evaluator (engine/batched_eval.py) on the SAME base/deltas/batches.
+    ``validator_round_sec``/``candidates_per_sec`` are the cohort path's
+    numbers; the dispatch counts are exact by construction — sequential
+    pays k programs per eval batch, the cohort pays one — so the ratio is
+    the K-fold dispatch reduction the design claims, and the wall-clock
+    pair is what this rig measured. CPU-measurable: the contrast is
+    dispatch/placement overhead, which exists on every backend."""
+    from distributedtraining_tpu import delta as delta_lib
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.engine.batched_eval import (
+        BatchedCohortEvaluator)
+
+    engine = TrainEngine(model, seq_len=SEQ)
+    base = engine.place_params(model.init_params(jax.random.PRNGKey(0)))
+    rng = np.random.default_rng(0)
+    batches = [{"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)}
+        for _ in range(n_batches)]
+    leaves, treedef = jax.tree_util.tree_flatten(base)
+    key = jax.random.PRNGKey(1)
+    deltas = []
+    for _ in range(k):
+        key, kk = jax.random.split(key)
+        ks = jax.random.split(kk, len(leaves))
+        deltas.append(jax.tree_util.tree_unflatten(
+            treedef, [0.01 * jax.random.normal(s, l.shape, l.dtype)
+                      for s, l in zip(ks, leaves)]))
+
+    def seq_round():
+        # engine.evaluate's closing float() fetch ends each candidate's
+        # timing on a real sync (the _step_burst fetch discipline)
+        return [engine.evaluate(delta_lib.apply_delta(base, d), batches)
+                for d in deltas]
+
+    ev = BatchedCohortEvaluator(engine)
+
+    def cohort_round():
+        return ev.evaluate_cohort(base, deltas, batches)
+
+    seq = seq_round()      # warm: compiles eval_step
+    coh = cohort_round()   # warm: compiles the bucket program
+    # parity guard: a fast-but-wrong cohort eval is not a win
+    parity_err = max(abs(a[0] - b[0]) for a, b in zip(seq, coh))
+
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        seq_round()
+    t_seq = (time.perf_counter() - t0) / trials
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        cohort_round()
+    t_coh = (time.perf_counter() - t0) / trials
+
+    return {
+        "validator_k": k,
+        "validator_eval_batches": n_batches,
+        "validator_seq_round_sec": round(t_seq, 4),
+        "validator_round_sec": round(t_coh, 4),
+        "validator_round_speedup": round(t_seq / t_coh, 3),
+        "candidates_per_sec": round(k / t_coh, 2),
+        "validator_seq_dispatches": k * n_batches,
+        "validator_cohort_dispatches": n_batches,
+        "validator_dispatch_ratio": float(k),
+        "validator_parity_max_abs_err": round(float(parity_err), 6),
+    }
 
 
 def _param_count(model) -> int:
@@ -381,6 +455,13 @@ def main() -> None:
         extras.update(_time_merge(model))
     except Exception as e:
         extras["merge_error"] = repr(e)
+
+    try:
+        # batched cohort validation vs sequential score_miner (the round's
+        # tentpole): dispatch ratio is exact, wall-clock is this rig's
+        extras.update(_time_validator_round(model, cfg))
+    except Exception as e:
+        extras["validator_round_error"] = repr(e)
 
     try:
         # MFU scale point (round-2 verdict item 7): config 3's model on one

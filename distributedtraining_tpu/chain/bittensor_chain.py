@@ -161,19 +161,50 @@ class BittensorChain:
         block unboundedly on the same dead endpoint, which is exactly
         what run_with_timeout exists to prevent. The reference gets the
         same semantics by killing its forked child per call
-        (chain_manager.py:36-46)."""
-        used = {}
+        (chain_manager.py:36-46).
+
+        ``used`` is guarded by a per-call lock shared with on_timeout:
+        without it, a deadline firing while the worker is still inside
+        ``_ensure_connected`` reads conn=None, does nothing, and the
+        abandoned worker then INSTALLS the connection it was wedging on
+        as current — live, deadline-less, and reused by the next call.
+        With the lock, whichever side runs second sees the other's
+        verdict: a post-timeout worker finds ``timed_out`` set, closes
+        its connection itself, and marks the recycle. Note the chain
+        object is otherwise single-threaded per role (one engine loop
+        issues RPCs sequentially); the lock exists ONLY for this
+        worker/deadline-thread pair, not for concurrent callers."""
+        used = {"conn": None, "timed_out": False}
+        used_lock = threading.Lock()
 
         def op():
             sub = self._ensure_connected()
-            used["conn"] = sub
+            with used_lock:
+                if not used["timed_out"]:
+                    used["conn"] = sub
+                    late = False
+                else:
+                    late = True
+            if late:
+                # the deadline already fired mid-reconnect: the caller is
+                # gone, so this connection must not survive as current
+                _close_connection(sub)
+                with _RECONNECT_LOCK:
+                    if sub is self.subtensor:
+                        self._needs_reconnect = True
+                raise ChainTimeout(
+                    f"{name}: deadline fired during reconnect")
             return fn(sub)
 
         def on_timeout():
-            conn = used.get("conn")
+            with used_lock:
+                used["timed_out"] = True
+                conn = used["conn"]
             if conn is None:
-                # hung inside the reconnect itself: nothing to close; the
-                # stale flag is still set, so the next call retries
+                # hung inside the reconnect itself: nothing to close yet;
+                # the worker cleans up its own connection when (if) the
+                # reconnect returns (see ``late`` above), and the stale
+                # flag stays set so the next call retries
                 return
             _close_connection(conn)
             with _RECONNECT_LOCK:

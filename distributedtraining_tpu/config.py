@@ -120,6 +120,10 @@ class RunConfig:
     checkpoint_interval: float = 600.0       # 0 disables local checkpointing
     checkpoint_dir: Optional[str] = None     # default: <work_dir>/checkpoints/<hotkey>
     validation_interval: float = 1800.0      # validator.py:112
+    val_cohort: int = 8                      # miners scored per batched eval
+    #                                          pass (<=1 = sequential legacy)
+    val_pipeline_depth: int = 1              # cohorts staged ahead of eval
+    #                                          (0 disables fetch/eval overlap)
     averaging_interval: float = 1200.0       # averager.py:106
 
     # -- averager strategy --------------------------------------------------
@@ -412,6 +416,15 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    type=float, default=d.check_update_interval)
     g.add_argument("--validation-interval", dest="validation_interval",
                    type=float, default=d.validation_interval)
+    g.add_argument("--val-cohort", dest="val_cohort", type=int,
+                   default=d.val_cohort,
+                   help="miner deltas scored per batched eval pass "
+                        "(engine/batched_eval.py); <=1 restores the "
+                        "sequential per-miner path")
+    g.add_argument("--val-pipeline-depth", dest="val_pipeline_depth",
+                   type=int, default=d.val_pipeline_depth,
+                   help="cohorts staged (fetched+screened) ahead of device "
+                        "eval; 0 disables the fetch/eval overlap")
     g.add_argument("--averaging-interval", dest="averaging_interval",
                    type=float, default=d.averaging_interval)
 

@@ -11,9 +11,9 @@ fully static shapes.
 from .packing import pack_documents, PackedBatch
 from .datasets import (ByteTokenizer, WordTokenizer, load_tokenizer,
                        text_corpus, batch_iterator)
-from .prefetch import PrefetchIterator, prefetch
+from .prefetch import PrefetchIterator, map_prefetch, prefetch
 from .vision import image_batches, synthetic_images
 
 __all__ = ["pack_documents", "PackedBatch", "ByteTokenizer", "WordTokenizer",
            "load_tokenizer", "text_corpus", "batch_iterator", "image_batches",
-           "synthetic_images", "PrefetchIterator", "prefetch"]
+           "synthetic_images", "PrefetchIterator", "prefetch", "map_prefetch"]

@@ -119,3 +119,14 @@ def prefetch(source: Iterable, *, depth: int = 2,
         loop.run(batches, ...)
     """
     return PrefetchIterator(source, depth=depth, transform=transform)
+
+
+def map_prefetch(fn: Callable, items: Iterable, *,
+                 depth: int = 1) -> PrefetchIterator:
+    """Map ``fn`` over ``items`` on the background thread, bounded
+    ``depth`` results ahead of the consumer — the staging half of a
+    fetch/compute pipeline. The validator's cohort prefetcher
+    (engine/batched_eval.stage_cohorts) runs transport fetch + decode +
+    screening of cohort n+1 through this while the device evaluates
+    cohort n; ``close()`` stops the worker early (failed round)."""
+    return PrefetchIterator(items, depth=depth, transform=fn)

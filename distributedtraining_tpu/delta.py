@@ -256,6 +256,47 @@ def pad_merge_weights(weights: jax.Array, m_padded: int) -> jax.Array:
         [weights, jnp.zeros((m_padded - m,), weights.dtype)])
 
 
+def pad_stack(stacked: Params, k_pad: int) -> Params:
+    """Zero-pad a stacked-delta tree's leading (miner/candidate) axis up to
+    ``k_pad``. Padded slots are zero deltas: applied to a base they
+    reproduce the base exactly, so a batched evaluator's padded candidates
+    cost compute but never perturb real slots (the bucket-padding
+    discipline of engine/batched_eval.py, mirroring pad_merge_weights for
+    merges). Jittable for any fixed k_pad."""
+    k = miner_axis_size(stacked)
+    if k == k_pad:
+        return stacked
+    if k > k_pad:
+        raise ValueError(f"cannot pad a {k}-entry stack down to {k_pad}")
+
+    def pad_leaf(x):
+        return jnp.concatenate(
+            [x, jnp.zeros((k_pad - k,) + x.shape[1:], x.dtype)], axis=0)
+
+    return jax.tree_util.tree_map(pad_leaf, stacked)
+
+
+def combine_candidate_deltas(stacked: Params, weight_matrix: jax.Array
+                             ) -> Params:
+    """[P, M] mixing matrix x [M, ...]-stacked deltas -> [P, ...]-stacked
+    CANDIDATE deltas: candidate p's delta is ``sum_i W[p, i] * delta_i``.
+
+    This is how a population of merge-weight vectors (GeneticMerge) becomes
+    one cohort for the batched evaluator: every row is the delta of one
+    candidate mixture, and ``base + candidate_delta[p]`` equals
+    ``weighted_merge(base, stacked, W[p])`` exactly (same contraction, f32
+    accumulation against a f32 base happens at apply time). Jittable;
+    materializes P x params, so single-device use only at small P."""
+    def leaf(d):
+        # contract in f32 and KEEP f32: rounding the combined delta back to
+        # a bf16 wire stack's dtype would perturb the candidate relative to
+        # weighted_merge's f32-accumulated result
+        w = weight_matrix.astype(jnp.float32)
+        return jnp.einsum("pm,m...->p...", w, d.astype(jnp.float32))
+
+    return jax.tree_util.tree_map(leaf, stacked)
+
+
 def unstack_deltas(stacked: Params) -> list[Params]:
     n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
     return [jax.tree_util.tree_map(lambda x: x[i], stacked) for i in range(n)]

@@ -10,6 +10,7 @@ pattern made first-class (SURVEY.md §4).
 from .scheduler import Clock, RealClock, FakeClock, PeriodicAction
 from .train import TrainEngine, MinerLoop, TrainState, default_optimizer
 from .lora_train import LoRAEngine, LoRAMinerLoop, fetch_delta_any
+from .batched_eval import BatchedCohortEvaluator, stage_cohorts
 from .validate import Validator
 from .average import (
     AveragerLoop,
@@ -23,6 +24,7 @@ __all__ = [
     "Clock", "RealClock", "FakeClock", "PeriodicAction",
     "TrainEngine", "MinerLoop", "TrainState", "default_optimizer",
     "LoRAEngine", "LoRAMinerLoop", "fetch_delta_any",
+    "BatchedCohortEvaluator", "stage_cohorts",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
     "OuterOptMerge",

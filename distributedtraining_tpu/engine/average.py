@@ -337,7 +337,7 @@ class GeneticMerge:
 
     def __init__(self, *, population: int = 10, generations: int = 10,
                  sigma: float = 0.1, elite: int = 2, seed: int = 0,
-                 screen_batches: int | None = 2):
+                 screen_batches: int | None = 2, batched: bool = True):
         self.population = population
         self.generations = generations
         self.sigma = sigma
@@ -349,6 +349,15 @@ class GeneticMerge:
             raise ValueError("screen_batches must be >= 1 or None "
                              f"(full-set fitness), got {screen_batches}")
         self.screen_batches = screen_batches
+        # ``batched``: score each tier's UNCACHED candidates through the
+        # batched cohort evaluator (engine/batched_eval.py) — the whole
+        # population rides one stacked program per val batch instead of
+        # population sequential eval passes per generation. Single-device
+        # stacks only: the [P, M] x [M, params] candidate expansion
+        # materializes P x params, which the chunked/mesh ingest paths
+        # exist to avoid (they keep the sequential tiers).
+        self.batched = batched
+        self._pop_evaluator: tuple | None = None  # (engine, evaluator)
 
     def merge(self, engine, base: Params, stacked: Params, miner_ids: list[str],
               *, val_batches: Callable[[], Iterable[dict]],
@@ -370,6 +379,16 @@ class GeneticMerge:
         # weight-vector bytes
         cache: dict[tuple[bytes, bool], float] = {}
 
+        evaluator = None
+        if (self.batched and not isinstance(stacked, list)
+                and getattr(engine, "mesh", None) is None):
+            from .batched_eval import BatchedCohortEvaluator
+            if (self._pop_evaluator is None
+                    or self._pop_evaluator[0] is not engine):
+                self._pop_evaluator = (engine,
+                                       BatchedCohortEvaluator(engine))
+            evaluator = self._pop_evaluator[1]
+
         def _eval(w, *, full: bool) -> float:
             key = (np.asarray(w).tobytes(), full)
             if key not in cache:
@@ -380,6 +399,35 @@ class GeneticMerge:
                                           batches)
                 cache[key] = loss
             return cache[key]
+
+        def _eval_many(ws, *, full: bool) -> None:
+            """Fill the cache for every uncached vector in ``ws`` — as ONE
+            candidate cohort per val batch when the batched evaluator is
+            available (each candidate's delta is its weighted mixture of
+            the miner stack, delta.combine_candidate_deltas), else by the
+            per-candidate sequential spelling."""
+            uniq, seen = [], set()
+            for w in ws:
+                k = np.asarray(w).tobytes()
+                if (k, full) not in cache and k not in seen:
+                    seen.add(k)
+                    uniq.append(w)
+            if not uniq:
+                return
+            if evaluator is None or len(uniq) == 1:
+                for w in uniq:
+                    _eval(w, full=full)
+                return
+            W = jnp.stack([delta_lib.pad_merge_weights(jnp.asarray(w), m_pad)
+                           for w in uniq])
+            cands = delta_lib.combine_candidate_deltas(stacked, W)
+            batches = val_batches()
+            if not full and self.screen_batches is not None:
+                batches = itertools.islice(batches, self.screen_batches)
+            scored = evaluator.evaluate_stacked(base, cands, len(uniq),
+                                                batches)
+            for w, (loss, _) in zip(uniq, scored):
+                cache[(np.asarray(w).tobytes(), full)] = loss
 
         def screen(w) -> float:   # cheap ranking tier
             return _eval(w, full=self.screen_batches is None)
@@ -393,7 +441,9 @@ class GeneticMerge:
             pop.append(jax.nn.softmax(jax.random.normal(k, (m,))))
         elites: list = []  # --genetic-generations 0 = pick best of the
         for gen in range(self.generations):  # initial population below
+            _eval_many(pop, full=self.screen_batches is None)
             scored = sorted(pop, key=screen)
+            _eval_many(scored[: self.elite * 2], full=True)
             elites = sorted(scored[: self.elite * 2],
                             key=fitness)[: self.elite]
             children = list(elites)
@@ -409,7 +459,9 @@ class GeneticMerge:
         # generation's elites — their full-set losses are already cached,
         # so including them costs nothing and guarantees a noisy final
         # screening batch can never discard the known full-eval best
+        _eval_many(pop, full=self.screen_batches is None)
         finalists = sorted(pop, key=screen)[: max(self.elite, 2)] + elites
+        _eval_many(finalists, full=True)
         best = min(finalists, key=fitness)
         return merge_fn(base, stacked, best), best
 

@@ -183,6 +183,13 @@ class TrainEngine:
                 "mesh sharding assumes causal-LM batches ([B, T] input_ids) "
                 "and LM parameter axis names; run custom-loss models "
                 "unsharded (mesh=None)")
+        # the PLAIN task loss (no fusion, no ambient mesh/rules): the
+        # batched cohort evaluator (engine/batched_eval.py) traces this
+        # inside its own vmap/shard_map programs, where a nested
+        # fused-loss shard_map or an in-model sharding constraint would
+        # fight the candidate-sharded spelling. Same math as the resolved
+        # loss to fp tolerance (the fused CE is pinned to the dense oracle).
+        self._plain_task_loss = loss_fn or _default_lm_loss
         if fused_loss:
             if loss_fn is not None:
                 raise ValueError("fused_loss and a custom loss_fn are "
@@ -204,15 +211,24 @@ class TrainEngine:
                 # "auto" resolves per backend. Leaving the scan spelling
                 # to GSPMD instead re-materializes full-vocab buffers at
                 # 8B scale (measured, scripts/scale_aot.py).
-                if any(mesh.shape.get(a, 1) > 1
-                       for a in mesh.axis_names
-                       if a not in ("dp", "fsdp", "tp", "sp")):
-                    raise ValueError(
+                exotic = [a for a in mesh.axis_names
+                          if a not in ("dp", "fsdp", "tp", "sp")
+                          and mesh.shape.get(a, 1) > 1]
+                if exotic:
+                    # soft fallback, not a construction-time raise: a role
+                    # wired onto a research mesh (custom axis names) should
+                    # run correct-but-unfused rather than refuse to boot —
+                    # the fused path is a perf lever, not a semantic one
+                    logger.warning(
                         "fused_loss composes with dp/fsdp/tp/sp meshes "
-                        "only; run other axes unfused")
-                loss_mesh = mesh
-            loss_fn = functools.partial(_fused_lm_loss, impl=impl,
-                                        mesh=loss_mesh)
+                        "only; mesh axes %s are unsupported — falling back "
+                        "to the unfused (materialized-logits) loss", exotic)
+                    fused_loss = False
+                else:
+                    loss_mesh = mesh
+            if fused_loss:
+                loss_fn = functools.partial(_fused_lm_loss, impl=impl,
+                                            mesh=loss_mesh)
         self.model = model
         self.tx = optimizer or default_optimizer()
         self.mesh = mesh

@@ -51,7 +51,9 @@ class Validator:
                  metrics=None,
                  lora_cfg=None,
                  accept_quant: bool = True,
-                 stale_deltas: str = "accept"):
+                 stale_deltas: str = "accept",
+                 cohort_size: int = 8,
+                 pipeline_depth: int = 1):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -76,6 +78,20 @@ class Validator:
             raise ValueError(f"stale_deltas must be 'skip' or 'accept', "
                              f"got {stale_deltas!r}")
         self.stale_deltas = stale_deltas
+        # Batched cohort scoring (engine/batched_eval.py): score up to
+        # ``cohort_size`` screened deltas per eval pass — eval batches are
+        # read/placed once per COHORT instead of once per miner, and the
+        # per-round eval dispatch count drops ~cohort_size-fold.
+        # ``pipeline_depth`` > 0 additionally overlaps transport fetch +
+        # decode + screening of cohort n+1 with device eval of cohort n
+        # (single-host only; pods stage inline to keep broadcast
+        # collectives deterministic). cohort_size <= 1 restores the
+        # sequential score_miner path exactly.
+        if cohort_size < 0:
+            raise ValueError(f"cohort_size must be >= 0, got {cohort_size}")
+        self.cohort_size = cohort_size
+        self.pipeline_depth = pipeline_depth
+        self._cohort_eval = None
         # accept adapter-tree submissions alongside full-param deltas
         # (engine/lora_train.py fetch_delta_any)
         self.lora_cfg = lora_cfg
@@ -150,10 +166,24 @@ class Validator:
         self.base_params = self.engine.place_params(base)
         self._eval_base()
 
+    def _evaluator(self):
+        if self._cohort_eval is None:
+            from .batched_eval import BatchedCohortEvaluator
+            self._cohort_eval = BatchedCohortEvaluator(self.engine)
+        return self._cohort_eval
+
     def _eval_base(self) -> None:
-        # full eval pass at startup/base-change (validation_logic.py:48)
-        self.base_loss, self.base_ppl = self.engine.evaluate(
-            self.base_params, self.eval_batches())
+        # full eval pass at startup/base-change (validation_logic.py:48).
+        # With cohort scoring on, the base folds into slot 0 of the same
+        # bucket-cached cohort program (a zero delta), so a base refresh
+        # never compiles or dispatches a separate eval path.
+        if self.cohort_size > 1:
+            (self.base_loss, self.base_ppl), = self._evaluator(
+                ).evaluate_cohort(self.base_params, [], self.eval_batches(),
+                                  include_base=True)
+        else:
+            self.base_loss, self.base_ppl = self.engine.evaluate(
+                self.base_params, self.eval_batches())
         logger.info("validator: base loss=%.4f ppl=%.2f",
                     self.base_loss, self.base_ppl)
 
@@ -230,23 +260,64 @@ class Validator:
         return stale_submission(self.transport, hotkey,
                                 self._base_revision, multi=self._multi())
 
-    def score_miner(self, hotkey: str) -> MinerScore:
+    def _stage_miner(self, hotkey: str):
+        """Fetch + screen one submission — the host-side staging shared by
+        the sequential and batched paths (and what the cohort pipeline
+        overlaps with device eval). Returns (hotkey, delta|None, reason)."""
         if self.stale_deltas == "skip" and self._is_stale(hotkey):
-            return MinerScore(hotkey, 0.0, reason="stale_base")
+            return hotkey, None, "stale_base"
         d = self._fetch_delta(hotkey)
         if d is None:
-            return MinerScore(hotkey, 0.0, reason="no_delta")
+            return hotkey, None, "no_delta"
         ok, reason = delta_lib.screen_delta(d, self.base_params,
                                             max_abs=self.max_delta_abs)
         if not ok:
-            return MinerScore(hotkey, 0.0, reason=reason)
-        candidate = delta_lib.apply_delta(self.base_params, d)
-        loss, ppl = self.engine.evaluate(candidate, self.eval_batches())
+            return hotkey, None, reason
+        return hotkey, d, "ok"
+
+    def _score_from(self, hotkey: str, loss: float, ppl: float) -> MinerScore:
         if self.metric == "perplexity":
             score = max(0.0, (self.base_ppl or 0.0) - ppl)
         else:
             score = max(0.0, (self.base_loss or 0.0) - loss)
         return MinerScore(hotkey, score, loss=loss, perplexity=ppl)
+
+    def score_miner(self, hotkey: str) -> MinerScore:
+        hotkey, d, reason = self._stage_miner(hotkey)
+        if d is None:
+            return MinerScore(hotkey, 0.0, reason=reason)
+        candidate = delta_lib.apply_delta(self.base_params, d)
+        loss, ppl = self.engine.evaluate(candidate, self.eval_batches())
+        return self._score_from(hotkey, loss, ppl)
+
+    def _score_cohorts(self, hotkeys: list[str]) -> list[MinerScore]:
+        """Batched scoring: stage cohorts of ``cohort_size`` submissions
+        (pipelined against device eval off-pod), then score each cohort's
+        valid deltas in one stacked program per eval batch."""
+        from .batched_eval import stage_cohorts
+        evaluator = self._evaluator()
+        pipeline = self.pipeline_depth > 0 and not self._multi()
+        results: list[MinerScore] = []
+        staged = stage_cohorts(hotkeys, self.cohort_size, self._stage_miner,
+                               pipeline=pipeline,
+                               depth=max(self.pipeline_depth, 1))
+        try:
+            for cohort in staged:
+                valid = [(h, d) for h, d, _ in cohort if d is not None]
+                results.extend(MinerScore(h, 0.0, reason=r)
+                               for h, d, r in cohort if d is None)
+                if not valid:
+                    continue
+                scored = evaluator.evaluate_cohort(
+                    self.base_params, [d for _, d in valid],
+                    self.eval_batches())
+                results.extend(self._score_from(h, loss, ppl)
+                               for (h, _), (loss, ppl) in zip(valid, scored))
+        finally:
+            close = getattr(staged, "close", None)
+            if close is not None:  # stop the stager early on a failed round
+                close()
+        return results
 
     def _synced_metagraph(self):
         """Round-start metagraph: coordinator's snapshot broadcast on a pod
@@ -263,11 +334,11 @@ class Validator:
         validation_logic.py:99-189)."""
         meta = self._synced_metagraph()
         self._maybe_refresh_base()
-        results: list[MinerScore] = []
-        for hotkey in meta.hotkeys:
-            if hotkey == self.chain.my_hotkey:
-                continue
-            results.append(self.score_miner(hotkey))
+        others = [h for h in meta.hotkeys if h != self.chain.my_hotkey]
+        if self.cohort_size > 1:
+            results = self._score_cohorts(others)
+        else:
+            results = [self.score_miner(h) for h in others]
         scored = {s.hotkey: s.score for s in results}
         if self.metrics:
             # BOUNDED metric-name cardinality: the reference logged

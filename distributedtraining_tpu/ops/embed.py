@@ -30,17 +30,36 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_warned_no_thread_resources = False
+
+
 def _ambient_mesh_needs_matmul_bwd() -> bool:
     """True when the mesh active during tracing has both dp>1 and fsdp>1 —
     the configuration whose gather-backward reshard GSPMD cannot express
     (see module docstring)."""
     try:
-        # the `with mesh:` context reader; public spelling
-        # (jax.interpreters.pxla.thread_resources) deprecated in 0.8.2
-        # with no public replacement for the legacy context
-        from jax._src.mesh import thread_resources
-    except ImportError:  # pragma: no cover — older jax
-        from jax.interpreters.pxla import thread_resources
+        try:
+            # the `with mesh:` context reader; public spelling
+            # (jax.interpreters.pxla.thread_resources) deprecated in 0.8.2
+            # with no public replacement for the legacy context
+            from jax._src.mesh import thread_resources
+        except ImportError:  # pragma: no cover — older jax
+            from jax.interpreters.pxla import thread_resources
+    except ImportError:  # pragma: no cover — future jax relocation
+        # both private spellings gone: degrade to the default scatter
+        # backward (correct everywhere, slower on dp x fsdp meshes)
+        # instead of raising out of every embedding TRACE — an import
+        # error here would take down single-device runs that never
+        # needed the probe at all
+        global _warned_no_thread_resources
+        if not _warned_no_thread_resources:
+            _warned_no_thread_resources = True
+            import logging
+            logging.getLogger(__name__).warning(
+                "jax no longer exposes thread_resources at either known "
+                "path; embedding backward keeps the scatter spelling "
+                "(involuntary-remat risk returns on dp x fsdp meshes)")
+        return False
     mesh = thread_resources.env.physical_mesh
     if mesh.empty:
         return False

@@ -86,11 +86,16 @@ class Components:
         logger.info("loading pretrained weights from %s", self.cfg.init_from)
         return convert.load_params(self.cfg.init_from, self.model_cfg)
 
-    def eval_batches(self) -> Callable[[], Iterable[dict]]:
-        """Factory over a fixed held-out shard (the reference evaluates the
-        first ~100 test texts, neurons/validator.py:49,98)."""
-        docs = text_corpus(split="test", source=self.cfg.dataset,
-                           n_docs=max(256, self.cfg.n_docs // 8))
+    _test_docs_cache = None
+
+    def _test_docs(self) -> list[str]:
+        if self._test_docs_cache is None:
+            self._test_docs_cache = text_corpus(
+                split="test", source=self.cfg.dataset,
+                n_docs=max(256, self.cfg.n_docs // 8))
+        return self._test_docs_cache
+
+    def _batches_over(self, docs) -> Callable[[], Iterable[dict]]:
         cfg = self.cfg
 
         def factory():
@@ -104,6 +109,37 @@ class Components:
                 yield b
 
         return factory
+
+    def eval_batches(self) -> Callable[[], Iterable[dict]]:
+        """SERVER-side held-out shard (validator scoring, averager
+        meta-learning/publish guard): the FRONT half of the test split —
+        the reference evaluates the first ~100 test texts
+        (neurons/validator.py:49,98). The back half is reserved for miner
+        self-validation (``miner_val_batches``), keeping the two roles'
+        eval data disjoint."""
+        docs = self._test_docs()
+        return self._batches_over(docs[: max(1, len(docs) // 2)]
+                                  if len(docs) >= 4 else docs)
+
+    def miner_val_batches(self) -> Callable[[], Iterable[dict]]:
+        """Miner self-validation shard: a per-hotkey-offset rotation of the
+        BACK half of the test split, disjoint from the validator's shard
+        (round-5 advisor: a miner guarding on the IDENTICAL shard the
+        validator scores biases its published state toward that shard by
+        selection — its score reads high by construction). The per-hotkey
+        rotation additionally decorrelates which windows different miners
+        overfit toward, like shuffle_seed_for does for train order."""
+        docs = self._test_docs()
+        if len(docs) < 4:
+            logger.warning(
+                "test split too small (%d docs) to give the miner a "
+                "disjoint self-eval shard; guard evals will share the "
+                "validator's data", len(docs))
+            tail = docs
+        else:
+            tail = docs[len(docs) // 2:]
+        off = shuffle_seed_for(self.cfg.hotkey) % len(tail)
+        return self._batches_over(tail[off:] + tail[:off])
 
 
 def build(cfg: RunConfig) -> Components:

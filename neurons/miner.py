@@ -32,14 +32,19 @@ def _guard_kwargs(cfg, c) -> dict:
     """Self-validation-guard wiring, shared by the full-param and LoRA
     branches. 0 disables; negative follows --send-interval (and disables
     when that is non-positive — push-every-step runs would eval every
-    step and revert on per-step noise)."""
+    step and revert on per-step noise).
+
+    The guard evals run on the miner's OWN disjoint slice of the test
+    split (Components.miner_val_batches), never the validator's shard:
+    keeping best-seen state by the exact data it is scored on would bias
+    published scores upward by selection (round-5 advisor)."""
     if cfg.self_eval_interval == 0:
         return {}
     interval = (cfg.self_eval_interval if cfg.self_eval_interval > 0
                 else cfg.send_interval)
     if interval <= 0:
         return {}
-    return dict(val_batches=c.eval_batches(),
+    return dict(val_batches=c.miner_val_batches(),
                 val_guard_interval=interval,
                 val_guard_patience=cfg.self_eval_patience,
                 val_guard_margin=cfg.self_eval_margin)

@@ -39,7 +39,9 @@ def main(argv=None) -> int:
                           max_delta_abs=cfg.max_delta_abs,
                           metrics=c.metrics, lora_cfg=c.lora_cfg,
                           accept_quant=cfg.accept_quant,
-                          stale_deltas=cfg.stale_deltas or "accept")
+                          stale_deltas=cfg.stale_deltas or "accept",
+                          cohort_size=cfg.val_cohort,
+                          pipeline_depth=cfg.val_pipeline_depth)
     # the reference gates weight-setting to staked validators
     # (btt_connector.py:358-385); refuse up front instead of silently
     # burning eval compute on scores no one will ever see. On a pod the

@@ -69,6 +69,25 @@ def test_time_merge_reports_all_spellings(tiny):
     assert out["sparse8_vs_f32_bytes"] > 4  # beats even dense int8's 4x
 
 
+def test_time_validator_round_ab(tiny):
+    """The cohort-vs-sequential validator A/B (ISSUE 1 acceptance): the
+    dispatch-count reduction is exact and >= 2x at K=4, the cohort path's
+    wall-clock beats the sequential spelling even on CPU (the contrast is
+    dispatch/placement overhead, present on every backend), and the two
+    paths agree numerically."""
+    model, cfg = tiny
+    out = bench._time_validator_round(model, cfg, k=4, n_batches=3,
+                                      trials=2)
+    for key in ("validator_round_sec", "validator_seq_round_sec",
+                "candidates_per_sec", "validator_round_speedup"):
+        assert key in out and out[key] > 0, out
+    assert out["validator_seq_dispatches"] == 12
+    assert out["validator_cohort_dispatches"] == 3
+    assert out["validator_dispatch_ratio"] >= 2.0
+    assert out["validator_round_speedup"] > 1.0, out
+    assert out["validator_parity_max_abs_err"] < 1e-4
+
+
 def test_peak_flops_ladder(monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
     assert bench._peak_flops() == 197e12

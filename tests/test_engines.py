@@ -671,8 +671,13 @@ def test_genetic_merge_successive_halving_cuts_full_evals(setup, tmp_path):
                 yield b
         return gen()
 
+    # batched=False pins the SEQUENTIAL tiers' cost model (batches read
+    # is proportional to candidates evaluated); the batched population
+    # eval reads each batch once per COHORT, which collapses this
+    # accounting — its selection parity is pinned in
+    # tests/test_batched_eval.py instead
     g = GeneticMerge(population=6, generations=3, elite=2,
-                     screen_batches=1)
+                     screen_batches=1, batched=False)
     merged, w = g.merge(engine, base, stacked, ["a", "b"],
                         val_batches=counted_batches)
     halved = consumed["batches"]
@@ -682,7 +687,7 @@ def test_genetic_merge_successive_halving_cuts_full_evals(setup, tmp_path):
 
     consumed["batches"] = 0
     g_full = GeneticMerge(population=6, generations=3, elite=2,
-                          screen_batches=None)
+                          screen_batches=None, batched=False)
     g_full.merge(engine, base, stacked, ["a", "b"],
                  val_batches=counted_batches)
     # the real cost is batches evaluated: screening reads 1 batch per
