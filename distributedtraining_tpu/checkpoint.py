@@ -71,6 +71,9 @@ class CheckpointStore:
                 enable_async_checkpointing=False,
             ),
         )
+        # async lane (save_async): created on first use so sync-only
+        # stores never own a thread
+        self._async_worker = None
 
     # -- write --------------------------------------------------------------
     def save(self, step: int, snapshot: Snapshot) -> None:
@@ -88,7 +91,46 @@ class CheckpointStore:
                      "has_base": snapshot.base_params is not None}),
             ),
         )
+        # Orbax finalizes each step directory with an atomic rename — a
+        # reader (or a restore after a crash mid-save) never sees a torn
+        # checkpoint, the same commit discipline as serialization.save_file.
+        # wait_until_finished keeps that contract synchronous HERE; the
+        # async spelling moves this whole call onto the worker instead.
         self._mgr.wait_until_finished()
+
+    def save_async(self, snapshot: Snapshot, *,
+                   precondition=None) -> None:
+        """Queue ``save`` on the store's background worker (single-slot
+        SUPERSEDE queue, engine/publish.py machinery): a pending save that
+        has not started when the next one arrives is dropped — only the
+        newest state matters, exactly like delta pushes. The caller must
+        hand over an independent snapshot (device copies — the training
+        loop's live state gets donated out from under a background reader).
+
+        ``precondition`` runs on the worker immediately before the write
+        and aborts the save when it returns False (the miner's non-finite
+        screen: the flag's device fetch then happens off-thread). The step
+        number is resolved ON the worker via ``next_step()`` — at submit
+        time a still-committing predecessor would alias its number. A
+        failed save is logged, never raised (same contract as the miner's
+        sync path: a failed save must not kill training)."""
+        if self._async_worker is None:
+            from .engine.publish import PublishWorker
+            self._async_worker = PublishWorker(
+                name=f"ckpt-save-{os.path.basename(self.directory)}")
+
+        def job():
+            if precondition is not None and not precondition():
+                return
+            self.save(self.next_step(), snapshot)
+
+        self._async_worker.submit(job)
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Drain pending + in-flight async saves (True when drained)."""
+        if self._async_worker is None:
+            return True
+        return self._async_worker.flush(timeout=timeout)
 
     def next_step(self) -> int:
         """Next free checkpoint key. Keys are a monotonic save sequence, NOT
@@ -146,6 +188,11 @@ class CheckpointStore:
         )
 
     def close(self) -> None:
+        if self._async_worker is not None:
+            # drain first: closing the manager under an in-flight save
+            # would turn the newest checkpoint into a logged failure
+            self._async_worker.close()
+            self._async_worker = None
         self._mgr.close()
 
     def __enter__(self):

@@ -106,6 +106,12 @@ class RunConfig:
 
     # -- cadences (seconds) -------------------------------------------------
     send_interval: float = 800.0             # miner.py:125
+    # async publication pipeline (engine/publish.py): overlap the miner's
+    # delta push / rider / checkpoint I/O with training compute; a push
+    # still in flight at the next interval is superseded, never queued.
+    # --no-push-async restores the fully sequential reference path.
+    push_async: bool = True
+    push_queue_depth: int = 1                # pending pushes before supersede
     check_update_interval: float = 300.0
     # miner self-validation guard: the miner scores its own candidate on
     # the held-out shard every ``self_eval_interval`` seconds and reverts
@@ -388,6 +394,25 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g = p.add_argument_group("cadence")
     g.add_argument("--send-interval", dest="send_interval", type=float,
                    default=d.send_interval)
+    if role == "miner":  # only the miner runs the publication pipeline
+        g.add_argument("--push-async", dest="push_async",
+                       action="store_true", default=d.push_async,
+                       help="overlap delta publication (device->host "
+                            "transfer, serialization, upload, meta rider) "
+                            "and checkpoint I/O with training compute on a "
+                            "background worker; an in-flight push is "
+                            "superseded by the next interval's, never "
+                            "queued behind (default on)")
+        g.add_argument("--no-push-async", dest="push_async",
+                       action="store_false",
+                       help="restore the fully sequential publish path "
+                            "(the reference's blocking upload semantics)")
+        g.add_argument("--push-queue-depth", dest="push_queue_depth",
+                       type=int, default=d.push_queue_depth,
+                       help="pushes the publisher may hold pending before "
+                            "the oldest is superseded (each artifact is "
+                            "the whole cumulative delta, so >1 only delays "
+                            "supersession; default 1)")
     g.add_argument("--self-eval-interval", dest="self_eval_interval",
                    type=float, default=d.self_eval_interval,
                    help="miner self-validation cadence in seconds; -1 = "

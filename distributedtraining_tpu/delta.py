@@ -73,11 +73,22 @@ def zeros_like(a: Params) -> Params:
 # Screening of untrusted submissions
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _any_nonfinite(tree: Params) -> jax.Array:
+def tree_finite(tree: Params) -> jax.Array:
+    """Scalar bool array: True when EVERY leaf is finite. The jittable
+    body of the finiteness screen — publishers fuse it into their jitted
+    snapshot programs (MinerLoop's delta+wire+compress program returns the
+    delta AND this flag from ONE program), so the screen costs no separate
+    dispatch or host round-trip on the push path. Float leaves only are
+    screened; integer leaves are finite by construction."""
     flags = [jnp.any(~jnp.isfinite(leaf))
-             for leaf in jax.tree_util.tree_leaves(tree)]
-    return jnp.any(jnp.stack(flags))
+             for leaf in jax.tree_util.tree_leaves(tree)
+             if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.inexact)]
+    if not flags:
+        return jnp.asarray(True)
+    return jnp.logical_not(jnp.any(jnp.stack(flags)))
+
+
+_tree_finite_jit = jax.jit(tree_finite)
 
 
 def has_nonfinite(tree: Params) -> bool:
@@ -87,7 +98,7 @@ def has_nonfinite(tree: Params) -> bool:
     ~150-leaf model would issue ~150 gloo/ICI round-trips per screen."""
     if not jax.tree_util.tree_leaves(tree):
         return False
-    return bool(jax.device_get(_any_nonfinite(tree)))
+    return not bool(jax.device_get(_tree_finite_jit(tree)))
 
 
 def shapes_match(tree: Params, reference: Params, *, check_dtype: bool = False,

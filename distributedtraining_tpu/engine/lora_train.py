@@ -221,26 +221,25 @@ class LoRAMinerLoop(MinerLoop):
         return float(total) / float(count)
 
     # -- the artifact -------------------------------------------------------
-    def _push_delta(self) -> None:
-        if self.state is None:
-            return
-        adapters = self.state.params
-        if self.nan_guard and delta_lib.has_nonfinite(adapters):
-            logger.warning("lora miner %s: non-finite adapters, not pushing",
-                           self.miner_id)
-            return
-        try:
-            # adapter trees mirror the base structure, so the same wire
-            # normalization applies: a scan_blocks LoRA miner's stacked
-            # [L, in, r]/[L, r, out] factors unstack to the universal
-            # per-block wire layout (train.py wire_out)
-            from .train import wire_out
-            self.transport.publish_delta(self.miner_id,
-                                         wire_out(self.engine, adapters))
-            self._publish_meta()  # base-revision rider (MinerLoop)
-            self.report.pushes += 1
-        except Exception:
-            logger.exception("lora miner %s: push failed", self.miner_id)
+    def _build_push_snapshot(self):
+        """LoRA spelling of the push snapshot program (MinerLoop hook):
+        the artifact IS the adapter tree — no delta subtraction, no wire
+        compression (--delta-dtype is a full-param knob) — so the program
+        is wire_out + the fused finiteness screen over the adapters.
+        Adapter trees mirror the base structure, so the same wire
+        normalization applies: a scan_blocks LoRA miner's stacked
+        [L, in, r]/[L, r, out] factors unstack to the universal per-block
+        wire layout (train.py wire_out)."""
+        from .train import wire_out
+        engine = self.engine
+
+        def snap(adapters):
+            return wire_out(engine, adapters), delta_lib.tree_finite(adapters)
+
+        return snap
+
+    def _push_snapshot(self):
+        return self._push_program()(self.state.params)
 
     # -- the loop (base is a step argument here) ----------------------------
     def _train_one(self, batch) -> dict:

@@ -1,0 +1,309 @@
+"""Asynchronous miner publication pipeline.
+
+The miner's push path used to stall the training loop for its entire
+duration every ``send_interval``: a host sync for the NaN screen, a
+device->host transfer of the full delta, msgpack serialization, a temp-file
+write, and a blocking upload (the reference pays the same tax at its upload
+cadence, training_manager.py:345-433). At TPU scale the standard lever is
+to hide host/network I/O behind accelerator compute — this module is the
+miner-side twin of the validator's fetch/eval pipeline
+(engine/batched_eval.stage_cohorts).
+
+Division of labor:
+
+- the TRAINING thread runs ONE jitted snapshot program (delta + wire
+  layout + compression + finite flag, non-donated outputs — built by
+  MinerLoop) and hands the device arrays to a :class:`SupersedeQueue`;
+  dispatch is asynchronous, so the step cadence never waits on transport
+- the PUBLISHER worker does everything with host cost off-thread: the
+  finite-flag fetch, device->host transfer, serialization,
+  ``transport.publish_delta``, and the base-revision rider — with bounded
+  jittered-backoff retries (transport/retry.py)
+- a push still in flight when the next interval fires is SUPERSEDED,
+  never queued behind: each artifact is the whole cumulative delta, so
+  only the newest matters (the same replace-don't-accumulate rule as the
+  wire formats themselves, delta.py)
+
+Pod rule (multi-host SPMD): the snapshot program, the flag fetch, and the
+host materialization of cross-process-sharded arrays are collectives or
+synced decisions — they stay on the training thread at the loop barrier
+(MinerLoop hands this queue an already-host tree); only the coordinator's
+upload itself runs here. ``flush()`` drains in-flight work so shutdown and
+e2e round semantics are unchanged from the sequential path.
+
+The same worker machinery drives async checkpoint saves
+(checkpoint.CheckpointStore.save_async).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+_CLOSED = object()
+
+
+class SupersedeQueue:
+    """Bounded single-producer/single-consumer handoff where NEWEST wins.
+
+    ``offer`` never blocks: when ``depth`` items are already pending, the
+    OLDEST pending item is dropped (superseded). An item the consumer has
+    already taken is never superseded — it completes. ``wait_drained``
+    blocks until nothing is pending AND nothing is in flight (the flush
+    primitive)."""
+
+    def __init__(self, depth: int = 1):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._depth = depth
+        self._items: deque = deque()
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self._closed = False
+
+    def offer(self, item) -> int:
+        """Enqueue ``item``; returns how many pending items it superseded
+        (0 or 1 at depth 1). No-op (returns 0) after close."""
+        with self._cv:
+            if self._closed:
+                return 0
+            dropped = 0
+            while len(self._items) >= self._depth:
+                self._items.popleft()
+                dropped += 1
+            self._items.append(item)
+            self._cv.notify_all()
+            return dropped
+
+    def take(self, timeout: float | None = None):
+        """Next item (marks it in flight — pair with ``task_done``), or
+        ``_CLOSED`` once closed and empty, or None on timeout."""
+        with self._cv:
+            while not self._items:
+                if self._closed:
+                    return _CLOSED
+                if not self._cv.wait(timeout=timeout):
+                    return None
+            self._in_flight += 1
+            return self._items.popleft()
+
+    def task_done(self) -> None:
+        with self._cv:
+            self._in_flight -= 1
+            self._cv.notify_all()
+
+    def wait_drained(self, timeout: float | None = None) -> bool:
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: not self._items and self._in_flight == 0,
+                timeout=timeout)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+class PublishWorker:
+    """One DAEMON thread draining a SupersedeQueue of zero-arg jobs.
+
+    A job exception is logged and reported to ``on_error``, never
+    propagated — a failed upload must not kill training (the reference's
+    rule, training_manager.py:410-431), and a poisoned job must not wedge
+    the queue. Daemon: a worker blocked in a hung upload at interpreter
+    exit must not block shutdown (the run loop's flush() is the orderly
+    path; see the leaked-thread guard in tests/conftest.py)."""
+
+    def __init__(self, name: str = "publisher", *, depth: int = 1,
+                 on_error: Optional[Callable[[BaseException], None]] = None):
+        self._q = SupersedeQueue(depth)
+        self._on_error = on_error
+        self._name = name
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self.jobs_run = 0
+        self.jobs_failed = 0
+        self.jobs_superseded = 0
+
+    def submit(self, job: Callable[[], None]) -> int:
+        """Queue ``job``; returns how many pending jobs it superseded.
+        The worker thread starts lazily on first submit, so loops that
+        never go async never own a thread."""
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._run,
+                                                name=self._name, daemon=True)
+                self._thread.start()
+        dropped = self._q.offer(job)
+        self.jobs_superseded += dropped
+        return dropped
+
+    def _run(self) -> None:
+        while True:
+            job = self._q.take()
+            if job is _CLOSED:
+                return
+            if job is None:
+                continue
+            try:
+                job()
+                self.jobs_run += 1
+            except BaseException as e:  # noqa: BLE001 - worker must survive
+                self.jobs_failed += 1
+                logger.exception("%s: background job failed", self._name)
+                if self._on_error is not None:
+                    try:
+                        self._on_error(e)
+                    except Exception:
+                        pass
+            finally:
+                self._q.task_done()
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Block until every pending AND in-flight job has completed
+        (failed jobs count as completed — they were logged/counted)."""
+        return self._q.wait_drained(timeout=timeout)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Drain, then stop the worker thread. Idempotent."""
+        self._q.wait_drained(timeout=timeout)
+        self._q.close()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+
+
+def host_materialize(tree: Params) -> Params:
+    """Host-complete numpy copy of a (possibly device, possibly
+    cross-process-sharded) pytree. On leaves sharded across processes this
+    runs a process_allgather — a COLLECTIVE: on a pod it must execute on
+    every process at the loop barrier, which is why MinerLoop calls it
+    on-thread before handing a pod push to the background worker (the
+    single-host fast path is a plain device_get and may run anywhere)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not all(getattr(l, "is_fully_addressable", True) for l in leaves):
+        from jax.experimental import multihost_utils
+        tree = multihost_utils.process_allgather(tree, tiled=True)
+    return jax.device_get(tree)
+
+
+class DeltaPublisher:
+    """The miner's publication lane: one implementation of the
+    screen -> transfer -> publish -> rider sequence, runnable either
+    inline (``publish_now``, the --push-async-off sequential path and the
+    warm-up spelling) or on the background worker (``submit``). Both
+    spellings execute the identical code on the identical arrays, so the
+    published artifacts are byte-identical by construction.
+
+    Counters land in the loop's :class:`MinerReport` (single logical
+    writer: either the training thread in sync mode or the worker in
+    async mode — never both concurrently for the same field)."""
+
+    def __init__(self, transport, miner_id: str, *, report,
+                 nan_guard: bool = True, queue_depth: int = 1,
+                 sleep: Callable[[float], None] | None = None,
+                 publish_retry=None, meta_retry=None):
+        from ..transport.retry import (DEFAULT_META_RETRY,
+                                       DEFAULT_PUBLISH_RETRY)
+        self.transport = transport
+        self.miner_id = miner_id
+        self.report = report
+        self.nan_guard = nan_guard
+        self.publish_retry = publish_retry or DEFAULT_PUBLISH_RETRY
+        self.meta_retry = meta_retry or DEFAULT_META_RETRY
+        self._sleep = sleep
+        self._worker = PublishWorker(name=f"publish-{miner_id}",
+                                     depth=queue_depth)
+
+    # -- the one publish procedure ------------------------------------------
+    def publish_now(self, payload: Params, finite, base_revision) -> bool:
+        """Screen + transfer + publish + rider ON the calling thread.
+        ``finite`` is the snapshot program's device flag (None skips the
+        screen); ``payload`` may be device arrays or an already-host tree
+        (the pod path materializes at the loop barrier)."""
+        import jax
+
+        from ..transport.retry import call_with_retry
+
+        if self.nan_guard and finite is not None \
+                and not bool(jax.device_get(finite)):
+            logger.warning("miner %s: delta has non-finite values, "
+                           "not pushing", self.miner_id)
+            return False
+        # plain device_get on a single host / an already-host tree; an
+        # allgather COLLECTIVE for cross-process shards — which is why the
+        # pod's sync path runs publish_now at the loop barrier on every
+        # process, and its async path materializes before submitting
+        host = host_materialize(payload)
+        sleep = self._sleep
+        try:
+            call_with_retry(
+                lambda: self.transport.publish_delta(self.miner_id, host),
+                policy=self.publish_retry,
+                describe=f"miner {self.miner_id} delta publish",
+                **({"sleep": sleep} if sleep is not None else {}))
+        except Exception:
+            self.report.pushes_failed += 1
+            logger.exception("miner %s: delta push failed", self.miner_id)
+            return False
+        self._publish_meta(base_revision)
+        self.report.pushes += 1
+        logger.info("miner %s: pushed delta #%d", self.miner_id,
+                    self.report.pushes)
+        return True
+
+    def _publish_meta(self, base_revision) -> None:
+        """Base-revision rider next to the delta (see
+        transport/base.publish_delta_meta for the staleness protocol).
+        The delta-THEN-rider order makes the only inconsistent window
+        false-STALE, never false-fresh. Best-effort: a rider that fails
+        its whole retry budget heals at the next push cadence, so it is
+        logged, not counted as a failed push."""
+        from ..transport.retry import call_with_retry
+
+        pm = getattr(self.transport, "publish_delta_meta", None)
+        if pm is None or base_revision is None:
+            return
+        sleep = self._sleep
+        try:
+            call_with_retry(
+                lambda: pm(self.miner_id, {"base_revision": base_revision}),
+                policy=self.meta_retry,
+                describe=f"miner {self.miner_id} delta meta publish",
+                **({"sleep": sleep} if sleep is not None else {}))
+        except Exception:
+            logger.warning(
+                "miner %s: delta meta publish failed after retries; "
+                "skip-policy receivers may treat this push as stale "
+                "until the next one", self.miner_id, exc_info=True)
+
+    # -- async lane ---------------------------------------------------------
+    def submit(self, payload: Params, finite, base_revision) -> int:
+        """Hand a snapshot to the background worker; returns how many
+        pending pushes it superseded. The caller must pass NON-DONATED
+        buffers (the jitted snapshot program's outputs) — the worker reads
+        them while later train steps donate the live state."""
+        dropped = self._worker.submit(
+            lambda: self.publish_now(payload, finite, base_revision))
+        if dropped:
+            self.report.pushes_superseded += dropped
+            logger.debug("miner %s: superseded %d pending push(es)",
+                         self.miner_id, dropped)
+        return dropped
+
+    def flush(self, timeout: float | None = None) -> bool:
+        """Drain pending + in-flight publishes (shutdown/e2e semantics:
+        the final push is on the wire before flush returns)."""
+        return self._worker.flush(timeout=timeout)
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._worker.close(timeout=timeout)

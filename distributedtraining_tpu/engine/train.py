@@ -676,6 +676,8 @@ def _snapshot(params: Params) -> Params:
 class MinerReport:
     steps: int = 0
     pushes: int = 0
+    pushes_failed: int = 0       # publish retries exhausted (delta artifact)
+    pushes_superseded: int = 0   # async pushes replaced before upload began
     base_pulls: int = 0
     val_reverts: int = 0
     last_loss: float = float("nan")
@@ -701,6 +703,8 @@ class MinerLoop:
                  val_guard_patience: int = 3,
                  val_guard_margin: float = 0.1,
                  keep_optimizer_on_pull: bool = False,
+                 push_async: bool = False,
+                 push_queue_depth: int = 1,
                  trace=None):
         self.engine = engine
         self.transport = transport
@@ -731,6 +735,18 @@ class MinerLoop:
         self.keep_optimizer_on_pull = keep_optimizer_on_pull
         self.checkpoint_store = checkpoint_store
         self.report = MinerReport()
+        # Async publication pipeline (engine/publish.py): the training
+        # thread runs ONE jitted snapshot program and hands its non-donated
+        # device outputs to a background worker; the worker pays the host
+        # sync, device->host transfer, serialization, and upload. Off, the
+        # SAME publisher runs inline (publish_now) — one implementation,
+        # byte-identical artifacts either way.
+        self.push_async = push_async
+        from .publish import DeltaPublisher
+        self._publisher = DeltaPublisher(
+            transport, miner_id, report=self.report, nan_guard=nan_guard,
+            queue_depth=push_queue_depth, sleep=self.clock.sleep)
+        self._push_program_cache = None
         # device-resident copy of the newest step's loss; fetched to
         # report.last_loss only at log boundaries and loop exit (a per-step
         # float() would block the host on every step's completion and
@@ -981,6 +997,14 @@ class MinerLoop:
                                     self._base_revision)
 
     # -- local checkpoint/resume (checkpoint.py) ----------------------------
+    # one program + one fetch for the whole-state screen (params AND
+    # optimizer moments — moments can overflow a step before params do);
+    # the eager two-tree has_nonfinite spelling cost two dispatches and two
+    # host round-trips per save
+    _state_finite = staticmethod(jax.jit(
+        lambda params, opt_state: jnp.logical_and(
+            delta_lib.tree_finite(params), delta_lib.tree_finite(opt_state))))
+
     def _save_checkpoint(self) -> None:
         if self.checkpoint_store is None or self.state is None:
             return
@@ -988,12 +1012,34 @@ class MinerLoop:
         key = (int(self.state.step), self._base_revision)
         if key == self._last_ckpt_key:  # nothing new (e.g. flush right after
             return                      # a periodic save on the final step)
-        if self.nan_guard and (delta_lib.has_nonfinite(self.state.params)
-                               or delta_lib.has_nonfinite(self.state.opt_state)):
-            # never persist a poisoned state: restore prefers the checkpoint,
-            # so saving NaNs would wedge the miner across restarts and lose
-            # the restart-recovers-from-base escape hatch. Optimizer moments
-            # can overflow a step before params do, so both are screened.
+        finite = (self._state_finite(self.state.params, self.state.opt_state)
+                  if self.nan_guard else None)
+        if self.push_async and hasattr(self.checkpoint_store, "save_async"):
+            # device side on THIS thread: an independent on-device copy
+            # (train_step donates the live state — the worker must never
+            # hold its buffers) and the screen's dispatch, both async; the
+            # flag FETCH and the orbax write happen on the store's worker,
+            # with the same supersede semantics as delta pushes (only the
+            # newest state matters).
+            snap = Snapshot(state=_snapshot(self.state),
+                            base_params=self._checkpoint_base(),
+                            base_revision=self._base_revision,
+                            lifetime_steps=self.report.steps)
+
+            def screened(flag=finite) -> bool:
+                if flag is None or bool(jax.device_get(flag)):
+                    return True
+                # never persist a poisoned state: restore prefers the
+                # checkpoint, so saving NaNs would wedge the miner across
+                # restarts and lose the restart-recovers-from-base escape
+                logger.warning("miner %s: state non-finite, not "
+                               "checkpointing", self.miner_id)
+                return False
+
+            self.checkpoint_store.save_async(snap, precondition=screened)
+            self._last_ckpt_key = key
+            return
+        if finite is not None and not bool(jax.device_get(finite)):
             logger.warning("miner %s: state non-finite, not checkpointing",
                            self.miner_id)
             return
@@ -1097,77 +1143,78 @@ class MinerLoop:
             return None
         return wire_in(self.engine, fetched[0])
 
-    # one program instead of an eager per-leaf op stream (each eager op on a
-    # cross-process mesh is its own collective program). wire_dtype is
-    # static (it changes the program), hence the static_argnames jit.
-    _compute_delta = staticmethod(
-        jax.jit(delta_lib.compute_delta, static_argnames=("wire_dtype",)))
-    _quantize = staticmethod(jax.jit(delta_lib.quantize_delta))
-    _sparsify = staticmethod(jax.jit(delta_lib.sparsify_delta,
-                                     static_argnames=("density",)))
+    def _build_push_snapshot(self):
+        """The push path's ONE device program, traced once per loop:
+        ``(params, base) -> (wire_payload, finite_flag)``. Folds
+        compute_delta, the finiteness screen (delta.tree_finite — no
+        separate has_nonfinite dispatch + host round-trip per push), the
+        wire-layout conversion, and int8/sparse8 compression into a single
+        jitted dispatch (each eager op on a cross-process mesh is its own
+        collective program). Outputs are NON-donated fresh buffers, so the
+        async publisher can hold them across later (donating) train steps.
+
+        Artifacts travel in the unrolled wire layout (see wire_out);
+        int8/sparse8 compression runs on the WIRE tree so scales and
+        top-k selections are per wire tensor (per block under
+        scan_blocks, not per stacked stack). NO error feedback:
+        artifacts replace each other (each push is the whole cumulative
+        delta), so carrying a residual into the next push would add the
+        superseded push's rounding error."""
+        engine = self.engine
+        mode = self.delta_dtype
+        wire_dtype = None if mode in ("int8", "sparse8") else mode
+        density = self.delta_density
+
+        def snap(params, base):
+            d = delta_lib.compute_delta(params, base, wire_dtype=wire_dtype)
+            finite = delta_lib.tree_finite(d)
+            payload = wire_out(engine, d)
+            if mode == "int8":
+                payload = delta_lib.quantize_delta(payload)
+            elif mode == "sparse8":
+                payload = delta_lib.sparsify_delta(payload, density=density)
+            return payload, finite
+
+        return snap
+
+    def _push_program(self):
+        if self._push_program_cache is None:
+            self._push_program_cache = jax.jit(self._build_push_snapshot())
+        return self._push_program_cache
+
+    def _push_snapshot(self):
+        """Run the snapshot program on the CURRENT state (hook: the LoRA
+        loop's program takes only the adapters)."""
+        return self._push_program()(self.state.params, self.base_params)
 
     def _push_delta(self) -> None:
         if self.state is None:
             return
-        d = self._compute_delta(
-            self.state.params, self.base_params,
-            wire_dtype=None if self.delta_dtype in ("int8", "sparse8")
-            else self.delta_dtype)
-        if self.nan_guard and delta_lib.has_nonfinite(d):
-            logger.warning("miner %s: delta has non-finite values, not pushing",
-                           self.miner_id)
+        payload, finite = self._push_snapshot()
+        if not self.nan_guard:
+            finite = None
+        if self.push_async and not self._multi():
+            # device arrays go straight to the worker; the finite fetch,
+            # device->host transfer, serialization, and upload all happen
+            # off-thread. A still-pending older push is superseded (each
+            # artifact is the whole cumulative delta — only newest matters).
+            self._publisher.submit(payload, finite, self._base_revision)
             return
-        # artifacts travel in the unrolled wire layout (see wire_out);
-        # int8/sparse8 compression runs on the WIRE tree so scales and
-        # top-k selections are per wire tensor (per block under
-        # scan_blocks, not per stacked stack). NO error feedback:
-        # artifacts replace each other (each push is the whole cumulative
-        # delta), so carrying a residual into the next push would add the
-        # superseded push's rounding error.
-        payload = wire_out(self.engine, d)
-        if self.delta_dtype == "int8":
-            payload = self._quantize(payload)
-        elif self.delta_dtype == "sparse8":
-            payload = self._sparsify(payload, density=self.delta_density)
-        try:
-            self.transport.publish_delta(self.miner_id, payload)
-            self._publish_meta()
-            self.report.pushes += 1
-            logger.info("miner %s: pushed delta #%d", self.miner_id,
-                        self.report.pushes)
-        except Exception:  # push failures must not kill training (ref :410-431)
-            logger.exception("miner %s: delta push failed", self.miner_id)
-
-    def _publish_meta(self) -> None:
-        """Base-revision rider next to the delta: lets receivers detect a
-        STALE submission (computed vs a base that has since moved — the
-        averager merging it would re-add the previous merge's update on
-        top of itself). Best-effort and optional: transports without the
-        rider API, and deltas vs an unpublished genesis base, just skip
-        it — receivers treat an absent rider as the reference's
-        accept-anything.
-
-        The delta-THEN-rider order makes the only inconsistent window
-        false-STALE (fresh delta + old rider — skip-policy receivers
-        drop an honest push), never false-fresh (which would re-open the
-        double-apply). A failed rider upload is retried once here and
-        then heals at the next push cadence; the one-interval cost is
-        the same magnitude as ordinary push staleness."""
-        pm = getattr(self.transport, "publish_delta_meta", None)
-        if pm is None or self._base_revision is None:
-            return
-        meta = {"base_revision": self._base_revision}
-        for attempt in (1, 2):
-            try:
-                pm(self.miner_id, meta)
+        if self.push_async:
+            # pod rule: the snapshot program above, this flag fetch, and
+            # the allgather materialization of cross-process shards are
+            # collectives/synced decisions — they must run here, at the
+            # loop barrier, identically on every process. Only the
+            # coordinator's upload itself goes to the background.
+            from .publish import host_materialize
+            if finite is not None and not bool(jax.device_get(finite)):
+                logger.warning("miner %s: delta has non-finite values, "
+                               "not pushing", self.miner_id)
                 return
-            except Exception:
-                if attempt == 2:
-                    logger.warning(
-                        "miner %s: delta meta publish failed twice; "
-                        "skip-policy receivers may treat this push as "
-                        "stale until the next one", self.miner_id,
-                        exc_info=True)
+            self._publisher.submit(host_materialize(payload), None,
+                                   self._base_revision)
+            return
+        self._publisher.publish_now(payload, finite, self._base_revision)
 
     # -- the loop -----------------------------------------------------------
     def _train_one(self, batch) -> dict:
@@ -1233,8 +1280,16 @@ class MinerLoop:
         return self.report
 
     def flush(self) -> None:
-        """Force a delta push (and checkpoint, if configured) now."""
+        """Force a delta push (and checkpoint, if configured) now, then
+        DRAIN the background publication/checkpoint workers — shutdown and
+        e2e round semantics are identical to the sequential path: the final
+        artifact is on the wire before flush returns."""
         self._push_delta()
         self._save_checkpoint()
+        self._publisher.flush()
+        if self.checkpoint_store is not None:
+            cs_flush = getattr(self.checkpoint_store, "flush", None)
+            if cs_flush is not None:
+                cs_flush()
         if self.trace is not None:
             self.trace.close()

@@ -21,9 +21,11 @@ pickle.
 from .base import Transport, Revision
 from .memory import InMemoryTransport
 from .localfs import LocalFSTransport
+from .retry import RetryPolicy, call_with_retry
 
 __all__ = ["Transport", "Revision", "InMemoryTransport", "LocalFSTransport",
-           "SignedTransport", "HFHubTransport"]
+           "SignedTransport", "HFHubTransport", "RetryPolicy",
+           "call_with_retry"]
 
 
 def __getattr__(name):

@@ -64,13 +64,25 @@ class HFHubTransport:
 
     # -- helpers ------------------------------------------------------------
     def _upload(self, repo_id: str, filename: str, tree: Params) -> Revision:
-        return self._upload_bytes(repo_id, filename, ser.to_msgpack(tree))
+        """Tree publish: serialization STREAMS leaf-by-leaf straight into
+        the spooled temp file (ser.to_msgpack_file). The old spelling
+        materialized the full msgpack payload in memory AND copied it to
+        the temp file — 2x peak host RSS per push at the 8B scale, paid on
+        the publisher worker every send interval."""
+        with tempfile.NamedTemporaryFile(suffix=".msgpack",
+                                         delete=False) as f:
+            ser.to_msgpack_file(tree, f)
+            tmp = f.name
+        return self._upload_path(repo_id, filename, tmp)
 
     def _upload_bytes(self, repo_id: str, filename: str,
                       data: bytes) -> Revision:
         with tempfile.NamedTemporaryFile(suffix=".msgpack", delete=False) as f:
             f.write(data)
             tmp = f.name
+        return self._upload_path(repo_id, filename, tmp)
+
+    def _upload_path(self, repo_id: str, filename: str, tmp: str) -> Revision:
         try:
             info = self.api.upload_file(
                 path_or_fileobj=tmp, path_in_repo=filename,

@@ -47,6 +47,8 @@ def _write_atomic(path: str, data: bytes) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
+        f.flush()
+        os.fsync(f.fileno())  # rename must never commit ahead of the data
     os.replace(tmp, path)  # readers never see a torn artifact
 
 

@@ -1,0 +1,74 @@
+"""Bounded retry with jittered exponential backoff for transport publishes.
+
+The reference retries uploads with ad-hoc fixed loops (a blocking double
+retry in the rider path, bare try/except elsewhere). This is the ONE home
+of the retry rule for every publish — the async publisher worker
+(engine/publish.py) and the sync push path both call through here, so the
+two paths cannot drift on attempt counts or pacing.
+
+Jitter matters at fleet scale: a hundred miners whose pushes all fail on
+the same Hub hiccup would otherwise re-hit it in lockstep at exactly
+base_delay, 2*base_delay, ... — the classic retry storm. The +/-``jitter``
+fraction decorrelates them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """attempts = TOTAL tries (1 = no retry). Delay before try n+1 is
+    ``base_delay * 2**(n-1)`` capped at ``max_delay``, scaled by a uniform
+    factor in [1-jitter, 1+jitter]."""
+    attempts: int = 3
+    base_delay: float = 0.25
+    max_delay: float = 8.0
+    jitter: float = 0.5
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff after the ``attempt``-th (1-based) failed try."""
+        d = min(self.max_delay, self.base_delay * (2.0 ** (attempt - 1)))
+        return max(0.0, d * (1.0 + self.jitter * (2.0 * rng.random() - 1.0)))
+
+
+# the rider is tiny and best-effort; the artifact is the protocol payload
+DEFAULT_PUBLISH_RETRY = RetryPolicy(attempts=3, base_delay=0.25, max_delay=8.0)
+DEFAULT_META_RETRY = RetryPolicy(attempts=3, base_delay=0.1, max_delay=2.0)
+
+
+def call_with_retry(fn: Callable, *, policy: RetryPolicy | None = None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    rng: Optional[random.Random] = None,
+                    describe: str = "publish"):
+    """Run ``fn`` under ``policy``; returns its value or raises the LAST
+    failure once the attempt budget is spent (callers decide whether a
+    terminal failure is fatal — for a miner push it never is).
+
+    ``sleep`` is injectable so loops pass their Clock's sleep (FakeClock
+    tests retry pacing in microseconds) and workers stay real-time."""
+    policy = policy or DEFAULT_PUBLISH_RETRY
+    rng = rng or random.Random()
+    for attempt in range(1, policy.attempts + 1):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt >= policy.attempts:
+                raise
+            delay = policy.delay(attempt, rng)
+            logger.warning("%s failed (attempt %d/%d), retrying in %.2fs: %s",
+                           describe, attempt, policy.attempts, delay, e)
+            sleep(delay)

@@ -90,6 +90,8 @@ def main(argv=None) -> int:
                              metrics=c.metrics, log_every=cfg.log_every,
                              checkpoint_store=store,
                              checkpoint_interval=cfg.checkpoint_interval,
+                             push_async=cfg.push_async,
+                             push_queue_depth=cfg.push_queue_depth,
                              trace=trace, **_guard_kwargs(cfg, c))
     else:
         loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
@@ -102,6 +104,8 @@ def main(argv=None) -> int:
                          keep_optimizer_on_pull=cfg.keep_optimizer_on_pull,
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval,
+                         push_async=cfg.push_async,
+                         push_queue_depth=cfg.push_queue_depth,
                          trace=trace, **_guard_kwargs(cfg, c))
     try:
         loop.bootstrap(params=c.initial_params)
@@ -113,8 +117,10 @@ def main(argv=None) -> int:
     finally:
         if store is not None:
             store.close()
-    logging.info("miner done: steps=%d pushes=%d base_pulls=%d loss=%.4f",
-                 report.steps, report.pushes, report.base_pulls,
+    logging.info("miner done: steps=%d pushes=%d (failed=%d superseded=%d) "
+                 "base_pulls=%d loss=%.4f",
+                 report.steps, report.pushes, report.pushes_failed,
+                 report.pushes_superseded, report.base_pulls,
                  report.last_loss)
     return 0
 

@@ -23,6 +23,34 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_leaked_nondaemon_threads():
+    """Every background worker this framework spawns — data prefetch,
+    stage_cohorts staging, the miner publication pipeline, async
+    checkpoint saves — must be a DAEMON thread that its owner drains via
+    flush()/close(): a leaked non-daemon worker blocks interpreter
+    shutdown (CI hangs at 100% green). This guard asserts no test module
+    leaves a NEW non-daemon thread running; threads that predate the
+    module (pytest/jax internals) are exempt, and joiners get a grace
+    window."""
+    import threading
+    import time as _time
+
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = _time.monotonic() + 5.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.is_alive() and not t.daemon
+                  and t.ident not in before]
+        if not leaked:
+            return
+        if _time.monotonic() > deadline:
+            raise AssertionError(
+                f"test module leaked non-daemon threads: {leaked}")
+        _time.sleep(0.05)
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -88,6 +88,24 @@ def test_time_validator_round_ab(tiny):
     assert out["validator_parity_max_abs_err"] < 1e-4
 
 
+def test_time_push_overlap_ab():
+    """The async-vs-sync miner publish A/B (ISSUE 2 acceptance): with a
+    simulated-latency transport the pipeline hides the training-thread
+    stall (>= 80% at the bench's default 150 ms; the floor here is looser
+    because CI boxes run loaded) and the published artifacts are
+    byte-identical. Cheap spelling: fewer steps, still latency-bound."""
+    out = bench._time_push_overlap(latency_s=0.1, steps=10)
+    for key in ("push_stall_ms", "push_stall_async_ms",
+                "push_overlap_speedup", "push_stall_removed"):
+        assert key in out and out[key] is not None, out
+    assert out["push_parity"] is True, out
+    assert out["push_overlap_speedup"] > 1.2, out
+    assert out["push_stall_removed"] >= 0.5, out
+    # the stall the sync path pays per push is at least the injected
+    # transport latency (upload + rider)
+    assert out["push_stall_ms"] >= 80.0, out
+
+
 def test_peak_flops_ladder(monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
     assert bench._peak_flops() == 197e12

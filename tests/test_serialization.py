@@ -41,6 +41,37 @@ def test_file_roundtrip(tmp_path):
                                           np.asarray(b, np.float32))
 
 
+def test_streaming_msgpack_byte_identical():
+    """to_msgpack_file (the leaf-streaming encoder HFHubTransport uploads
+    through — one leaf of host RSS instead of the whole artifact) must
+    produce EXACTLY the bytes of to_msgpack: mixed dtypes (bf16 included),
+    scalars, nesting, and flax's oversized-leaf chunking."""
+    import io
+
+    import flax.serialization as flax_ser
+
+    t = {**tree(), "bf": jnp.ones((3, 5), jnp.bfloat16),
+         "s": {"c": np.float32(2.5), "d": np.arange(7)}}
+    buf = io.BytesIO()
+    n = ser.to_msgpack_file(t, buf)
+    dense = ser.to_msgpack(t)
+    assert buf.getvalue() == dense and n == len(dense)
+
+    # chunked path: shrink flax's threshold so a 100-element leaf chunks
+    old = flax_ser.MAX_CHUNK_SIZE
+    flax_ser.MAX_CHUNK_SIZE = 64
+    try:
+        big = {"w": np.arange(100, dtype=np.float32)}
+        buf = io.BytesIO()
+        ser.to_msgpack_file(big, buf)
+        assert buf.getvalue() == ser.to_msgpack(big)
+        out = ser.from_msgpack(buf.getvalue(),
+                               {"w": np.zeros(100, np.float32)})
+        np.testing.assert_array_equal(out["w"], big["w"])
+    finally:
+        flax_ser.MAX_CHUNK_SIZE = old
+
+
 def test_size_cap():
     t = tree()
     data = ser.to_msgpack(t)
