@@ -333,6 +333,77 @@ def _time_push_overlap(*, latency_s: float = 0.15, steps: int = 24,
     return out
 
 
+def _time_metrics_overhead(*, steps: int = 100, trials: int = 2,
+                           log_every: int = 5) -> dict:
+    """Observability-layer A/B (round-8 satellite): the production
+    MinerLoop with the obs layer OFF (no configured sink, no anomaly
+    monitor — every obs call is a single-branch no-op) vs fully ON
+    (utils/obs configured with a real JSONLSink, per-step step-time
+    histogram, periodic registry flush at the log cadence, and an
+    AnomalyMonitor fed every step). Both sides run the identical metrics
+    sink and log cadence, so the contrast is exactly the new layer.
+    Interleaved off/on pairs (scripts/measure.sh rule 4); acceptance
+    floor: metrics_overhead_frac < 0.02."""
+    import os as _os
+    import tempfile
+
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.engine.train import MinerLoop
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+    from distributedtraining_tpu.utils import obs
+    from distributedtraining_tpu.utils.metrics import JSONLSink
+    from distributedtraining_tpu.utils.obs import AnomalyMonitor
+
+    model, cfg = gpt2.make_model("tiny")
+    seq = 64
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (BATCH, seq)), np.int32)}
+
+    def run_once(instrumented: bool) -> float:
+        fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+        _os.close(fd)
+        sink = JSONLSink(tmp)
+        try:
+            if instrumented:
+                obs.configure(sink, role="bench")
+            engine = TrainEngine(model, seq_len=seq)
+            loop = MinerLoop(
+                engine, InMemoryTransport(), "bench-obs",
+                send_interval=1e9, check_update_interval=1e9,
+                log_every=log_every, metrics=sink,
+                anomaly=AnomalyMonitor() if instrumented else None)
+            loop.bootstrap(jax.random.PRNGKey(0))
+
+            def batches():
+                while True:
+                    yield batch
+
+            loop.run(batches(), max_steps=2)   # warm compiles off-timing
+            t0 = time.perf_counter()
+            loop.run(batches(), max_steps=steps)
+            dt = time.perf_counter() - t0      # exit loss fetch ends timing
+            assert loop.report.last_loss == loop.report.last_loss
+            return dt
+        finally:
+            obs.reset()
+            sink.close()
+            _os.unlink(tmp)
+
+    offs, ons = [], []
+    for _ in range(trials):
+        offs.append(run_once(False))
+        ons.append(run_once(True))
+    off, on = float(np.mean(offs)), float(np.mean(ons))
+    return {
+        "metrics_steps": steps,
+        "metrics_off_s": round(off, 4),
+        "metrics_on_s": round(on, 4),
+        "metrics_overhead_frac": round(max(0.0, on / off - 1.0), 4),
+    }
+
+
 def _param_count(model) -> int:
     abstract = jax.eval_shape(
         lambda: model.init_params(jax.random.PRNGKey(0)))
@@ -565,6 +636,13 @@ def main() -> None:
         extras.update(_time_push_overlap())
     except Exception as e:
         extras["push_overlap_error"] = repr(e)
+
+    try:
+        # observability layer cost: production loop with utils/obs off vs
+        # fully on (round-8 satellite; acceptance < 2%)
+        extras.update(_time_metrics_overhead())
+    except Exception as e:
+        extras["metrics_overhead_error"] = repr(e)
 
     try:
         # MFU scale point (round-2 verdict item 7): config 3's model on one

@@ -157,6 +157,12 @@ class RunConfig:
     mlflow_uri: Optional[str] = None
     profile_dir: Optional[str] = None        # jax.profiler trace capture
     profile_steps: int = 5                   # train steps per capture
+    # anomaly-triggered capture (utils/obs.AnomalyMonitor): a loss spike,
+    # push-failure streak, or step-time p99 blowout arms ONE disarmed
+    # TraceCapture automatically — profiler evidence of the first anomaly
+    # lands on disk without anyone watching
+    anomaly_trace: bool = True
+    anomaly_dir: Optional[str] = None        # default: <work_dir>/anomaly_traces/<hotkey>
 
     @classmethod
     def from_args(cls, role: str, argv: Sequence[str] | None = None
@@ -521,4 +527,13 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                             "at full speed")
         g.add_argument("--profile-steps", dest="profile_steps", type=int,
                        default=d.profile_steps)
+        g.add_argument("--no-anomaly-trace", dest="anomaly_trace",
+                       action="store_false", default=d.anomaly_trace,
+                       help="disable the anomaly-armed profiler capture "
+                            "(a loss spike, push-failure streak, or "
+                            "step-time p99 blowout otherwise records one "
+                            "bounded jax.profiler trace automatically)")
+        g.add_argument("--anomaly-dir", dest="anomaly_dir", default=None,
+                       help="trace directory for the anomaly capture; "
+                            "default <work-dir>/anomaly_traces/<hotkey>")
     return p

@@ -35,6 +35,7 @@ import optax
 from .. import delta as delta_lib
 from .. import serialization as ser
 from ..ops.losses import causal_lm_loss
+from ..utils import obs
 from .scheduler import Clock, PeriodicAction, RealClock
 
 logger = logging.getLogger(__name__)
@@ -541,6 +542,10 @@ class AveragerLoop:
         #                           merge (skip identical re-merges)
         self._host_template_cache = None
         self._quant_template_cache = None
+        # hotkey -> correlation id (delta_id from the meta rider) of the
+        # submissions gathered THIS round — the merge span records exactly
+        # which artifacts entered each merge (utils/obs.py)
+        self._round_cids: dict[str, str] = {}
 
     # -- multi-host (the averager can span a pod too) -----------------------
     def _multi(self) -> bool:
@@ -636,6 +641,7 @@ class AveragerLoop:
             meta = broadcast_metagraph(self.chain)
         else:
             meta = self.chain.sync()
+        self._round_cids.clear()
         ids, deltas = [], []
         rejected = 0
         for hotkey in meta.hotkeys:
@@ -646,7 +652,13 @@ class AveragerLoop:
                             "base)", hotkey)
                 rejected += 1
                 continue
-            d = self._fetch_delta(hotkey)
+            # correlation id from the rider (single-host only: a pod's
+            # per-process rider read would touch the transport off the
+            # coordinator) — joins this merge to the miner's push spans
+            cid = None if self._multi() else obs.fetch_cid(self.transport,
+                                                           hotkey)
+            with obs.span("avg.fetch", cid=cid, miner=hotkey):
+                d = self._fetch_delta(hotkey)
             if d is None:
                 continue
             ok, reason = delta_lib.screen_delta(d, self.base_params,
@@ -657,6 +669,8 @@ class AveragerLoop:
                 continue
             ids.append(hotkey)
             deltas.append(d)
+            if cid is not None:
+                self._round_cids[hotkey] = cid
         self.report.last_accepted = len(ids)
         self.report.last_rejected = rejected
         return ids, deltas
@@ -714,10 +728,17 @@ class AveragerLoop:
                 if multihost.is_coordinator() else None) or {}
         else:
             consensus = getattr(self.chain, "consensus_scores", lambda: {})()
-        merged, weights = self.strategy.merge(
-            self.engine, self.base_params, stacked, ids,
-            val_batches=self.val_batches, consensus=consensus)
-        loss, ppl = self.engine.evaluate(merged, self.val_batches())
+        # the merge span records exactly WHICH artifacts entered this
+        # merge: with the per-push delta_id riders, one artifact's whole
+        # life (snapshot -> upload -> fetch -> eval -> merge) joins on cid
+        # in scripts/obs_report.py
+        cids = [c for c in (self._round_cids.get(h) for h in ids) if c]
+        with obs.span("avg.merge", miners=len(ids), cids=cids):
+            merged, weights = self.strategy.merge(
+                self.engine, self.base_params, stacked, ids,
+                val_batches=self.val_batches, consensus=consensus)
+        with obs.span("avg.eval"):
+            loss, ppl = self.engine.evaluate(merged, self.val_batches())
         if self.publish_policy == "improved":
             if self._base_loss is None:
                 # once per base: the batch factory is fixed, so the
@@ -744,8 +765,10 @@ class AveragerLoop:
                     self.metrics.log(
                         {"merged_loss": loss, "merged_ppl": ppl,
                          "base_loss": self._base_loss,
-                         "accepted": len(ids), "published": 0},
+                         "accepted": len(ids), "published": 0,
+                         "merge_delta_ids": dict(self._round_cids)},
                         step=self.report.rounds)
+                    obs.flush(self.metrics, step=self.report.rounds)
                 self.report.rounds += 1
                 self._declined_fp = self._delta_fingerprint(ids)
                 self.transport.gc()   # storage bounding must not stall
@@ -755,11 +778,13 @@ class AveragerLoop:
         self.report.last_loss = loss
         if self.metrics:
             self.metrics.log({"merged_loss": loss, "merged_ppl": ppl,
-                              "accepted": len(ids), "published": 1},
+                              "accepted": len(ids), "published": 1,
+                              "merge_delta_ids": dict(self._round_cids)},
                              step=self.report.rounds)
         from .train import wire_out
-        self._base_revision = self.transport.publish_base(
-            wire_out(self.engine, merged))
+        with obs.span("avg.publish", cids=cids):
+            self._base_revision = self.transport.publish_base(
+                wire_out(self.engine, merged))
         # round-spanning strategy state (e.g. OuterOptMerge velocity) commits
         # only once the new base is actually out
         commit = getattr(self.strategy, "commit", None)
@@ -769,6 +794,10 @@ class AveragerLoop:
         self._base_loss = loss
         self._declined_fp = None
         self.transport.gc()
+        if self.metrics:
+            # registry flush at the round cadence (fetch/merge/publish
+            # span histograms, retry counters)
+            obs.flush(self.metrics, step=self.report.rounds)
         self.report.rounds += 1
         return True
 

@@ -51,6 +51,7 @@ overlap.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
@@ -58,6 +59,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import delta as delta_lib
+from ..utils import obs
 
 logger = logging.getLogger(__name__)
 
@@ -90,6 +92,12 @@ class BatchedCohortEvaluator:
         # measured on CPU); fusing assembly into one program per bucket
         # makes cohort staging a single dispatch
         self._stack_cache: dict[tuple, Callable] = {}
+        # bucket sizes this evaluator has dispatched: a NEW k_pad means a
+        # fresh XLA compile of the cohort program (jit keys on the padded
+        # stack's shapes) — val.cohort_bucket_compiles counts them, so a
+        # wobbling fleet size that defeats the bucket ladder shows up in
+        # the registry instead of as mystery multi-second eval stalls
+        self._buckets_seen: set[int] = set()
 
     # -- bucket policy ------------------------------------------------------
     def bucket_for(self, k: int) -> int:
@@ -237,6 +245,7 @@ class BatchedCohortEvaluator:
         key = (len(deltas), k_pad, include_base)
         assemble = self._stack_cache.get(key)
         if assemble is None:
+            obs.count("val.cohort_stack_compiles")
             lead = 1 if include_base else 0
 
             def assemble(*real):
@@ -278,6 +287,9 @@ class BatchedCohortEvaluator:
         discipline as TrainEngine.evaluate)."""
         k_stack = delta_lib.miner_axis_size(stacked)
         k_pad = self.bucket_for(max(k_stack, k_real))
+        if k_pad not in self._buckets_seen:
+            self._buckets_seen.add(k_pad)
+            obs.count("val.cohort_bucket_compiles")
         if k_stack != k_pad:
             pad = self._stack_cache.get(("pad", k_pad))
             if pad is None:  # one program, not one concat dispatch per leaf
@@ -341,8 +353,17 @@ def stage_cohorts(items: Sequence, cohort_size: int, stage_one: Callable,
         raise ValueError(f"cohort_size must be >= 1, got {cohort_size}")
     groups = [list(items[i:i + cohort_size])
               for i in range(0, len(items), cohort_size)]
+
+    def stage_group(group):
+        # stager-side occupancy half: time actually spent fetching +
+        # screening (the consumer's wait half is val.stage_wait_ms in
+        # engine/validate.py) — busy/(busy+wait) is pipeline overlap
+        t0 = time.perf_counter()
+        out = [stage_one(x) for x in group]
+        obs.count("val.stage_busy_ms", (time.perf_counter() - t0) * 1e3)
+        return out
+
     if not pipeline:
-        return iter([stage_one(x) for x in group] for group in groups)
+        return iter(stage_group(group) for group in groups)
     from ..data.prefetch import map_prefetch
-    return map_prefetch(lambda group: [stage_one(x) for x in group],
-                        groups, depth=depth)
+    return map_prefetch(stage_group, groups, depth=depth)

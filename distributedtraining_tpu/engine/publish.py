@@ -39,8 +39,11 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Optional
+
+from ..utils import obs
 
 logger = logging.getLogger(__name__)
 
@@ -78,8 +81,14 @@ class SupersedeQueue:
                 self._items.popleft()
                 dropped += 1
             self._items.append(item)
+            depth = len(self._items)
             self._cv.notify_all()
-            return dropped
+        # outside the cv: observability must never extend the handoff's
+        # critical section (no-ops unless a sink is configured)
+        obs.observe("publish.queue_depth", depth)
+        if dropped:
+            obs.count("publish.superseded", dropped)
+        return dropped
 
     def take(self, timeout: float | None = None):
         """Next item (marks it in flight — pair with ``task_done``), or
@@ -146,11 +155,21 @@ class PublishWorker:
 
     def _run(self) -> None:
         while True:
+            # idle = worker waiting for work (training fully overlapped);
+            # busy = host cost actually hidden behind accelerator compute.
+            # publish.worker_idle_ms / publish.worker_busy_ms together
+            # read as the pipeline's occupancy: busy/(busy+idle) near 1.0
+            # means the worker is the bottleneck and pushes will start
+            # superseding each other.
+            t0 = time.perf_counter()
             job = self._q.take()
+            obs.count("publish.worker_idle_ms",
+                      (time.perf_counter() - t0) * 1e3)
             if job is _CLOSED:
                 return
             if job is None:
                 continue
+            t1 = time.perf_counter()
             try:
                 job()
                 self.jobs_run += 1
@@ -163,6 +182,8 @@ class PublishWorker:
                     except Exception:
                         pass
             finally:
+                obs.count("publish.worker_busy_ms",
+                          (time.perf_counter() - t1) * 1e3)
                 self._q.task_done()
 
     def flush(self, timeout: float | None = None) -> bool:
@@ -225,44 +246,57 @@ class DeltaPublisher:
                                      depth=queue_depth)
 
     # -- the one publish procedure ------------------------------------------
-    def publish_now(self, payload: Params, finite, base_revision) -> bool:
+    def publish_now(self, payload: Params, finite, base_revision,
+                    cid: str | None = None) -> bool:
         """Screen + transfer + publish + rider ON the calling thread.
         ``finite`` is the snapshot program's device flag (None skips the
         screen); ``payload`` may be device arrays or an already-host tree
-        (the pod path materializes at the loop barrier)."""
+        (the pod path materializes at the loop barrier). ``cid`` is the
+        push's correlation id (utils/obs.py): it tags every span below,
+        rides the meta rider as ``delta_id``, and is what lets
+        scripts/obs_report.py join this push to the validator's fetch and
+        the averager's merge across processes."""
         import jax
 
         from ..transport.retry import call_with_retry
 
-        if self.nan_guard and finite is not None \
-                and not bool(jax.device_get(finite)):
-            logger.warning("miner %s: delta has non-finite values, "
-                           "not pushing", self.miner_id)
-            return False
-        # plain device_get on a single host / an already-host tree; an
-        # allgather COLLECTIVE for cross-process shards — which is why the
-        # pod's sync path runs publish_now at the loop barrier on every
-        # process, and its async path materializes before submitting
-        host = host_materialize(payload)
-        sleep = self._sleep
-        try:
-            call_with_retry(
-                lambda: self.transport.publish_delta(self.miner_id, host),
-                policy=self.publish_retry,
-                describe=f"miner {self.miner_id} delta publish",
-                **({"sleep": sleep} if sleep is not None else {}))
-        except Exception:
-            self.report.pushes_failed += 1
-            logger.exception("miner %s: delta push failed", self.miner_id)
-            return False
-        self._publish_meta(base_revision)
-        self.report.pushes += 1
-        logger.info("miner %s: pushed delta #%d", self.miner_id,
-                    self.report.pushes)
-        return True
+        with obs.correlate(cid):
+            if self.nan_guard and finite is not None:
+                with obs.span("push.screen"):
+                    finite_ok = bool(jax.device_get(finite))
+                if not finite_ok:
+                    logger.warning("miner %s: delta has non-finite values, "
+                                   "not pushing", self.miner_id)
+                    return False
+            # plain device_get on a single host / an already-host tree; an
+            # allgather COLLECTIVE for cross-process shards — which is why
+            # the pod's sync path runs publish_now at the loop barrier on
+            # every process, and its async path materializes first
+            with obs.span("push.materialize"):
+                host = host_materialize(payload)
+            sleep = self._sleep
+            try:
+                with obs.span("push.upload", miner=self.miner_id):
+                    call_with_retry(
+                        lambda: self.transport.publish_delta(self.miner_id,
+                                                             host),
+                        policy=self.publish_retry,
+                        describe=f"miner {self.miner_id} delta publish",
+                        **({"sleep": sleep} if sleep is not None else {}))
+            except Exception:
+                self.report.pushes_failed += 1
+                obs.count("publish.failed")
+                logger.exception("miner %s: delta push failed", self.miner_id)
+                return False
+            self._publish_meta(base_revision, cid)
+            self.report.pushes += 1
+            obs.count("publish.pushes")
+            logger.info("miner %s: pushed delta #%d", self.miner_id,
+                        self.report.pushes)
+            return True
 
-    def _publish_meta(self, base_revision) -> None:
-        """Base-revision rider next to the delta (see
+    def _publish_meta(self, base_revision, cid: str | None = None) -> None:
+        """Base-revision (+ correlation-id) rider next to the delta (see
         transport/base.publish_delta_meta for the staleness protocol).
         The delta-THEN-rider order makes the only inconsistent window
         false-STALE, never false-fresh. Best-effort: a rider that fails
@@ -271,15 +305,21 @@ class DeltaPublisher:
         from ..transport.retry import call_with_retry
 
         pm = getattr(self.transport, "publish_delta_meta", None)
-        if pm is None or base_revision is None:
+        if pm is None or (base_revision is None and cid is None):
             return
+        meta: dict = {}
+        if base_revision is not None:
+            meta["base_revision"] = base_revision
+        if cid is not None:
+            meta["delta_id"] = cid
         sleep = self._sleep
         try:
-            call_with_retry(
-                lambda: pm(self.miner_id, {"base_revision": base_revision}),
-                policy=self.meta_retry,
-                describe=f"miner {self.miner_id} delta meta publish",
-                **({"sleep": sleep} if sleep is not None else {}))
+            with obs.span("push.meta"):
+                call_with_retry(
+                    lambda: pm(self.miner_id, meta),
+                    policy=self.meta_retry,
+                    describe=f"miner {self.miner_id} delta meta publish",
+                    **({"sleep": sleep} if sleep is not None else {}))
         except Exception:
             logger.warning(
                 "miner %s: delta meta publish failed after retries; "
@@ -287,13 +327,21 @@ class DeltaPublisher:
                 "until the next one", self.miner_id, exc_info=True)
 
     # -- async lane ---------------------------------------------------------
-    def submit(self, payload: Params, finite, base_revision) -> int:
+    def submit(self, payload: Params, finite, base_revision,
+               cid: str | None = None) -> int:
         """Hand a snapshot to the background worker; returns how many
         pending pushes it superseded. The caller must pass NON-DONATED
         buffers (the jitted snapshot program's outputs) — the worker reads
-        them while later train steps donate the live state."""
+        them while later train steps donate the live state.
+
+        ``publish.submit_ms`` is the TRAINING THREAD's whole cost of a
+        push in async mode — the number the pipeline exists to keep near
+        zero (bench._time_push_overlap measures the same thing end to
+        end)."""
+        t0 = time.perf_counter()
         dropped = self._worker.submit(
-            lambda: self.publish_now(payload, finite, base_revision))
+            lambda: self.publish_now(payload, finite, base_revision, cid))
+        obs.observe("publish.submit_ms", (time.perf_counter() - t0) * 1e3)
         if dropped:
             self.report.pushes_superseded += dropped
             logger.debug("miner %s: superseded %d pending push(es)",

@@ -32,6 +32,7 @@ from flax import struct
 from .. import delta as delta_lib
 from ..ops.losses import causal_lm_loss
 from ..parallel.sharding import batch_sharding, mesh_shardings, opt_state_shardings
+from ..utils import obs
 from ..utils.metrics import device_metrics
 from .scheduler import Clock, PeriodicAction, RealClock
 
@@ -705,7 +706,8 @@ class MinerLoop:
                  keep_optimizer_on_pull: bool = False,
                  push_async: bool = False,
                  push_queue_depth: int = 1,
-                 trace=None):
+                 trace=None,
+                 anomaly=None):
         self.engine = engine
         self.transport = transport
         self.miner_id = miner_id
@@ -713,6 +715,14 @@ class MinerLoop:
         self.metrics = metrics
         # optional bounded jax.profiler capture (utils.metrics.TraceCapture)
         self.trace = trace
+        # optional anomaly-armed capture (utils.obs.AnomalyMonitor): fed
+        # step times every step and loss/push counters at log boundaries;
+        # a loss spike, push-failure streak, or step-time p99 blowout arms
+        # its one-shot TraceCapture automatically
+        self.anomaly = anomaly
+        # per-push correlation-id sequence (obs.new_delta_id): stamps the
+        # meta rider so validator/averager spans join to this push
+        self._push_seq = 0
         self.log_every = log_every
         self.nan_guard = nan_guard
         self.delta_dtype = delta_dtype
@@ -1190,7 +1200,16 @@ class MinerLoop:
     def _push_delta(self) -> None:
         if self.state is None:
             return
-        payload, finite = self._push_snapshot()
+        # correlation id for THIS push: tags the snapshot span here, every
+        # publisher span (sync or worker thread), and the meta rider the
+        # validator/averager read it back from
+        self._push_seq += 1
+        cid = obs.new_delta_id(self.miner_id, self._push_seq)
+        with obs.span("push.snapshot", cid=cid):
+            # dispatch-only duration: the jitted program runs async on
+            # device; the host cost it hides shows up in push.screen /
+            # push.materialize instead
+            payload, finite = self._push_snapshot()
         if not self.nan_guard:
             finite = None
         if self.push_async and not self._multi():
@@ -1198,7 +1217,7 @@ class MinerLoop:
             # device->host transfer, serialization, and upload all happen
             # off-thread. A still-pending older push is superseded (each
             # artifact is the whole cumulative delta — only newest matters).
-            self._publisher.submit(payload, finite, self._base_revision)
+            self._publisher.submit(payload, finite, self._base_revision, cid)
             return
         if self.push_async:
             # pod rule: the snapshot program above, this flag fetch, and
@@ -1212,9 +1231,9 @@ class MinerLoop:
                                "not pushing", self.miner_id)
                 return
             self._publisher.submit(host_materialize(payload), None,
-                                   self._base_revision)
+                                   self._base_revision, cid)
             return
-        self._publisher.publish_now(payload, finite, self._base_revision)
+        self._publisher.publish_now(payload, finite, self._base_revision, cid)
 
     # -- the loop -----------------------------------------------------------
     def _train_one(self, batch) -> dict:
@@ -1229,14 +1248,26 @@ class MinerLoop:
         if self.state is None:
             self.bootstrap()
         start_steps = self.report.steps  # max_steps bounds *this* call
+        import time as _time
         try:
             for batch in batches:
                 if max_steps is not None and self.report.steps - start_steps >= max_steps:
                     break
                 self._pull_action.poll()
+                # step-time attribution: dispatch-side wall time per step
+                # (the host's view — what pipeline stalls actually cost).
+                # Two perf_counter reads + one gated histogram observe; the
+                # <2% overhead budget is pinned by
+                # bench._time_metrics_overhead.
+                t0 = _time.perf_counter()
                 m = self._train_one(batch)
+                step_ms = (_time.perf_counter() - t0) * 1e3
+                obs.observe("miner.step_ms", step_ms)
                 if self.trace is not None:
                     self.trace.tick()
+                if self.anomaly is not None:
+                    self.anomaly.observe_step_ms(step_ms)
+                    self.anomaly.tick()
                 self.report.steps += 1
                 # keep the loss on-device: train_step dispatches
                 # asynchronously, so the host can prep the next batch while
@@ -1246,11 +1277,21 @@ class MinerLoop:
                 self._last_loss_dev = m["loss"]
                 if self.metrics and self.report.steps % self.log_every == 0:
                     self.report.last_loss = float(self._last_loss_dev)
+                    if self.anomaly is not None:
+                        # loss + push-failure rules run at the log cadence:
+                        # the loss is already host-fetched here, so anomaly
+                        # detection never adds a device sync of its own
+                        self.anomaly.observe_loss(self.report.last_loss)
+                        self.anomaly.observe_push_counters(
+                            self.report.pushes, self.report.pushes_failed)
                     self.metrics.log(
                         {"train_loss": self.report.last_loss,
                          "staleness_s": self.clock.now() - self._last_base_time,
                          **device_metrics()},
                         step=self.report.steps)
+                    # periodic registry flush: counters + span/step
+                    # histograms ride the same sink at the same cadence
+                    obs.flush(self.metrics, step=self.report.steps)
                 if self._val_guard_action is not None:
                     # before push: a revert must land before publishing, so
                     # the pushed delta is never the known-degraded state
@@ -1293,3 +1334,9 @@ class MinerLoop:
                 cs_flush()
         if self.trace is not None:
             self.trace.close()
+        if self.anomaly is not None:
+            self.anomaly.close()
+        # final registry flush: the drained publisher's worker counters and
+        # the last partial log window must reach the sink before exit
+        if self.metrics is not None:
+            obs.flush(self.metrics, step=self.report.steps)

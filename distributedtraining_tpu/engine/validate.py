@@ -20,11 +20,13 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Any, Callable, Iterable, Optional
 
 import jax
 
 from .. import delta as delta_lib
+from ..utils import obs
 from ..utils.metrics import device_metrics
 from .scheduler import Clock, RealClock
 
@@ -104,6 +106,10 @@ class Validator:
         self.base_loss: float | None = None
         self.base_ppl: float | None = None
         self._warned_no_permit = False
+        # hotkey -> correlation id of the artifact staged THIS round (from
+        # the delta's meta rider, utils/obs.py) — written by the staging
+        # thread, read when tagging eval spans and the round record
+        self._round_cids: dict[str, str] = {}
 
     # -- validator permit ---------------------------------------------------
     def has_vpermit(self, meta=None) -> bool:
@@ -263,14 +269,25 @@ class Validator:
     def _stage_miner(self, hotkey: str):
         """Fetch + screen one submission — the host-side staging shared by
         the sequential and batched paths (and what the cohort pipeline
-        overlaps with device eval). Returns (hotkey, delta|None, reason)."""
+        overlaps with device eval). Returns (hotkey, delta|None, reason).
+
+        Correlation: the artifact's ``delta_id`` (stamped into the meta
+        rider by the miner's publisher) tags the fetch/screen spans here
+        and the eval span later, joining this round's records to the
+        miner's push spans in scripts/obs_report.py. Single-host only —
+        on a pod the rider read would be a per-process transport touch."""
+        cid = None if self._multi() else obs.fetch_cid(self.transport, hotkey)
+        if cid is not None:
+            self._round_cids[hotkey] = cid
         if self.stale_deltas == "skip" and self._is_stale(hotkey):
             return hotkey, None, "stale_base"
-        d = self._fetch_delta(hotkey)
+        with obs.span("val.fetch", cid=cid, miner=hotkey):
+            d = self._fetch_delta(hotkey)
         if d is None:
             return hotkey, None, "no_delta"
-        ok, reason = delta_lib.screen_delta(d, self.base_params,
-                                            max_abs=self.max_delta_abs)
+        with obs.span("val.screen", cid=cid, miner=hotkey):
+            ok, reason = delta_lib.screen_delta(d, self.base_params,
+                                                max_abs=self.max_delta_abs)
         if not ok:
             return hotkey, None, reason
         return hotkey, d, "ok"
@@ -287,7 +304,9 @@ class Validator:
         if d is None:
             return MinerScore(hotkey, 0.0, reason=reason)
         candidate = delta_lib.apply_delta(self.base_params, d)
-        loss, ppl = self.engine.evaluate(candidate, self.eval_batches())
+        with obs.span("val.eval", cid=self._round_cids.get(hotkey),
+                      miner=hotkey):
+            loss, ppl = self.engine.evaluate(candidate, self.eval_batches())
         return self._score_from(hotkey, loss, ppl)
 
     def _score_cohorts(self, hotkeys: list[str]) -> list[MinerScore]:
@@ -302,15 +321,29 @@ class Validator:
                                pipeline=pipeline,
                                depth=max(self.pipeline_depth, 1))
         try:
-            for cohort in staged:
+            it = iter(staged)
+            while True:
+                # time blocked on the stager: together with the stager's
+                # own val.stage_busy_ms this reads as pipeline occupancy —
+                # near-zero wait means staging fully overlaps device eval
+                t0 = time.perf_counter()
+                try:
+                    cohort = next(it)
+                except StopIteration:
+                    break
+                obs.observe("val.stage_wait_ms",
+                            (time.perf_counter() - t0) * 1e3)
                 valid = [(h, d) for h, d, _ in cohort if d is not None]
                 results.extend(MinerScore(h, 0.0, reason=r)
                                for h, d, r in cohort if d is None)
                 if not valid:
                     continue
-                scored = evaluator.evaluate_cohort(
-                    self.base_params, [d for _, d in valid],
-                    self.eval_batches())
+                cids = [c for c in (self._round_cids.get(h)
+                                    for h, _ in valid) if c]
+                with obs.span("val.cohort_eval", k=len(valid), cids=cids):
+                    scored = evaluator.evaluate_cohort(
+                        self.base_params, [d for _, d in valid],
+                        self.eval_batches())
                 results.extend(self._score_from(h, loss, ppl)
                                for (h, _), (loss, ppl) in zip(valid, scored))
         finally:
@@ -332,6 +365,7 @@ class Validator:
     def validate_and_score(self) -> list[MinerScore]:
         """One validation round (validate_and_score,
         validation_logic.py:99-189)."""
+        self._round_cids.clear()  # correlation ids are per round
         meta = self._synced_metagraph()
         self._maybe_refresh_base()
         others = [h for h in meta.hotkeys if h != self.chain.my_hotkey]
@@ -363,8 +397,13 @@ class Validator:
                 "base_loss": self.base_loss,
                 "round_scores": {
                     s.hotkey: {"score": s.score, "loss": s.loss,
-                               "reason": s.reason} for s in results},
+                               "reason": s.reason,
+                               "cid": self._round_cids.get(s.hotkey)}
+                    for s in results},
             }, step=self._round)
+            # periodic registry flush (span histograms, stage/eval timing,
+            # retry counters) at the round cadence
+            obs.flush(self.metrics, step=self._round)
         self._round += 1
         if self.chain.should_set_weights():
             if self.has_vpermit(meta):

@@ -23,6 +23,7 @@ import tempfile
 from typing import Any
 
 from .. import serialization as ser
+from ..utils import obs
 from .base import Revision
 
 Params = Any
@@ -141,8 +142,12 @@ class HFHubTransport:
 
     # -- Transport API ------------------------------------------------------
     def publish_delta(self, miner_id: str, delta: Params) -> Revision:
-        repo = self.my_repo_id or miner_id
-        return self._upload(repo, DELTA_FILE, delta)
+        # spans nest inside the publisher's push.upload and inherit the
+        # thread's correlation id (utils/obs.py); Hub latency is the
+        # fleet's dominant phase, so it gets first-class attribution
+        with obs.span("transport.publish_delta", miner=miner_id):
+            repo = self.my_repo_id or miner_id
+            return self._upload(repo, DELTA_FILE, delta)
 
     def publish_raw(self, miner_id: str, data: bytes) -> Revision:
         """Pre-serialized (possibly signature-enveloped) delta bytes."""
@@ -150,7 +155,8 @@ class HFHubTransport:
         return self._upload_bytes(repo, DELTA_FILE, data)
 
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
-        return self._download(miner_id, DELTA_FILE, template)
+        with obs.span("transport.fetch_delta", miner=miner_id):
+            return self._download(miner_id, DELTA_FILE, template)
 
     def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
         """Raw bytes — multi-template validation (full vs LoRA wire formats)
@@ -183,8 +189,9 @@ class HFHubTransport:
                 pass  # best-effort, like the reference
 
     def publish_base(self, base: Params) -> Revision:
-        self._squash_base_repo()
-        return self._upload(self.base_repo_id, BASE_FILE, base)
+        with obs.span("transport.publish_base"):
+            self._squash_base_repo()
+            return self._upload(self.base_repo_id, BASE_FILE, base)
 
     def publish_base_raw(self, data: bytes) -> Revision:
         self._squash_base_repo()
@@ -194,10 +201,11 @@ class HFHubTransport:
         return self._download_bytes(self.base_repo_id, BASE_FILE)
 
     def fetch_base(self, template: Params):
-        tree = self._download(self.base_repo_id, BASE_FILE, template)
-        if tree is None:
-            return None
-        return tree, self._revision(self.base_repo_id)
+        with obs.span("transport.fetch_base"):
+            tree = self._download(self.base_repo_id, BASE_FILE, template)
+            if tree is None:
+                return None
+            return tree, self._revision(self.base_repo_id)
 
     def base_revision(self) -> Revision:
         return self._revision(self.base_repo_id)

@@ -23,6 +23,7 @@ from typing import Any
 
 from .. import serialization as ser
 from .. import signing
+from ..utils import obs
 from .base import (META_MAX_BYTES, Revision, encode_delta_meta,
                    parse_delta_meta)
 
@@ -85,9 +86,12 @@ class LocalFSTransport:
 
     # -- miner side ---------------------------------------------------------
     def publish_delta(self, miner_id: str, delta: Params) -> Revision:
-        path = self._delta_path(miner_id)
-        ser.save_file(delta, path)
-        return _hash_file(path)
+        # transport spans nest inside the caller's phase spans (e.g. the
+        # publisher's push.upload) and inherit the thread's correlation id
+        with obs.span("transport.publish_delta", miner=miner_id):
+            path = self._delta_path(miner_id)
+            ser.save_file(delta, path)
+            return _hash_file(path)
 
     def publish_raw(self, miner_id: str, data: bytes) -> Revision:
         """Arbitrary (possibly signature-enveloped, possibly hostile) bytes
@@ -98,17 +102,18 @@ class LocalFSTransport:
 
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
-        data = self.fetch_delta_bytes(miner_id)
-        if data is None:
-            return None
-        try:
-            # envelope-tolerant WITHOUT verification: an unsigned node on a
-            # signed fleet still reads artifacts (verification lives in
-            # SignedTransport, which uses the raw-bytes path instead)
-            return ser.validated_load(signing.strip_envelope(data), template,
-                                      max_bytes=self.max_bytes)
-        except ser.PayloadError:
-            return None
+        with obs.span("transport.fetch_delta", miner=miner_id):
+            data = self.fetch_delta_bytes(miner_id)
+            if data is None:
+                return None
+            try:
+                # envelope-tolerant WITHOUT verification: an unsigned node
+                # on a signed fleet still reads artifacts (verification
+                # lives in SignedTransport, which uses the raw-bytes path)
+                return ser.validated_load(signing.strip_envelope(data),
+                                          template, max_bytes=self.max_bytes)
+            except ser.PayloadError:
+                return None
 
     def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
         """Raw artifact bytes (size-capped), one read — for multi-template
@@ -131,8 +136,9 @@ class LocalFSTransport:
 
     # -- base model ---------------------------------------------------------
     def publish_base(self, base: Params) -> Revision:
-        ser.save_file(base, self._base_path)
-        return _hash_file(self._base_path)
+        with obs.span("transport.publish_base"):
+            ser.save_file(base, self._base_path)
+            return _hash_file(self._base_path)
 
     def publish_base_raw(self, data: bytes) -> Revision:
         """Pre-serialized (possibly signature-enveloped) base bytes."""
@@ -143,16 +149,17 @@ class LocalFSTransport:
         return _read_capped(self._base_path, self.max_bytes)
 
     def fetch_base(self, template: Params):
-        data = self.fetch_base_bytes()
-        if data is None:
-            return None
-        try:
-            tree = ser.validated_load(signing.strip_envelope(data), template,
-                                      max_bytes=self.max_bytes)
-        except ser.PayloadError:
-            # a torn/corrupt base must read as "absent", not crash the node
-            return None
-        return tree, _hash_file(self._base_path)
+        with obs.span("transport.fetch_base"):
+            data = self.fetch_base_bytes()
+            if data is None:
+                return None
+            try:
+                tree = ser.validated_load(signing.strip_envelope(data),
+                                          template, max_bytes=self.max_bytes)
+            except ser.PayloadError:
+                # a torn/corrupt base reads as "absent", never a crash
+                return None
+            return tree, _hash_file(self._base_path)
 
     def base_revision(self) -> Revision:
         return _hash_file(self._base_path)

@@ -59,16 +59,35 @@ def call_with_retry(fn: Callable, *, policy: RetryPolicy | None = None,
     terminal failure is fatal — for a miner push it never is).
 
     ``sleep`` is injectable so loops pass their Clock's sleep (FakeClock
-    tests retry pacing in microseconds) and workers stay real-time."""
+    tests retry pacing in microseconds) and workers stay real-time.
+
+    Every try feeds the observability registry (utils/obs.py, no-ops
+    unless a sink is configured): ``transport.retry.attempts`` counts
+    total tries, ``transport.retry.retries`` the failed-then-retried
+    ones, ``transport.retry.exhausted`` spent budgets, and
+    ``transport.retry.call_ms`` the per-try latency — the fleet-level
+    view of a flaky Hub that per-role logs cannot show."""
+    from ..utils import obs
+
     policy = policy or DEFAULT_PUBLISH_RETRY
     rng = rng or random.Random()
     for attempt in range(1, policy.attempts + 1):
+        obs.count("transport.retry.attempts")
+        t0 = time.perf_counter()
         try:
-            return fn()
+            out = fn()
         except Exception as e:
+            obs.observe("transport.retry.call_ms",
+                        (time.perf_counter() - t0) * 1e3)
             if attempt >= policy.attempts:
+                obs.count("transport.retry.exhausted")
                 raise
+            obs.count("transport.retry.retries")
             delay = policy.delay(attempt, rng)
             logger.warning("%s failed (attempt %d/%d), retrying in %.2fs: %s",
                            describe, attempt, policy.attempts, delay, e)
             sleep(delay)
+        else:
+            obs.observe("transport.retry.call_ms",
+                        (time.perf_counter() - t0) * 1e3)
+            return out

@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
+import weakref
 from typing import Any, Protocol
 
 
@@ -34,19 +36,39 @@ class InMemorySink:
 
 
 class JSONLSink:
-    """One JSON object per line; the default production sink."""
+    """One JSON object per line; the default production sink.
+
+    Thread-safe and crash-consistent: the publish worker
+    (engine/publish.py) logs from its background thread while the train
+    loop logs concurrently, so records are serialized under a lock and
+    each is written as ONE ``write()`` call of a complete line to a
+    handle kept open with line buffering (the old reopen-per-record
+    spelling paid an open/close syscall pair per record and could
+    interleave partial lines across threads). A reader that joins the
+    file mid-crash sees whole records or nothing."""
 
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = None  # opened lazily: no file until the first record
 
     def log(self, metrics: dict[str, Any], *, step: int | None = None) -> None:
         rec = {"ts": time.time(), "step": step, **metrics}
-        with open(self.path, "a") as f:
-            f.write(json.dumps(rec, default=float) + "\n")
+        line = json.dumps(rec, default=float) + "\n"
+        with self._lock:
+            if self._fh is None:
+                self._fh = open(self.path, "a", buffering=1)
+            self._fh.write(line)
 
     def log_params(self, params: dict[str, Any]) -> None:
         self.log({"params": params})
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is not None:
+            fh.close()
 
 
 class MLflowSink:
@@ -88,6 +110,16 @@ def multi_sink(*sinks: MetricsSink) -> MetricsSink:
     return _MultiSink(sinks)
 
 
+# captures whose jax profiler is RUNNING — the tests/conftest.py hygiene
+# guard asserts no test module leaves one behind (a leaked live profiler
+# poisons every later capture in the process)
+_LIVE_CAPTURES: "weakref.WeakSet[TraceCapture]" = weakref.WeakSet()
+
+
+def live_captures() -> list["TraceCapture"]:
+    return list(_LIVE_CAPTURES)
+
+
 class TraceCapture:
     """Bounded ``jax.profiler`` trace capture for the perf loop (SURVEY §5).
 
@@ -96,42 +128,90 @@ class TraceCapture:
     Poll ``tick()`` once per step from the training loop; it is a no-op
     after the capture window closes. Start is deferred to the first tick
     AFTER ``skip`` steps so compile time never pollutes the trace.
+
+    ``arm=False`` constructs it DISARMED: ticks are free no-ops until
+    ``arm()`` is called (the anomaly path, utils/obs.AnomalyMonitor —
+    skip counts from the arming tick, so the capture window lands on the
+    steps right after the anomaly fired). Arming is one-way and a
+    finished capture can never re-arm: one window per instance.
     """
 
-    def __init__(self, log_dir: str, *, steps: int = 5, skip: int = 3):
+    def __init__(self, log_dir: str, *, steps: int = 5, skip: int = 3,
+                 arm: bool = True):
         self.log_dir = log_dir
         self.steps = steps
         self.skip = skip
+        self._armed = arm
         self._seen = 0
         self._active = False
         self._done = False
+        self._jax = None  # cached on first armed tick (hot-loop: tick()
+        #                   must not pay an import-system lookup per step)
+
+    @property
+    def armed(self) -> bool:
+        return self._armed and not self._done
+
+    def arm(self) -> None:
+        if self._done or self._armed:
+            return
+        self._armed = True
+        self._seen = 0
 
     def tick(self) -> None:
-        if self._done:
+        if self._done or not self._armed:
             return
-        import jax
+        if self._jax is None:
+            import jax
+            self._jax = jax
         self._seen += 1
         if not self._active and self._seen > self.skip:
             os.makedirs(self.log_dir, exist_ok=True)
-            jax.profiler.start_trace(self.log_dir)
+            self._jax.profiler.start_trace(self.log_dir)
             self._active = True
+            _LIVE_CAPTURES.add(self)
         elif self._active and self._seen > self.skip + self.steps:
-            jax.profiler.stop_trace()
+            self._jax.profiler.stop_trace()
             self._active = False
             self._done = True
+            _LIVE_CAPTURES.discard(self)
 
     def close(self) -> None:
         """Stop an in-flight capture (role shutdown mid-window)."""
         if self._active:
-            import jax
+            if self._jax is None:  # pragma: no cover - active implies cached
+                import jax
+                self._jax = jax
             try:
-                jax.profiler.stop_trace()
+                self._jax.profiler.stop_trace()
             finally:
                 self._active = False
                 self._done = True
+                _LIVE_CAPTURES.discard(self)
 
 
 _NET_BASELINE = None  # (bytes_sent, bytes_recv) at this process's first sample
+# (psutil module, Process handle) once probed, False when unavailable —
+# device_metrics runs inside hot loops at the log cadence, and the old
+# spelling re-imported psutil and re-built the Process handle (a /proc
+# walk) on every call
+_PSUTIL_STATE = None
+
+
+def _psutil_state():
+    global _PSUTIL_STATE, _NET_BASELINE
+    if _PSUTIL_STATE is None:
+        try:
+            import psutil
+            proc = psutil.Process()
+            psutil.cpu_percent()  # prime: first call always reads 0.0
+            net = psutil.net_io_counters()
+            if _NET_BASELINE is None:
+                _NET_BASELINE = (net.bytes_sent, net.bytes_recv)
+            _PSUTIL_STATE = (psutil, proc)
+        except Exception:
+            _PSUTIL_STATE = False
+    return _PSUTIL_STATE
 
 
 def device_metrics() -> dict[str, float]:
@@ -154,21 +234,21 @@ def device_metrics() -> dict[str, float]:
     from .timeout import abandoned_total, abandoned_workers
     out["chain_abandoned_workers"] = float(abandoned_workers())
     out["chain_abandoned_total"] = float(abandoned_total())
-    try:
-        import psutil
-        out["cpu_percent"] = psutil.cpu_percent()
-        out["rss_mb"] = psutil.Process().memory_info().rss / 1e6
-        # net bytes parity (utils/mlflow_utils.py:15-69): on this framework
-        # the network IS the artifact plane, so transfer volume matters.
-        # psutil's counters are machine-wide since boot; report the delta
-        # from this process's first sample so runs are comparable (still
-        # host-wide — co-located traffic is included, as in the reference)
-        global _NET_BASELINE
-        net = psutil.net_io_counters()
-        if _NET_BASELINE is None:
-            _NET_BASELINE = (net.bytes_sent, net.bytes_recv)
-        out["net_sent_mb"] = (net.bytes_sent - _NET_BASELINE[0]) / 1e6
-        out["net_recv_mb"] = (net.bytes_recv - _NET_BASELINE[1]) / 1e6
-    except Exception:
-        pass
+    state = _psutil_state()
+    if state:
+        psutil, proc = state
+        try:
+            out["cpu_percent"] = psutil.cpu_percent()
+            out["rss_mb"] = proc.memory_info().rss / 1e6
+            # net bytes parity (utils/mlflow_utils.py:15-69): on this
+            # framework the network IS the artifact plane, so transfer
+            # volume matters. psutil's counters are machine-wide since
+            # boot; report the delta from this process's first sample so
+            # runs are comparable (still host-wide — co-located traffic
+            # is included, as in the reference)
+            net = psutil.net_io_counters()
+            out["net_sent_mb"] = (net.bytes_sent - _NET_BASELINE[0]) / 1e6
+            out["net_recv_mb"] = (net.bytes_recv - _NET_BASELINE[1]) / 1e6
+        except Exception:
+            pass
     return out

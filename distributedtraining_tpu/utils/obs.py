@@ -1,0 +1,514 @@
+"""Round-trip observability: spans, a phase-timing registry, correlation
+ids, and anomaly-triggered profiler capture.
+
+The reference ships flat per-role scalar logging (utils/mlflow_utils.py);
+after the validator's fetch/eval pipeline and the miner's background
+publish worker, the hot paths are asynchronous and cross-thread — a
+regression in push latency or fetch staleness is invisible in flat logs.
+This module is the one home of the structured layer every role emits:
+
+- ``span("push.upload")`` context managers record start/duration records
+  through the process's configured :class:`MetricsSink` (the same JSONL
+  file the scalar metrics land in) and feed a latency histogram per span
+  name. Spans nest; each record carries its parent and depth.
+- a process-wide :class:`Registry` of counters and latency histograms
+  (p50/p95/p99 from bounded ring reservoirs) with name linting —
+  ``[a-z0-9_.]`` only, and one name cannot be both a counter and a
+  histogram. ``flush()`` snapshots it through the sink at each role's
+  natural cadence (miner log boundary, validator/averager round end).
+- a **correlation id** per published artifact: the miner stamps
+  ``delta_id`` into the delta's meta rider (transport/base.py), the
+  validator and averager read it back and tag their fetch/screen/eval/
+  merge spans with it — one artifact's life (snapshot -> upload ->
+  fetch -> screen -> cohort-eval -> merge) is then reconstructable from
+  the per-role JSONL files by ``scripts/obs_report.py``.
+- :class:`AnomalyMonitor`: a loss spike, a push-failure streak, or a
+  step-time p99 blowout arms a ONE-SHOT ``TraceCapture``
+  (utils/metrics.py) so the profiler evidence of the first anomaly is on
+  disk before anyone is paged.
+
+Everything here is off unless a sink is configured (``configure``): the
+module-level ``count``/``observe`` helpers and ``span`` are single-branch
+no-ops when disabled, so instrumentation costs nothing in tests and
+tight benches that never opt in (bench._time_metrics_overhead pins the
+enabled cost: < 2% of step time).
+
+Thread discipline: the registry and the span emitter are lock-protected
+(the publish worker spans from its background thread while the train
+loop spans concurrently); the span STACK and current correlation id are
+thread-local, so a worker thread must re-enter its artifact's id via
+``correlate(cid)`` — DeltaPublisher does exactly that.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable
+
+logger = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+
+# cap on a correlation id read back from a PEER-CONTROLLED rider
+_CID_MAX_LEN = 120
+
+
+def check_metric_name(name: str) -> str:
+    """Registry name lint: reject anything outside ``[a-z0-9_.]`` before
+    it reaches a backend (MLflow key rules, grep-ability, and the
+    flattened ``<name>.p99`` snapshot spelling all assume it)."""
+    if not isinstance(name, str) or not _NAME_RE.match(name):
+        raise ValueError(
+            f"invalid metric name {name!r}: must match [a-z0-9_.]+")
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Instruments
+# ---------------------------------------------------------------------------
+
+class Counter:
+    """Monotonic float counter (thread-safe)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = check_metric_name(name)
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+def percentile(sorted_vals, q: float) -> float:
+    """numpy's default ('linear') percentile on an already-sorted list —
+    implemented locally so the hot observability path never imports
+    numpy (tests pin this against ``np.percentile``)."""
+    n = len(sorted_vals)
+    if n == 0:
+        return float("nan")
+    if n == 1:
+        return float(sorted_vals[0])
+    pos = (n - 1) * (q / 100.0)
+    lo = math.floor(pos)
+    hi = math.ceil(pos)
+    frac = pos - lo
+    return float(sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac)
+
+
+class Histogram:
+    """Latency histogram over a bounded ring reservoir (thread-safe).
+
+    The ring keeps the most recent ``capacity`` observations — percentiles
+    reflect CURRENT behavior, which is what an anomaly check wants (a
+    classic reservoir sample would dilute a fresh regression with hours
+    of healthy history). ``count``/``total`` are lifetime."""
+
+    __slots__ = ("name", "capacity", "_ring", "_count", "_total", "_lock")
+
+    def __init__(self, name: str, capacity: int = 512):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.name = check_metric_name(name)
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._count = 0
+        self._total = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._ring.append(float(value))
+            self._count += 1
+            self._total += float(value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def total(self) -> float:
+        return self._total
+
+    def percentiles(self, qs: Iterable[float] = (50.0, 95.0, 99.0)
+                    ) -> dict[str, float]:
+        with self._lock:
+            vals = sorted(self._ring)
+        return {f"p{int(q)}": percentile(vals, q) for q in qs}
+
+    def snapshot(self) -> dict[str, float]:
+        out = {"count": float(self._count), "sum": self._total}
+        if self._count:
+            out.update(self.percentiles())
+        return out
+
+
+class Registry:
+    """Named counters + histograms; get-or-create, kind-checked.
+
+    One name is ONE instrument: registering ``x`` as a counter after it
+    exists as a histogram (or vice versa) raises — the duplicate-
+    registration lint, so two call sites cannot silently split a metric
+    into two series."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Any] = {}
+
+    def _get(self, name: str, kind) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = kind(name)
+            elif not isinstance(m, kind):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {kind.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat numeric dict: counters as ``name``, histograms as
+        ``name.count/.sum/.p50/.p95/.p99`` — MLflow's numeric filter
+        keeps every key, JSONL keeps the record verbatim."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: dict[str, float] = {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = m.value
+            else:
+                for k, v in m.snapshot().items():
+                    out[f"{name}.{k}"] = v
+        return out
+
+    def flush_to(self, sink, *, step: int | None = None) -> dict[str, float]:
+        snap = self.snapshot()
+        if snap and sink is not None:
+            sink.log(snap, step=step)
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# Process-wide state
+# ---------------------------------------------------------------------------
+
+class _ObsState:
+    def __init__(self):
+        self.registry = Registry()
+        self.sink = None          # MetricsSink or None (None = disabled)
+        self.role: str | None = None
+        self.tl = threading.local()
+
+
+_STATE = _ObsState()
+
+
+def configure(sink, *, role: str | None = None) -> Registry:
+    """Bind the process's span/metric emitter to ``sink`` (a MetricsSink).
+    Called once per role boot (neurons/common.build); re-configuring
+    replaces the sink/role and keeps the registry."""
+    _STATE.sink = sink
+    _STATE.role = role
+    return _STATE.registry
+
+
+def enabled() -> bool:
+    return _STATE.sink is not None
+
+
+def registry() -> Registry:
+    return _STATE.registry
+
+
+def reset() -> None:
+    """Drop ALL global observability state (sink, role, registry, span
+    stacks). Role entry points call this on exit so sequential in-process
+    role runs (scripts/e2e_round.py, tests) never bleed metrics into each
+    other; the tests/conftest.py guard asserts every test module leaves
+    this state clean."""
+    global _STATE
+    _STATE = _ObsState()
+
+
+def dirty() -> bool:
+    """True when a sink is configured or the registry holds metrics —
+    what the conftest hygiene guard checks after each test module."""
+    return _STATE.sink is not None or len(_STATE.registry) > 0
+
+
+def count(name: str, n: float = 1.0) -> None:
+    """Increment a registry counter — single-branch no-op when disabled,
+    so hot paths may call this unconditionally."""
+    if _STATE.sink is None:
+        return
+    _STATE.registry.counter(name).inc(n)
+
+
+def observe(name: str, value: float) -> None:
+    """Record into a registry histogram — no-op when disabled."""
+    if _STATE.sink is None:
+        return
+    _STATE.registry.histogram(name).observe(value)
+
+
+def flush(sink=None, *, step: int | None = None) -> dict[str, float]:
+    """Snapshot the registry through ``sink`` (default: the configured
+    one). The periodic-flush primitive each role calls at its natural
+    cadence."""
+    if sink is None:
+        sink = _STATE.sink
+    if sink is None:
+        return {}
+    return _STATE.registry.flush_to(sink, step=step)
+
+
+# ---------------------------------------------------------------------------
+# Correlation ids
+# ---------------------------------------------------------------------------
+
+def new_delta_id(miner_id: str, seq: int) -> str:
+    """Deterministic per-push correlation id. Greppable, sortable, and
+    collision-free per miner per process run; the push SEQUENCE (not a
+    content hash) so superseded pushes stay distinguishable."""
+    return f"{miner_id}-{seq:06d}"
+
+
+def _tl():
+    tl = _STATE.tl
+    if not hasattr(tl, "stack"):
+        tl.stack = []
+        tl.cid = None
+    return tl
+
+
+def current_cid() -> str | None:
+    return getattr(_STATE.tl, "cid", None)
+
+
+@contextlib.contextmanager
+def correlate(cid: str | None):
+    """Set the CURRENT thread's correlation id for the duration — spans
+    opened inside inherit it. The publish worker re-enters its job's id
+    through this (thread-local state does not cross threads)."""
+    tl = _tl()
+    prev = tl.cid
+    tl.cid = cid
+    try:
+        yield
+    finally:
+        tl.cid = prev
+
+
+def rider_delta_id(meta: dict | None) -> str | None:
+    """Defensive read of ``delta_id`` from a PEER-CONTROLLED meta rider:
+    a short string or nothing (a hostile rider must not be able to
+    inject junk into span records or report joins)."""
+    if not isinstance(meta, dict):
+        return None
+    v = meta.get("delta_id")
+    if isinstance(v, str) and 0 < len(v) <= _CID_MAX_LEN:
+        return v
+    return None
+
+
+def fetch_cid(transport, miner_id: str) -> str | None:
+    """Correlation id of ``miner_id``'s current artifact, from its meta
+    rider — observability only, so every failure reads as None (riderless
+    miners and transports without riders stay fully supported)."""
+    if _STATE.sink is None:
+        return None
+    fm = getattr(transport, "fetch_delta_meta", None)
+    if fm is None:
+        return None
+    try:
+        return rider_delta_id(fm(miner_id))
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def span(name: str, *, cid: str | None = None, **attrs):
+    """Time a phase; on exit emit one record through the configured sink
+    and feed the ``span.<name>_ms`` histogram. Nesting is tracked per
+    thread (records carry ``parent`` and ``depth``). Zero-cost no-op when
+    no sink is configured. ``attrs`` ride verbatim in the record (keep
+    them JSON-able and small)."""
+    st = _STATE
+    if st.sink is None:
+        yield
+        return
+    check_metric_name(name)
+    tl = _tl()
+    parent = tl.stack[-1] if tl.stack else None
+    prev_cid = tl.cid
+    if cid is not None:
+        tl.cid = cid
+    tl.stack.append(name)
+    t0_wall = time.time()
+    t0 = time.perf_counter()
+    ok = True
+    try:
+        yield
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        tl.stack.pop()
+        ccid = tl.cid
+        tl.cid = prev_cid
+        st.registry.histogram(f"span.{name}_ms").observe(dur_ms)
+        rec = {"span": name, "dur_ms": round(dur_ms, 3), "t0": t0_wall,
+               "depth": len(tl.stack)}
+        if st.role is not None:
+            rec["role"] = st.role
+        if parent is not None:
+            rec["parent"] = parent
+        if ccid is not None:
+            rec["cid"] = ccid
+        if not ok:
+            rec["error"] = True
+        rec.update(attrs)
+        try:
+            st.sink.log(rec)
+        except Exception:  # a broken sink must never break the traced phase
+            logger.exception("span sink emit failed")
+
+
+# ---------------------------------------------------------------------------
+# Anomaly-triggered profiler capture
+# ---------------------------------------------------------------------------
+
+class AnomalyMonitor:
+    """Arms a one-shot TraceCapture (utils/metrics.py) on the FIRST of:
+
+    - loss spike: loss exceeds ``loss_spike_factor`` x its EMA (after
+      ``loss_warmup`` observations), or goes non-finite;
+    - push failure streak: ``push_failure_streak`` consecutive failed
+      pushes with no success in between;
+    - step-time p99 blowout: the recent-step p99 exceeds
+      ``step_p99_factor`` x p50 (after ``step_warmup`` steps; checked
+      every ``check_every`` observations so the per-step cost is one
+      deque append).
+
+    Exactly ONE arming per monitor lifetime, whatever fires afterwards —
+    a capture window is expensive evidence, and the first anomaly is the
+    one worth profiling. ``capture`` may be None (detection + counters
+    only). The miner loop feeds observations and forwards ``tick()``."""
+
+    def __init__(self, capture=None, *, loss_spike_factor: float = 2.0,
+                 loss_warmup: int = 8, push_failure_streak: int = 3,
+                 step_p99_factor: float = 8.0, step_warmup: int = 64,
+                 check_every: int = 32, step_capacity: int = 256):
+        if loss_spike_factor <= 1.0 or step_p99_factor <= 1.0:
+            raise ValueError("anomaly factors must be > 1.0")
+        if push_failure_streak < 1:
+            raise ValueError("push_failure_streak must be >= 1")
+        self.capture = capture
+        self.loss_spike_factor = loss_spike_factor
+        self.loss_warmup = loss_warmup
+        self.push_failure_streak = push_failure_streak
+        self.step_p99_factor = step_p99_factor
+        self.step_warmup = step_warmup
+        self.check_every = check_every
+        self.triggered: str | None = None
+        self._loss_ema: float | None = None
+        self._loss_seen = 0
+        self._fail_streak = 0
+        self._last_pushes = 0
+        self._last_failed = 0
+        self._steps = Histogram("anomaly.step_ms", capacity=step_capacity)
+
+    # -- observations -------------------------------------------------------
+    def observe_loss(self, loss: float) -> None:
+        loss = float(loss)
+        if not math.isfinite(loss):
+            self._trigger("loss_nonfinite", value=loss)
+            return
+        self._loss_seen += 1
+        if self._loss_ema is None:
+            self._loss_ema = loss
+            return
+        if (self._loss_seen > self.loss_warmup and self._loss_ema > 0
+                and loss > self.loss_spike_factor * self._loss_ema):
+            self._trigger("loss_spike", value=loss, ema=self._loss_ema)
+        self._loss_ema += 0.1 * (loss - self._loss_ema)
+
+    def observe_step_ms(self, ms: float) -> None:
+        self._steps.observe(ms)
+        n = self._steps.count
+        if n < self.step_warmup or n % self.check_every:
+            return
+        p = self._steps.percentiles((50.0, 99.0))
+        if p["p50"] > 0 and p["p99"] > self.step_p99_factor * p["p50"]:
+            self._trigger("step_time_p99", p50=p["p50"], p99=p["p99"])
+
+    def observe_push_counters(self, pushes: int, failed: int) -> None:
+        """Feed the loop's cumulative MinerReport counters; deltas since
+        the last call drive the streak (a success resets it)."""
+        d_push = pushes - self._last_pushes
+        d_fail = failed - self._last_failed
+        self._last_pushes, self._last_failed = pushes, failed
+        if d_push > 0:
+            self._fail_streak = 0
+        if d_fail > 0:
+            self._fail_streak += d_fail
+            if self._fail_streak >= self.push_failure_streak:
+                self._trigger("push_failure_streak",
+                              streak=self._fail_streak)
+
+    # -- capture plumbing ---------------------------------------------------
+    def tick(self) -> None:
+        """Forward one step tick to the (possibly armed) capture."""
+        if self.capture is not None:
+            self.capture.tick()
+
+    def close(self) -> None:
+        if self.capture is not None:
+            self.capture.close()
+
+    def _trigger(self, reason: str, **details) -> None:
+        if self.triggered is not None:
+            return  # one-shot: first anomaly wins, forever
+        self.triggered = reason
+        count(f"obs.anomaly.{reason}")
+        logger.warning("anomaly detected (%s%s)%s", reason,
+                       "".join(f" {k}={v:.4g}" for k, v in details.items()),
+                       "" if self.capture is None
+                       else " — arming one-shot profiler capture")
+        if _STATE.sink is not None:
+            try:
+                _STATE.sink.log({"anomaly": reason, **details})
+            except Exception:
+                logger.exception("anomaly sink emit failed")
+        if self.capture is not None:
+            self.capture.arm()

@@ -72,6 +72,10 @@ def main(argv=None) -> int:
                                    rounds=cfg.rounds)
     except KeyboardInterrupt:
         merged = loop.report.rounds > 0
+    finally:
+        # see neurons/miner.py: global obs state must not outlive the role
+        from distributedtraining_tpu.utils import obs
+        obs.reset()
     logging.info("averager done: rounds=%d accepted=%d rejected=%d loss=%.4f",
                  loop.report.rounds, loop.report.last_accepted,
                  loop.report.last_rejected, loop.report.last_loss)

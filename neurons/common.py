@@ -346,6 +346,14 @@ def build(cfg: RunConfig) -> Components:
                                 experiment=f"hivetrain-{cfg.netuid}",
                                 run_name=f"{cfg.role}-{cfg.hotkey}"))
     metrics = multi_sink(*sinks) if sinks else None
+    if metrics is not None:
+        # bind the process-wide span/counter emitter (utils/obs.py) to
+        # this role's sink: every engine/transport span and registry
+        # flush lands in the same JSONL the scalar metrics do, which is
+        # what scripts/obs_report.py joins across roles. Role mains reset
+        # it on exit so sequential in-process role runs (e2e) stay clean.
+        from distributedtraining_tpu.utils import obs
+        obs.configure(metrics, role=cfg.role)
 
     lora_cfg = None
     if cfg.lora_rank > 0:

@@ -60,6 +60,18 @@ def main(argv=None) -> int:
     if cfg.profile_dir:
         from distributedtraining_tpu.utils.metrics import TraceCapture
         trace = TraceCapture(cfg.profile_dir, steps=cfg.profile_steps)
+    anomaly = None
+    if cfg.anomaly_trace:
+        # disarmed capture + monitor: a loss spike, push-failure streak,
+        # or step-time p99 blowout arms ONE bounded profiler window
+        # automatically (utils/obs.AnomalyMonitor); until then every
+        # tick is a no-op
+        from distributedtraining_tpu.utils.metrics import TraceCapture
+        from distributedtraining_tpu.utils.obs import AnomalyMonitor
+        anomaly = AnomalyMonitor(TraceCapture(
+            cfg.anomaly_dir or os.path.join(cfg.work_dir, "anomaly_traces",
+                                            cfg.hotkey),
+            steps=cfg.profile_steps, arm=False))
     store = None
     if cfg.checkpoint_interval > 0:
         from distributedtraining_tpu.checkpoint import CheckpointStore
@@ -92,7 +104,8 @@ def main(argv=None) -> int:
                              checkpoint_interval=cfg.checkpoint_interval,
                              push_async=cfg.push_async,
                              push_queue_depth=cfg.push_queue_depth,
-                             trace=trace, **_guard_kwargs(cfg, c))
+                             trace=trace, anomaly=anomaly,
+                             **_guard_kwargs(cfg, c))
     else:
         loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
                          send_interval=cfg.send_interval,
@@ -106,7 +119,8 @@ def main(argv=None) -> int:
                          checkpoint_interval=cfg.checkpoint_interval,
                          push_async=cfg.push_async,
                          push_queue_depth=cfg.push_queue_depth,
-                         trace=trace, **_guard_kwargs(cfg, c))
+                         trace=trace, anomaly=anomaly,
+                         **_guard_kwargs(cfg, c))
     try:
         loop.bootstrap(params=c.initial_params)
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)
@@ -117,6 +131,11 @@ def main(argv=None) -> int:
     finally:
         if store is not None:
             store.close()
+        # drop the process-wide observability state: sequential in-process
+        # role runs (scripts/e2e_round.py, tests) must not bleed this
+        # role's registry/sink into the next
+        from distributedtraining_tpu.utils import obs
+        obs.reset()
     logging.info("miner done: steps=%d pushes=%d (failed=%d superseded=%d) "
                  "base_pulls=%d loss=%.4f",
                  report.steps, report.pushes, report.pushes_failed,

@@ -73,6 +73,10 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         logging.info("validator interrupted; exiting")
         return 0
+    finally:
+        # see neurons/miner.py: global obs state must not outlive the role
+        from distributedtraining_tpu.utils import obs
+        obs.reset()
     return 0 if ok else 1
 
 

@@ -107,6 +107,8 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         # averager's pubkey registers
         common += ["--sign-artifacts", "--base-signer", "hotkey_99"]
 
+    val_metrics = os.path.join(work_dir, "validator_metrics.jsonl")
+    avg_metrics = os.path.join(work_dir, "averager_metrics.jsonl")
     t0 = time.time()
     rc = miner.main(common + [
         "--hotkey", "hotkey_0", "--max-steps", str(steps),
@@ -115,11 +117,13 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         "--log-every", "5"]
         + (["--delta-dtype", delta_dtype] if delta_dtype else []))
     assert rc == 0, "miner failed"
-    rc = validator.main(common + ["--hotkey", "hotkey_91", "--rounds", "1"])
+    rc = validator.main(common + ["--hotkey", "hotkey_91", "--rounds", "1",
+                                  "--metrics-path", val_metrics])
     assert rc == 0, "validator failed"
     rc = averager.main(common + [
         "--hotkey", "hotkey_99", "--rounds", "1",
-        "--strategy", "parameterized", "--meta-epochs", "1"])
+        "--strategy", "parameterized", "--meta-epochs", "1",
+        "--metrics-path", avg_metrics])
     assert rc == 0, "averager failed"
     wall = time.time() - t0
 
@@ -144,9 +148,23 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
     import glob as _glob
     for tf in _glob.glob(os.path.join(work_dir, "tokenizer", "bpe-*.json")):
         tok_vocab = len(json.load(open(tf))["model"]["vocab"])
+    # round-trip trace: join the three roles' JSONL streams on the
+    # correlation id each delta's meta rider carried (scripts/obs_report.py)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import obs_report
+    obs_rep = obs_report.report([metrics_path, val_metrics, avg_metrics])
+    assert obs_rep["deltas"], "no correlated obs traces in the role JSONLs"
+    for cid, tr in obs_rep["deltas"].items():
+        missing = ({"snapshot", "upload", "fetch", "eval", "merge"}
+                   - set(tr["phases_ms"]))
+        assert not missing, f"trace {cid} missing phases {missing}"
+    print(obs_report.format_table(obs_rep))
+
     summary = {
         "protocol": "miner->delta->validator->averager, "
                     f"{model} from a pretrained-format checkpoint",
+        "obs_traces": {cid: tr["phases_ms"]
+                       for cid, tr in obs_rep["deltas"].items()},
         "corpus": corpus, "tokenizer": tok_desc,
         "fused_loss": fused_loss,
         "tokenizer_vocab": tok_vocab,

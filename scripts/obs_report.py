@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Join per-role JSONL metric streams on correlation id and print the
+round-trip phase breakdown per published delta.
+
+Each role writes span records (utils/obs.py) into its own JSONL sink; the
+miner stamps a ``delta_id`` correlation id into every push's meta rider
+and the validator/averager tag their fetch/screen/eval/merge spans with
+the id they read back. This script is the offline half: it joins the
+three files on ``cid`` and prints, per delta, the life of the artifact —
+
+    snapshot -> upload -> fetch -> screen -> eval -> merge
+
+with per-phase durations and the end-to-end wall-clock from snapshot
+dispatch to merge. Phases emitted against a whole cohort (the batched
+cohort eval, the merge) carry a ``cids`` list; their duration is shared
+by every member and is annotated with the cohort size.
+
+Usage:
+    python scripts/obs_report.py miner.jsonl validator.jsonl averager.jsonl
+    python scripts/obs_report.py --work-dir ./run      # globs *.jsonl
+    python scripts/obs_report.py ... --json report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# span name -> report phase, in round-trip order. push.screen /
+# push.materialize / push.meta fold into "upload" (they are the same
+# publish lane's host cost); avg.fetch folds into "fetch" when the
+# validator's is absent (averager-only deployments).
+PHASE_ORDER = ("snapshot", "upload", "fetch", "screen", "eval", "merge")
+SPAN_PHASE = {
+    "push.snapshot": "snapshot",
+    "push.screen": "upload",
+    "push.materialize": "upload",
+    "push.upload": "upload",
+    "push.meta": "upload",
+    "val.fetch": "fetch",
+    "avg.fetch": "fetch",
+    "val.screen": "screen",
+    "val.eval": "eval",
+    "val.cohort_eval": "eval",
+    "avg.merge": "merge",
+    "avg.publish": "merge",
+}
+
+
+def load_records(paths: list[str]) -> list[dict]:
+    records = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line of a crashed writer
+                    if isinstance(rec, dict):
+                        records.append(rec)
+        except OSError as e:
+            print(f"warning: cannot read {path}: {e}", file=sys.stderr)
+    return records
+
+
+def build_traces(records: list[dict]) -> dict[str, list[dict]]:
+    """cid -> span records (a ``cids`` list fans the record out to every
+    member, annotated with the sharing count)."""
+    traces: dict[str, list[dict]] = {}
+    for rec in records:
+        if "span" not in rec:
+            continue
+        cids = []
+        if isinstance(rec.get("cid"), str):
+            cids.append(rec["cid"])
+        shared = rec.get("cids")
+        if isinstance(shared, list):
+            cids.extend(c for c in shared if isinstance(c, str))
+        for cid in dict.fromkeys(cids):  # dedup, keep order
+            r = dict(rec)
+            if len(cids) > 1 or (isinstance(shared, list) and shared):
+                r["shared_by"] = max(len(cids), len(shared or []))
+            traces.setdefault(cid, []).append(r)
+    for recs in traces.values():
+        recs.sort(key=lambda r: r.get("t0", 0.0))
+    return traces
+
+
+def summarize_trace(recs: list[dict]) -> dict:
+    """Per-phase duration sums + end-to-end wall-clock for one cid."""
+    phases: dict[str, float] = {}
+    shared: dict[str, int] = {}
+    t_first = t_last = None
+    for r in recs:
+        phase = SPAN_PHASE.get(r.get("span"))
+        dur = r.get("dur_ms")
+        t0 = r.get("t0")
+        if phase is None or not isinstance(dur, (int, float)):
+            continue
+        phases[phase] = phases.get(phase, 0.0) + float(dur)
+        if r.get("shared_by"):
+            shared[phase] = max(shared.get(phase, 0), int(r["shared_by"]))
+        if isinstance(t0, (int, float)):
+            t_first = t0 if t_first is None else min(t_first, t0)
+            t_end = t0 + float(dur) / 1e3
+            t_last = t_end if t_last is None else max(t_last, t_end)
+    out = {"phases_ms": phases, "spans": len(recs)}
+    if shared:
+        out["shared_by"] = shared
+    if t_first is not None and t_last is not None:
+        out["roundtrip_s"] = round(t_last - t_first, 3)
+    return out
+
+
+def report(paths: list[str]) -> dict:
+    records = load_records(paths)
+    traces = build_traces(records)
+    return {
+        "files": paths,
+        "records": len(records),
+        "span_records": sum(1 for r in records if "span" in r),
+        "deltas": {cid: summarize_trace(recs)
+                   for cid, recs in sorted(traces.items())},
+    }
+
+
+def format_table(rep: dict) -> str:
+    header = ["delta_id"] + list(PHASE_ORDER) + ["roundtrip_s"]
+    rows = []
+    for cid, summary in rep["deltas"].items():
+        phases = summary["phases_ms"]
+        shared = summary.get("shared_by", {})
+        row = [cid]
+        for phase in PHASE_ORDER:
+            if phase in phases:
+                cell = f"{phases[phase]:.1f}"
+                if phase in shared:
+                    cell += f"/{shared[phase]}"  # cohort-shared duration
+                row.append(cell)
+            else:
+                row.append("-")
+        row.append(str(summary.get("roundtrip_s", "-")))
+        rows.append(row)
+    widths = [max(len(r[i]) for r in [header] + rows)
+              for i in range(len(header))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    lines.append("")
+    lines.append("phase durations in ms (X/N = one program shared by an "
+                 "N-candidate cohort); roundtrip = first span start to "
+                 "last span end")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*", help="per-role JSONL metric files")
+    p.add_argument("--work-dir", default=None,
+                   help="glob <work-dir>/*.jsonl instead of listing files")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the full report as JSON here")
+    a = p.parse_args(argv)
+    paths = list(a.files)
+    if a.work_dir:
+        paths += sorted(glob.glob(os.path.join(a.work_dir, "*.jsonl")))
+    if not paths:
+        p.error("no input files (pass JSONL paths or --work-dir)")
+    rep = report(paths)
+    if not rep["deltas"]:
+        print(f"no correlated spans found in {len(paths)} file(s) "
+              f"({rep['span_records']} span records total — are the roles "
+              "running with --metrics-path and a rider-capable transport?)")
+        return 1
+    print(format_table(rep))
+    if a.json_out:
+        with open(a.json_out, "w") as f:
+            json.dump(rep, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head et al. closing stdout is not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -51,6 +51,32 @@ def _no_leaked_nondaemon_threads():
         _time.sleep(0.05)
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _no_leaked_obs_state():
+    """Observability hygiene (mirrors the thread-leak guard above): the
+    span/metric layer (utils/obs.py) is PROCESS-WIDE state — a test that
+    configures a sink or populates the global registry and walks away
+    silently pollutes every later module's metrics, and a TraceCapture
+    whose jax profiler is still running poisons every later capture in
+    the process. Each test module must leave both clean (obs.reset(), and
+    drained/closed captures); this guard asserts it and force-cleans so
+    one offender cannot cascade."""
+    yield
+    from distributedtraining_tpu.utils import metrics as metrics_mod
+    from distributedtraining_tpu.utils import obs
+
+    live = metrics_mod.live_captures()
+    for cap in live:
+        cap.close()
+    was_dirty = obs.dirty()
+    leftover = obs.registry().names() if was_dirty else []
+    obs.reset()
+    assert not live, f"test module left a running TraceCapture: {live}"
+    assert not was_dirty, (
+        "test module left global obs state behind (configured sink or "
+        f"registry metrics {leftover}); call obs.reset() in teardown")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -46,8 +46,13 @@ def test_three_miners_validator_averager(tmp_path):
         assert p.returncode == 0, out[-2000:]
         assert "miner done: steps=25" in out, out[-2000:]
 
-    deltas = os.listdir(os.path.join(work, "artifacts", "deltas"))
-    assert len(deltas) == 3, deltas
+    listing = os.listdir(os.path.join(work, "artifacts", "deltas"))
+    deltas = [f for f in listing if f.endswith(".msgpack")]
+    assert len(deltas) == 3, listing
+    # every artifact ships its meta rider (base revision + the delta_id
+    # correlation id, utils/obs.py)
+    riders = [f for f in listing if f.endswith(".meta.json")]
+    assert len(riders) == 3, listing
 
     v = _run("validator", "--work-dir", work, *COMMON,
              "--hotkey", "hotkey_91", "--rounds", "1")

@@ -1,0 +1,470 @@
+"""Round-trip observability layer (utils/obs.py + scripts/obs_report.py).
+
+Covers: span nesting/ordering through the configured sink, histogram
+percentiles against the numpy reference, registry name/kind linting,
+JSONLSink thread-safety, anomaly triggers arming a TraceCapture exactly
+once, TraceCapture arm gating, and the full correlation-id round trip —
+a localfs miner -> validator -> averager mini-round whose three JSONL
+streams join into one per-delta phase trace via scripts/obs_report.py.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.engine import TrainEngine
+from distributedtraining_tpu.engine.average import AveragerLoop, WeightedAverage
+from distributedtraining_tpu.engine.train import MinerLoop
+from distributedtraining_tpu.engine.validate import Validator
+from distributedtraining_tpu.chain.local import LocalChain
+from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.transport import LocalFSTransport
+from distributedtraining_tpu.utils import obs
+from distributedtraining_tpu.utils.metrics import (InMemorySink, JSONLSink,
+                                                   TraceCapture,
+                                                   device_metrics,
+                                                   live_captures)
+from distributedtraining_tpu.utils.obs import AnomalyMonitor, Registry
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import obs_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentiles_match_numpy():
+    reg = Registry()
+    h = reg.histogram("test.latency_ms")
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(0.0, 100.0, size=200)
+    for v in vals:
+        h.observe(float(v))
+    p = h.percentiles()
+    for q in (50, 95, 99):
+        assert p[f"p{q}"] == pytest.approx(np.percentile(vals, q), abs=1e-9)
+    assert h.count == 200
+    snap = reg.snapshot()
+    assert snap["test.latency_ms.count"] == 200.0
+    assert snap["test.latency_ms.p95"] == p["p95"]
+
+
+def test_histogram_ring_is_bounded():
+    h = Registry().histogram("test.h")
+    for v in range(10_000):
+        h.observe(float(v))
+    assert h.count == 10_000
+    assert len(h._ring) == h.capacity
+    # percentiles reflect the most recent window only
+    assert h.percentiles()["p50"] >= 10_000 - h.capacity
+
+
+def test_metric_name_lint():
+    reg = Registry()
+    for bad in ("Bad", "a-b", "a b", "", "UPPER.case", "x/y"):
+        with pytest.raises(ValueError):
+            reg.counter(bad)
+    reg.counter("ok.name_1")  # valid
+    # duplicate registration under a different kind is rejected
+    with pytest.raises(ValueError):
+        reg.histogram("ok.name_1")
+    # get-or-create under the SAME kind returns the same instrument
+    assert reg.counter("ok.name_1") is reg.counter("ok.name_1")
+
+
+def test_registry_flush_to_sink():
+    reg = Registry()
+    reg.counter("c.x").inc(3)
+    reg.histogram("h.y").observe(2.0)
+    sink = InMemorySink()
+    snap = reg.flush_to(sink, step=7)
+    assert snap["c.x"] == 3.0
+    assert sink.records[-1]["step"] == 7
+    assert sink.records[-1]["h.y.count"] == 1.0
+
+
+def test_module_helpers_noop_when_disabled():
+    obs.count("x.y", 2)
+    obs.observe("x.z", 1.0)
+    with obs.span("x.phase"):
+        pass
+    assert not obs.dirty()  # nothing recorded, nothing configured
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_ordering_and_cid_inheritance():
+    sink = InMemorySink()
+    obs.configure(sink, role="tester")
+    with obs.span("outer", cid="cid-1", foo="bar"):
+        with obs.span("inner"):
+            pass
+    spans = [r for r in sink.records if "span" in r]
+    assert [s["span"] for s in spans] == ["inner", "outer"]  # exit order
+    inner, outer = spans
+    assert inner["parent"] == "outer" and inner["depth"] == 1
+    assert "parent" not in outer and outer["depth"] == 0
+    assert inner["cid"] == "cid-1"  # inherited from the enclosing span
+    assert outer["cid"] == "cid-1" and outer["foo"] == "bar"
+    assert outer["role"] == inner["role"] == "tester"
+    assert outer["dur_ms"] >= inner["dur_ms"]
+    assert outer["t0"] <= inner["t0"]
+    # span latencies also land in the registry
+    assert obs.registry().histogram("span.outer_ms").count == 1
+
+
+def test_span_records_error_flag():
+    sink = InMemorySink()
+    obs.configure(sink)
+    with pytest.raises(RuntimeError):
+        with obs.span("boom"):
+            raise RuntimeError("x")
+    rec = [r for r in sink.records if r.get("span") == "boom"][0]
+    assert rec["error"] is True
+
+
+def test_correlate_is_thread_local():
+    sink = InMemorySink()
+    obs.configure(sink)
+    seen = {}
+
+    def worker():
+        seen["worker_cid"] = obs.current_cid()
+        with obs.correlate("w-1"):
+            with obs.span("w.phase"):
+                pass
+
+    with obs.correlate("main-1"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert obs.current_cid() == "main-1"
+    assert seen["worker_cid"] is None  # main's cid never leaked across
+    rec = [r for r in sink.records if r.get("span") == "w.phase"][0]
+    assert rec["cid"] == "w-1"
+
+
+# ---------------------------------------------------------------------------
+# JSONLSink thread-safety (PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_jsonl_sink_concurrent_writers_no_torn_lines(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    sink = JSONLSink(str(path))
+    n_threads, n_records = 8, 200
+
+    def writer(tid):
+        for i in range(n_records):
+            sink.log({"tid": tid, "i": i, "pad": "x" * 64})
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == n_threads * n_records
+    recs = [json.loads(line) for line in lines]  # every line parses whole
+    per_tid = {}
+    for r in recs:
+        per_tid.setdefault(r["tid"], []).append(r["i"])
+    for tid, seq in per_tid.items():
+        assert seq == list(range(n_records))  # per-writer order preserved
+
+
+def test_jsonl_sink_lazy_file_creation(tmp_path):
+    path = tmp_path / "lazy.jsonl"
+    sink = JSONLSink(str(path))
+    assert not path.exists()  # no file until the first record
+    sink.log({"a": 1})
+    assert path.exists()
+    sink.close()
+
+
+# ---------------------------------------------------------------------------
+# Anomaly triggers + TraceCapture arming
+# ---------------------------------------------------------------------------
+
+class _StubCapture:
+    def __init__(self):
+        self.arm_calls = 0
+        self.ticks = 0
+        self.closed = False
+
+    def arm(self):
+        self.arm_calls += 1
+
+    def tick(self):
+        self.ticks += 1
+
+    def close(self):
+        self.closed = True
+
+
+def test_anomaly_loss_spike_arms_capture_exactly_once():
+    cap = _StubCapture()
+    mon = AnomalyMonitor(cap, loss_warmup=2, push_failure_streak=2)
+    for _ in range(3):
+        mon.observe_loss(1.0)
+    assert mon.triggered is None
+    mon.observe_loss(10.0)  # > 2x EMA
+    assert mon.triggered == "loss_spike"
+    assert cap.arm_calls == 1
+    # later anomalies of ANY kind never re-arm
+    mon.observe_loss(100.0)
+    mon.observe_push_counters(0, 5)
+    mon.observe_loss(float("nan"))
+    assert cap.arm_calls == 1
+    assert mon.triggered == "loss_spike"  # first reason wins
+
+
+def test_anomaly_push_failure_streak():
+    cap = _StubCapture()
+    mon = AnomalyMonitor(cap, push_failure_streak=3)
+    mon.observe_push_counters(pushes=1, failed=1)
+    mon.observe_push_counters(pushes=2, failed=1)  # success resets streak
+    mon.observe_push_counters(pushes=2, failed=2)
+    mon.observe_push_counters(pushes=2, failed=3)
+    assert mon.triggered is None
+    mon.observe_push_counters(pushes=2, failed=4)
+    assert mon.triggered == "push_failure_streak"
+    assert cap.arm_calls == 1
+
+
+def test_anomaly_step_time_p99_blowout():
+    cap = _StubCapture()
+    mon = AnomalyMonitor(cap, step_warmup=64, check_every=32,
+                         step_p99_factor=8.0)
+    for _ in range(63):
+        mon.observe_step_ms(1.0)
+    assert mon.triggered is None
+    for _ in range(33):  # p99 >> 8x p50 once the check lands
+        mon.observe_step_ms(500.0)
+    assert mon.triggered == "step_time_p99"
+    assert cap.arm_calls == 1
+
+
+def test_anomaly_nonfinite_loss_triggers():
+    mon = AnomalyMonitor(None)  # capture-less monitor: detection only
+    mon.observe_loss(float("inf"))
+    assert mon.triggered == "loss_nonfinite"
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.started = []
+        self.stopped = 0
+
+    def start_trace(self, d):
+        self.started.append(d)
+
+    def stop_trace(self):
+        self.stopped += 1
+
+
+class _FakeJax:
+    def __init__(self):
+        self.profiler = _FakeProfiler()
+
+
+def test_tracecapture_arm_gating(tmp_path):
+    cap = TraceCapture(str(tmp_path / "tr"), steps=2, skip=1, arm=False)
+    cap._jax = _FakeJax()  # never touch the real profiler in tests
+    for _ in range(10):
+        cap.tick()  # disarmed: free no-ops
+    assert not cap._jax.profiler.started and not cap._done
+    cap.arm()
+    assert cap.armed
+    cap.tick()                       # skip window
+    assert not cap._jax.profiler.started
+    cap.tick()                       # starts
+    assert cap._jax.profiler.started == [str(tmp_path / "tr")]
+    assert cap in live_captures()
+    cap.tick()                       # in-window
+    cap.tick()                       # stops (seen > skip + steps)
+    assert cap._jax.profiler.stopped == 1 and cap._done
+    assert cap not in live_captures()
+    cap.arm()                        # a finished capture can never re-arm
+    cap.tick()
+    assert cap._jax.profiler.stopped == 1
+    assert len(cap._jax.profiler.started) == 1
+
+
+def test_tracecapture_default_is_armed(tmp_path):
+    cap = TraceCapture(str(tmp_path / "tr"), steps=1, skip=0)
+    cap._jax = _FakeJax()
+    cap.tick()
+    assert cap._jax.profiler.started  # legacy behavior: live immediately
+    cap.close()
+    assert cap._jax.profiler.stopped == 1
+
+
+def test_device_metrics_cached_psutil_state():
+    a = device_metrics()
+    b = device_metrics()
+    assert "chain_abandoned_workers" in a
+    # psutil ships in this image; the cached-state path must keep serving
+    if "rss_mb" in a:
+        assert "rss_mb" in b and b["rss_mb"] > 0
+
+
+# ---------------------------------------------------------------------------
+# obs_report joining
+# ---------------------------------------------------------------------------
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_obs_report_joins_three_streams(tmp_path):
+    cid = "hotkey_0-000001"
+    _write_jsonl(tmp_path / "miner.jsonl", [
+        {"ts": 1.0, "train_loss": 3.2},  # non-span records are ignored
+        {"span": "push.snapshot", "cid": cid, "dur_ms": 5.0, "t0": 100.0},
+        {"span": "push.upload", "cid": cid, "dur_ms": 50.0, "t0": 100.01},
+    ])
+    _write_jsonl(tmp_path / "validator.jsonl", [
+        {"span": "val.fetch", "cid": cid, "dur_ms": 8.0, "t0": 101.0},
+        {"span": "val.screen", "cid": cid, "dur_ms": 2.0, "t0": 101.01},
+        {"span": "val.cohort_eval", "cids": [cid, "other-000007"],
+         "dur_ms": 30.0, "t0": 102.0},
+    ])
+    _write_jsonl(tmp_path / "averager.jsonl", [
+        {"span": "avg.merge", "cids": [cid], "dur_ms": 20.0, "t0": 110.0},
+    ])
+    rep = obs_report.report([str(tmp_path / f) for f in
+                             ("miner.jsonl", "validator.jsonl",
+                              "averager.jsonl")])
+    tr = rep["deltas"][cid]
+    assert set(tr["phases_ms"]) == {"snapshot", "upload", "fetch", "screen",
+                                    "eval", "merge"}
+    assert tr["phases_ms"]["upload"] == pytest.approx(50.0)
+    assert tr["phases_ms"]["eval"] == pytest.approx(30.0)
+    assert tr["shared_by"]["eval"] == 2  # cohort program shared by 2 cids
+    assert tr["roundtrip_s"] == pytest.approx(110.02 - 100.0, abs=1e-3)
+    # the cohort-mate got its own (eval-only) trace
+    assert "other-000007" in rep["deltas"]
+    table = obs_report.format_table(rep)
+    assert cid in table and "roundtrip_s" in table
+
+
+def test_obs_report_tolerates_torn_tail(tmp_path):
+    p = tmp_path / "m.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"span": "push.upload", "cid": "c-1",
+                            "dur_ms": 1.0, "t0": 1.0}) + "\n")
+        f.write('{"span": "push.m')  # crashed writer's torn last line
+    rep = obs_report.report([str(p)])
+    assert list(rep["deltas"]) == ["c-1"]
+
+
+# ---------------------------------------------------------------------------
+# Correlation round trip: localfs miner -> validator -> averager
+# ---------------------------------------------------------------------------
+
+def _batch(cfg, n=2, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (n, seq)), np.int32)}
+
+
+def test_correlation_id_roundtrip_localfs(tmp_path):
+    model, cfg = gpt2.make_model("tiny")
+    transport = LocalFSTransport(str(tmp_path / "artifacts"))
+    chain_dir = str(tmp_path / "chain")
+    batch = _batch(cfg)
+
+    def eval_batches():
+        yield _batch(cfg, seed=1)
+
+    paths = {r: str(tmp_path / f"{r}.jsonl")
+             for r in ("miner", "validator", "averager")}
+
+    # -- miner: train a few steps, push with a correlation id --------------
+    sink = JSONLSink(paths["miner"])
+    obs.configure(sink, role="miner")
+    try:
+        loop = MinerLoop(TrainEngine(model, seq_len=16), transport,
+                         "hotkey_0", send_interval=1e9,
+                         check_update_interval=1e9, metrics=sink,
+                         log_every=2)
+        loop.bootstrap(jax.random.PRNGKey(0))
+        loop.run(iter([batch] * 3), max_steps=3)
+        loop.flush()  # the push: snapshot/upload spans + delta_id rider
+        assert loop.report.pushes == 1
+    finally:
+        obs.reset()
+        sink.close()
+
+    meta = transport.fetch_delta_meta("hotkey_0")
+    cid = obs.rider_delta_id(meta)
+    assert cid == "hotkey_0-000001"
+
+    # -- validator: cohort-scores the delta, spans tagged with the cid -----
+    sink = JSONLSink(paths["validator"])
+    obs.configure(sink, role="validator")
+    try:
+        val = Validator(TrainEngine(model, seq_len=16), transport,
+                        LocalChain(chain_dir, my_hotkey="hotkey_91"),
+                        eval_batches=eval_batches, metrics=sink,
+                        cohort_size=8, pipeline_depth=1)
+        val.bootstrap(rng=jax.random.PRNGKey(0))
+        results = val.validate_and_score()
+        assert any(s.hotkey == "hotkey_0" and s.loss is not None
+                   for s in results)
+    finally:
+        obs.reset()
+        sink.close()
+
+    # -- averager: merges it, the merge span records the cid ---------------
+    sink = JSONLSink(paths["averager"])
+    obs.configure(sink, role="averager")
+    try:
+        avg = AveragerLoop(TrainEngine(model, seq_len=16), transport,
+                           LocalChain(chain_dir, my_hotkey="hotkey_99"),
+                           WeightedAverage(uniform=True),
+                           val_batches=eval_batches, metrics=sink)
+        avg.bootstrap(rng=jax.random.PRNGKey(0))
+        assert avg.run_round() is True
+        assert avg.report.last_accepted == 1
+    finally:
+        obs.reset()
+        sink.close()
+
+    # -- join: one trace covering the artifact's whole life ----------------
+    rep = obs_report.report(list(paths.values()))
+    assert cid in rep["deltas"], rep["deltas"].keys()
+    phases = rep["deltas"][cid]["phases_ms"]
+    for phase in ("snapshot", "upload", "fetch", "screen", "eval", "merge"):
+        assert phase in phases, f"missing {phase}: {phases}"
+    assert rep["deltas"][cid]["roundtrip_s"] >= 0
+    # per-role roles tagged correctly in the raw records
+    recs = obs_report.load_records([paths["validator"]])
+    vs = [r for r in recs if r.get("span") == "val.fetch"
+          and r.get("cid") == cid]
+    assert vs and vs[0]["role"] == "validator"
+    # the averager's metrics record names which delta ids entered the merge
+    arecs = obs_report.load_records([paths["averager"]])
+    merged_ids = [r["merge_delta_ids"] for r in arecs
+                  if "merge_delta_ids" in r]
+    assert merged_ids and merged_ids[-1] == {"hotkey_0": cid}

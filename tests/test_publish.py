@@ -225,7 +225,10 @@ def test_meta_rider_published_from_worker(setup):
         jax.random.PRNGKey(1)).params)
     t, loop = _run_miner(model, batch, push_async=True, transport=transport)
     assert loop.report.base_pulls == 0  # bootstrap pulled it, not run()
-    assert t.fetch_delta_meta("m0") == {"base_revision": rev}
+    meta = t.fetch_delta_meta("m0")
+    assert meta["base_revision"] == rev
+    # the rider also carries the push's correlation id (utils/obs.py)
+    assert meta["delta_id"].startswith("m0-")
 
 
 # ---------------------------------------------------------------------------

@@ -100,8 +100,9 @@ def test_miner_publishes_rider_and_averager_skips_stale(tmp_path):
     val = list(batches(1))
     miner.run(iter(val * 4), max_steps=4)
     miner.flush()
-    assert transport.fetch_delta_meta("m0") == {
-        "base_revision": miner._base_revision}
+    meta = transport.fetch_delta_meta("m0")
+    assert meta["base_revision"] == miner._base_revision
+    assert meta["delta_id"] == "m0-000001"  # correlation id rides along
 
     # FIXED val batches (the same ones the miner trained on): the publish
     # guard compares base vs merged on the same batch factory — a
