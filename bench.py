@@ -333,6 +333,124 @@ def _time_push_overlap(*, latency_s: float = 0.15, steps: int = 24,
     return out
 
 
+def _time_gather_deltas(*, n_miners: int = 4, latency_s: float = 0.05,
+                        trials: int = 2) -> dict:
+    """Averager ingest A/B over localfs (round-9 tentpole): serial ingest
+    (1 worker, cache disabled — the shape of the pre-ingest gather loop)
+    vs the pooled + content-addressed-cached ingestor
+    (engine/ingest.py), staging the IDENTICAL artifacts.
+
+      averager_ingest_serial_ms   serial cold round (per-miner sequential
+                                  fetch+decode)
+      averager_ingest_ms          pooled cold round (all fetches in
+                                  flight at once, fused cohort screen)
+      averager_ingest_warm_ms     pooled round with unchanged revisions —
+                                  revision probes only, zero downloads
+      ingest_speedup_cold/warm    serial / pooled wall-clock
+      ingest_warm_downloads       artifact fetches in the warm round
+                                  (acceptance: exactly 0)
+      ingest_parity               accepted ids + delta bytes identical in
+                                  both modes
+
+    CPU-measurable: the contrast is transport latency overlap and skipped
+    downloads — host/network time that exists identically on every
+    backend. The simulated per-fetch latency is conservative vs a real
+    Hub LFS pull (O(seconds) in every E2E round artifact)."""
+    import shutil
+    import tempfile
+
+    from distributedtraining_tpu import serialization as ser
+    from distributedtraining_tpu.engine.ingest import DeltaIngestor
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import LocalFSTransport
+
+    model, cfg = gpt2.make_model("tiny")
+    base = model.init_params(jax.random.PRNGKey(0))
+    host = jax.tree_util.tree_map(
+        lambda x: np.zeros(x.shape, x.dtype), base)
+
+    tmp = tempfile.mkdtemp(prefix="ingest_bench_")
+    try:
+        downloads = []
+
+        class SlowFS(LocalFSTransport):
+            def fetch_delta_bytes(self, miner_id):
+                time.sleep(latency_s)   # simulated network pull
+                downloads.append(miner_id)
+                return super().fetch_delta_bytes(miner_id)
+
+        transport = SlowFS(tmp)
+        hotkeys = [f"m{i}" for i in range(n_miners)]
+        key = jax.random.PRNGKey(1)
+        leaves, treedef = jax.tree_util.tree_flatten(base)
+        for i, h in enumerate(hotkeys):
+            key, k = jax.random.split(key)
+            ks = jax.random.split(k, len(leaves))
+            transport.publish_delta(h, jax.tree_util.tree_unflatten(
+                treedef, [0.01 * jax.random.normal(s, l.shape, l.dtype)
+                          for s, l in zip(ks, leaves)]))
+            transport.publish_delta_meta(
+                h, {"base_revision": "r1", "delta_id": f"{h}-000001"})
+
+        serial = DeltaIngestor(transport, host, workers=1, cache_bytes=0,
+                               max_delta_abs=1e3)
+        pooled = DeltaIngestor(transport, host,
+                               workers=min(8, n_miners),
+                               max_delta_abs=1e3)
+        try:
+            serial.stage(hotkeys)   # warm the fused screen's compile
+            pooled.cache.clear()
+
+            def timed(ing, *, clear: bool):
+                if clear:
+                    ing.cache.clear()
+                t0 = time.perf_counter()
+                staged = ing.stage(hotkeys)
+                return time.perf_counter() - t0, staged
+
+            # interleaved serial/cold/warm triplets (measure.sh rule 4)
+            t_serial, t_cold, t_warm = [], [], []
+            staged_serial = staged_cold = staged_warm = None
+            warm_downloads = 0
+            for _ in range(trials):
+                dt, staged_serial = timed(serial, clear=True)
+                t_serial.append(dt)
+                dt, staged_cold = timed(pooled, clear=True)
+                t_cold.append(dt)
+                downloads.clear()
+                dt, staged_warm = timed(pooled, clear=False)
+                t_warm.append(dt)
+                warm_downloads += len(downloads)
+
+            def accepted(staged):
+                return [(s.hotkey, ser.to_msgpack(s.delta))
+                        for s in staged if s.delta is not None]
+
+            parity = (accepted(staged_serial) == accepted(staged_cold)
+                      == accepted(staged_warm))
+            ser_ms = float(np.mean(t_serial)) * 1e3
+            cold_ms = float(np.mean(t_cold)) * 1e3
+            warm_ms = float(np.mean(t_warm)) * 1e3
+            return {
+                "ingest_miners": n_miners,
+                "ingest_fetch_latency_ms": round(latency_s * 1e3, 1),
+                "averager_ingest_serial_ms": round(ser_ms, 2),
+                "averager_ingest_ms": round(cold_ms, 2),
+                "averager_ingest_warm_ms": round(warm_ms, 2),
+                "ingest_speedup_cold": round(ser_ms / max(cold_ms, 1e-9),
+                                             3),
+                "ingest_speedup_warm": round(ser_ms / max(warm_ms, 1e-9),
+                                             3),
+                "ingest_warm_downloads": warm_downloads,
+                "ingest_parity": bool(parity),
+            }
+        finally:
+            serial.close()
+            pooled.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _time_metrics_overhead(*, steps: int = 100, trials: int = 2,
                            log_every: int = 5) -> dict:
     """Observability-layer A/B (round-8 satellite): the production
@@ -643,6 +761,14 @@ def main() -> None:
         extras.update(_time_metrics_overhead())
     except Exception as e:
         extras["metrics_overhead_error"] = repr(e)
+
+    try:
+        # concurrent + cached averager ingest vs serial gather over
+        # localfs (round-9 tentpole): cold speedup is the fetch pool,
+        # warm speedup is the revision cache skipping every download
+        extras.update(_time_gather_deltas())
+    except Exception as e:
+        extras["gather_deltas_error"] = repr(e)
 
     try:
         # MFU scale point (round-2 verdict item 7): config 3's model on one

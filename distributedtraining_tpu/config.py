@@ -131,6 +131,12 @@ class RunConfig:
     val_pipeline_depth: int = 1              # cohorts staged ahead of eval
     #                                          (0 disables fetch/eval overlap)
     averaging_interval: float = 1200.0       # averager.py:106
+    # concurrent revision-aware ingest (engine/ingest.py, validator +
+    # averager): fetch-pool width (1 = serial fetch order) and the
+    # content-addressed host cache's byte budget (0 disables — every
+    # round re-downloads every artifact, the reference's behavior)
+    ingest_workers: int = 4
+    ingest_cache_mb: int = 2048
 
     # -- averager strategy --------------------------------------------------
     strategy: str = "parameterized"          # weighted | parameterized | genetic
@@ -458,6 +464,20 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "eval; 0 disables the fetch/eval overlap")
     g.add_argument("--averaging-interval", dest="averaging_interval",
                    type=float, default=d.averaging_interval)
+    if role in ("validator", "averager"):  # the delta-consuming roles
+        g = p.add_argument_group("ingest")
+        g.add_argument("--ingest-workers", dest="ingest_workers", type=int,
+                       default=d.ingest_workers,
+                       help="concurrent artifact fetches during delta "
+                            "ingest (engine/ingest.py); 1 restores serial "
+                            "fetch order")
+        g.add_argument("--ingest-cache-mb", dest="ingest_cache_mb",
+                       type=int, default=d.ingest_cache_mb,
+                       help="byte budget (MB) of the content-addressed "
+                            "host cache keyed (hotkey, delta_revision): "
+                            "unchanged submissions skip download + decode "
+                            "+ dequantize + screen entirely; 0 disables "
+                            "(re-download every round, reference behavior)")
 
     if role == "averager":
         g = p.add_argument_group("strategy")

@@ -161,6 +161,88 @@ def screen_delta(delta: Params, base: Params, *, max_abs: float | None = None,
     return True, "ok"
 
 
+def _cohort_screen_stats(*deltas: Params) -> tuple[jax.Array, jax.Array]:
+    """Per-tree (finite flag, max |value|) for a cohort of structurally
+    identical deltas — the jittable body of the batched admission screen.
+    ONE program computes what the serial path dispatches as two programs
+    PER MINER (``has_nonfinite`` + ``global_max_abs``), so screening cost
+    stays ~flat in cohort size. Returns ([K] bool, [K] f32)."""
+    fins, maxs = [], []
+    for d in deltas:
+        leaves = jax.tree_util.tree_leaves(d)
+        flags = [jnp.any(~jnp.isfinite(l)) for l in leaves
+                 if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+        fins.append(jnp.logical_not(jnp.any(jnp.stack(flags)))
+                    if flags else jnp.asarray(True))
+        maxs.append(jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in leaves]))
+            if leaves else jnp.asarray(0.0, jnp.float32))
+    return jnp.stack(fins), jnp.stack(maxs)
+
+
+_cohort_screen_stats_jit = jax.jit(_cohort_screen_stats)
+
+# device memory per screen dispatch is bounded at SCREEN_CHUNK x params
+# (the chunked_weighted_merge discipline — an averager may gather ~100
+# full deltas and must not stage them all on one chip at once); arity is
+# bucket-padded (repeat, not zero-alloc) so recompiles are bounded too
+SCREEN_CHUNK = 8
+_SCREEN_BUCKETS = (1, 2, 4, 8)
+
+
+def _screen_arity(k: int) -> int:
+    for b in _SCREEN_BUCKETS:
+        if k <= b:
+            return b
+    return SCREEN_CHUNK
+
+
+def screen_deltas(deltas: Sequence[Params], base: Params, *,
+                  max_abs: float | None = None, check_dtype: bool = True,
+                  extra_dtypes: Sequence[str] = ("bfloat16",),
+                  chunk: int = SCREEN_CHUNK) -> list[tuple[bool, str]]:
+    """Batched ``screen_delta``: identical per-delta verdicts (same
+    reasons, same check order — shape, finiteness, magnitude), with the
+    finite/max-abs device work fused into one jitted program per chunk of
+    ``chunk`` deltas instead of two dispatches per miner.
+
+    Shape/dtype parity is checked host-side per delta first (pure
+    metadata); survivors are grouped by leaf-dtype signature (a mixed
+    f32/bf16-wire fleet must not stack into one promoted program) and
+    screened ``chunk`` at a time. Short chunks are arity-padded by
+    REPEATING a member (no zero-tree allocation) up to a small bucket
+    ladder so a wobbling cohort size hits cached compiles.
+    """
+    results: list[tuple[bool, str] | None] = [None] * len(deltas)
+    by_sig: dict[tuple, list[int]] = {}
+    for i, d in enumerate(deltas):
+        if not shapes_match(d, base, check_dtype=check_dtype,
+                            extra_dtypes=extra_dtypes):
+            results[i] = (False, "shape_mismatch")
+            continue
+        sig = tuple(str(np.asarray(l).dtype)
+                    for l in jax.tree_util.tree_leaves(d))
+        by_sig.setdefault(sig, []).append(i)
+    cap = max_abs is not None and max_abs > 0
+    for idxs in by_sig.values():
+        for c in range(0, len(idxs), max(1, chunk)):
+            part = idxs[c:c + max(1, chunk)]
+            arity = _screen_arity(len(part))
+            args = [deltas[i] for i in part]
+            args += [args[0]] * (arity - len(args))
+            finite, mags = jax.device_get(_cohort_screen_stats_jit(*args))
+            for slot, i in enumerate(part):
+                if not bool(finite[slot]):
+                    results[i] = (False, "nonfinite")
+                elif cap and float(mags[slot]) > max_abs:
+                    results[i] = (
+                        False, f"magnitude_exceeded({float(mags[slot]):.3e}"
+                               f">{max_abs:.3e})")
+                else:
+                    results[i] = (True, "ok")
+    return results  # type: ignore[return-value]
+
+
 def global_max_abs(tree: Params) -> float:
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:

@@ -11,6 +11,7 @@ from .scheduler import Clock, RealClock, FakeClock, PeriodicAction
 from .train import TrainEngine, MinerLoop, TrainState, default_optimizer
 from .lora_train import LoRAEngine, LoRAMinerLoop, fetch_delta_any
 from .batched_eval import BatchedCohortEvaluator, stage_cohorts
+from .ingest import DeltaCache, DeltaIngestor, IngestPool, StagedDelta
 from .publish import DeltaPublisher, PublishWorker, SupersedeQueue
 from .validate import Validator
 from .average import (
@@ -26,6 +27,7 @@ __all__ = [
     "TrainEngine", "MinerLoop", "TrainState", "default_optimizer",
     "LoRAEngine", "LoRAMinerLoop", "fetch_delta_any",
     "BatchedCohortEvaluator", "stage_cohorts",
+    "DeltaCache", "DeltaIngestor", "IngestPool", "StagedDelta",
     "DeltaPublisher", "PublishWorker", "SupersedeQueue",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",

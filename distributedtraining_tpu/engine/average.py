@@ -493,7 +493,9 @@ class AveragerLoop:
                  lora_cfg=None,
                  accept_quant: bool = True,
                  stale_deltas: str = "skip",
-                 publish_policy: str = "improved"):
+                 publish_policy: str = "improved",
+                 ingest_workers: int = 4,
+                 ingest_cache_mb: int = 2048):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -530,10 +532,19 @@ class AveragerLoop:
             raise ValueError(f"publish_policy must be 'improved' or "
                              f"'always', got {publish_policy!r}")
         self.publish_policy = publish_policy
-        # accept adapter-tree submissions alongside full-param deltas;
-        # template cached once (depends only on base shapes)
+        # accept adapter-tree submissions alongside full-param deltas
+        # (the ingestor builds + caches the adapter wire template)
         self.lora_cfg = lora_cfg
-        self._lora_template = None
+        # concurrent revision-aware ingest (engine/ingest.py): fetch pool
+        # width and host-cache byte budget (0 disables the cache; 1
+        # worker restores serial fetch order)
+        self.ingest_workers = ingest_workers
+        self.ingest_cache_mb = ingest_cache_mb
+        self._ingestor = None
+        # hotkey -> delta_revision probed by THIS round's ingest — the
+        # declined-merge fingerprint reuses these instead of issuing a
+        # second delta_revision read per miner per round
+        self._round_revisions: dict[str, str | None] = {}
         self.report = AveragerReport()
         self.base_params: Params | None = None
         self._base_revision = None
@@ -594,29 +605,6 @@ class AveragerLoop:
         self.base_params = self.engine.place_params(self.base_params)
         self._base_loss = None   # new base: guard re-evaluates lazily
 
-    def _fetch_delta(self, hotkey: str):
-        from .lora_train import (adapter_template, fetch_delta_any,
-                                 fetch_delta_any_broadcast)
-        from .train import wire_in
-        if self.lora_cfg is not None and self._lora_template is None:
-            # WIRE layout: adapter artifacts travel unrolled (train.py
-            # wire helpers), whatever layout this averager runs
-            self._lora_template = adapter_template(self._host_template(),
-                                                   self.lora_cfg)
-        if self._multi():
-            d = fetch_delta_any_broadcast(
-                self.transport, hotkey, self._host_template(), self.lora_cfg,
-                lora_template=self._lora_template,
-                quant_template=self._quant_template,
-                accept_quant=self.accept_quant)
-        else:
-            d = fetch_delta_any(self.transport, hotkey,
-                                self._host_template(), self.lora_cfg,
-                                lora_template=self._lora_template,
-                                quant_template=self._quant_template,
-                                accept_quant=self.accept_quant)
-        return wire_in(self.engine, d)
-
     def _quant_template(self):
         """Lazy+cached int8 wire template supplier (see Validator's)."""
         if self._quant_template_cache is None:
@@ -624,53 +612,68 @@ class AveragerLoop:
                 self._host_template())
         return self._quant_template_cache
 
-    def _is_stale(self, hotkey: str) -> bool:
-        """Rider check BEFORE the (full-model-bytes) artifact fetch — the
-        rider is a tiny JSON read. Policy-gated OUTSIDE the collective is
-        safe: stale_deltas is constructor config, identical on every
-        process (unlike _base_revision — see stale_submission)."""
-        if self.stale_deltas != "skip":
-            return False
-        from .train import stale_submission
-        return stale_submission(self.transport, hotkey,
-                                self._base_revision, multi=self._multi())
+    def _ingest(self):
+        """Lazy shared ingest front-end (engine/ingest.py): concurrent
+        fetch pool + content-addressed host cache + fused cohort screen.
+        Screening runs in WIRE layout against the wire template — the
+        same leaves screen_delta checked post-wire_in, so verdicts are
+        identical whatever this averager's scan setting."""
+        if self._ingestor is None:
+            from .ingest import DeltaIngestor
+            self._ingestor = DeltaIngestor(
+                self.transport, self._host_template,
+                lora_cfg=self.lora_cfg,
+                quant_template=self._quant_template,
+                accept_quant=self.accept_quant,
+                max_delta_abs=self.max_delta_abs,
+                stale_deltas=self.stale_deltas,
+                workers=self.ingest_workers,
+                cache_bytes=self.ingest_cache_mb * (1 << 20),
+                span_prefix="avg")
+        return self._ingestor
+
+    def close(self) -> None:
+        """Drop the ingest pool's worker threads (idempotent)."""
+        if self._ingestor is not None:
+            self._ingestor.close()
 
     def gather_deltas(self) -> tuple[list[str], list[Params]]:
+        from .train import wire_in
         if self._multi():
             from .train import broadcast_metagraph
             meta = broadcast_metagraph(self.chain)
         else:
             meta = self.chain.sync()
         self._round_cids.clear()
+        self._round_revisions.clear()
+        hotkeys = [h for h in meta.hotkeys
+                   if h != getattr(self.chain, "my_hotkey", None)]
+        staged = self._ingest().stage(hotkeys,
+                                      base_revision=self._base_revision,
+                                      multi=self._multi())
         ids, deltas = [], []
         rejected = 0
-        for hotkey in meta.hotkeys:
-            if hotkey == getattr(self.chain, "my_hotkey", None):
+        for s in staged:
+            self._round_revisions[s.hotkey] = s.revision
+            if s.cid is not None:
+                self._round_cids[s.hotkey] = s.cid
+            if s.delta is None:
+                if s.reason == "stale_base":
+                    logger.info("averager: skipping %s (delta vs a "
+                                "superseded base)", s.hotkey)
+                    rejected += 1
+                elif s.reason != "no_delta":
+                    # shape/NaN/magnitude screens (averaging_logic.py:
+                    # 121-127,404-410) and isolated per-miner fetch errors
+                    logger.warning("averager: rejecting %s (%s)",
+                                   s.hotkey, s.reason)
+                    rejected += 1
                 continue
-            if self._is_stale(hotkey):
-                logger.info("averager: skipping %s (delta vs a superseded "
-                            "base)", hotkey)
-                rejected += 1
-                continue
-            # correlation id from the rider (single-host only: a pod's
-            # per-process rider read would touch the transport off the
-            # coordinator) — joins this merge to the miner's push spans
-            cid = None if self._multi() else obs.fetch_cid(self.transport,
-                                                           hotkey)
-            with obs.span("avg.fetch", cid=cid, miner=hotkey):
-                d = self._fetch_delta(hotkey)
-            if d is None:
-                continue
-            ok, reason = delta_lib.screen_delta(d, self.base_params,
-                                                max_abs=self.max_delta_abs)
-            if not ok:  # shape/NaN screens (averaging_logic.py:121-127,404-410)
-                logger.warning("averager: rejecting %s (%s)", hotkey, reason)
-                rejected += 1
-                continue
-            ids.append(hotkey)
-            deltas.append(d)
-            if cid is not None:
-                self._round_cids[hotkey] = cid
+            ids.append(s.hotkey)
+            deltas.append(wire_in(self.engine, s.delta))
+        # only the cids of ACCEPTED deltas annotate the merge records
+        self._round_cids = {h: c for h, c in self._round_cids.items()
+                            if h in set(ids)}
         self.report.last_accepted = len(ids)
         self.report.last_rejected = rejected
         return ids, deltas
@@ -679,12 +682,20 @@ class AveragerLoop:
         """(hotkey, delta_revision) set — identifies an exact submission
         set so a declined merge is not recomputed until something
         changes. Single-host only (per-process revision reads would
-        diverge on a pod; pods just re-merge)."""
-        try:
-            return frozenset(
-                (h, self.transport.delta_revision(h)) for h in ids)
-        except Exception:
-            return None
+        diverge on a pod; pods just re-merge). Revisions come from THIS
+        round's ingest probes — no second transport read per miner; the
+        rare fallback read is guarded against transport I/O errors only
+        (a coding bug must surface, not read as 'no fingerprint')."""
+        out = []
+        for h in ids:
+            rev = self._round_revisions.get(h)
+            if rev is None:
+                try:
+                    rev = self.transport.delta_revision(h)
+                except OSError:
+                    return None
+            out.append((h, rev))
+        return frozenset(out)
 
     def run_round(self) -> bool:
         """One averaging cycle; returns True when deltas were gathered and

@@ -336,15 +336,21 @@ class BatchedCohortEvaluator:
 # ---------------------------------------------------------------------------
 
 def stage_cohorts(items: Sequence, cohort_size: int, stage_one: Callable,
-                  *, pipeline: bool = True, depth: int = 1) -> Iterator[list]:
+                  *, pipeline: bool = True, depth: int = 1,
+                  stage_many: Callable | None = None) -> Iterator[list]:
     """Group ``items`` into cohorts of ``cohort_size`` and map
     ``stage_one`` over each — on a bounded background thread ``depth``
     cohorts ahead when ``pipeline``, so staging cohort n+1 (transport
     fetch + wire_in + screen) overlaps the caller's device eval of
     cohort n.
 
+    ``stage_many`` (optional) stages a WHOLE cohort in one call instead
+    of item-by-item — how the validator routes a cohort through the
+    concurrent ingest pool (engine/ingest.py: fetches in flight at once,
+    one fused screen program) rather than serial per-miner staging.
+
     ``pipeline=False`` stages inline in caller order — REQUIRED on
-    multi-host pods, where stage_one contains broadcast collectives that
+    multi-host pods, where staging contains broadcast collectives that
     must interleave deterministically with the eval program's. The
     returned iterator exposes ``close()`` when pipelined (stop the
     worker early on a failed round).
@@ -359,7 +365,10 @@ def stage_cohorts(items: Sequence, cohort_size: int, stage_one: Callable,
         # screening (the consumer's wait half is val.stage_wait_ms in
         # engine/validate.py) — busy/(busy+wait) is pipeline overlap
         t0 = time.perf_counter()
-        out = [stage_one(x) for x in group]
+        if stage_many is not None:
+            out = stage_many(group)
+        else:
+            out = [stage_one(x) for x in group]
         obs.count("val.stage_busy_ms", (time.perf_counter() - t0) * 1e3)
         return out
 

@@ -283,6 +283,13 @@ def fetch_delta_any(transport, hotkey: str, base,
     Returns None when nothing matches — the caller scores 0
     (validation_logic.py:152-166 semantics).
 
+    This is the one-shot spelling. The validator and averager round
+    paths ingest through engine/ingest.py's DeltaIngestor instead, which
+    adds the per-round machinery a whole-fleet gather wants — concurrent
+    fetches, a (hotkey, delta_revision) host cache that skips unchanged
+    artifacts, fused cohort screening — and calls densify_delta_bytes /
+    this function underneath for the actual wire-form decode.
+
     When the transport exposes ``fetch_delta_bytes`` the artifact is pulled
     from the network ONCE and every validation runs on the same bytes —
     the HF transport deletes its download after each fetch, so repeated

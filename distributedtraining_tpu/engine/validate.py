@@ -55,7 +55,9 @@ class Validator:
                  accept_quant: bool = True,
                  stale_deltas: str = "accept",
                  cohort_size: int = 8,
-                 pipeline_depth: int = 1):
+                 pipeline_depth: int = 1,
+                 ingest_workers: int = 4,
+                 ingest_cache_mb: int = 2048):
         self.engine = engine
         self.transport = transport
         self.chain = chain
@@ -94,6 +96,12 @@ class Validator:
         self.cohort_size = cohort_size
         self.pipeline_depth = pipeline_depth
         self._cohort_eval = None
+        # concurrent revision-aware ingest (engine/ingest.py): fetch pool
+        # width and host-cache byte budget (0 disables the cache; 1
+        # worker restores serial fetch order within a cohort)
+        self.ingest_workers = ingest_workers
+        self.ingest_cache_mb = ingest_cache_mb
+        self._ingestor = None
         # accept adapter-tree submissions alongside full-param deltas
         # (engine/lora_train.py fetch_delta_any)
         self.lora_cfg = lora_cfg
@@ -224,28 +232,6 @@ class Validator:
                                                    self.lora_cfg)
         return self._lora_template
 
-    def _fetch_delta(self, hotkey: str):
-        """Dense delta for ``hotkey`` (any wire form), or None. On a
-        multi-host pod only the coordinator touches the transport; the
-        result is broadcast so every process scores the IDENTICAL delta —
-        a mid-publish read skew would otherwise turn one SPMD eval into
-        divergent programs emitting silently wrong scores."""
-        from .lora_train import fetch_delta_any, fetch_delta_any_broadcast
-        from .train import wire_in
-        if not self._multi():
-            d = fetch_delta_any(self.transport, hotkey,
-                                self._host_template(), self.lora_cfg,
-                                lora_template=self._adapter_template(),
-                                quant_template=self._quant_template,
-                                accept_quant=self.accept_quant)
-        else:
-            d = fetch_delta_any_broadcast(
-                self.transport, hotkey, self._host_template(), self.lora_cfg,
-                lora_template=self._adapter_template(),
-                quant_template=self._quant_template,
-                accept_quant=self.accept_quant)
-        return wire_in(self.engine, d)
-
     _quant_template_cache = None
 
     def _quant_template(self):
@@ -258,39 +244,60 @@ class Validator:
                 self._host_template())
         return self._quant_template_cache
 
-    def _is_stale(self, hotkey: str) -> bool:
-        """Rider check before the full artifact fetch (tiny JSON read);
-        shared verdict logic + pod broadcast discipline in
-        train.stale_submission. Riderless submissions are never stale."""
-        from .train import stale_submission
-        return stale_submission(self.transport, hotkey,
-                                self._base_revision, multi=self._multi())
+    def _ingest(self):
+        """Lazy shared ingest front-end (engine/ingest.py): concurrent
+        fetch pool + content-addressed host cache + fused cohort screen,
+        the same subsystem the averager gathers through. Screening runs
+        in WIRE layout against the wire template — the same leaves the
+        old per-miner screen checked post-wire_in."""
+        if self._ingestor is None:
+            from .ingest import DeltaIngestor
+            self._ingestor = DeltaIngestor(
+                self.transport, self._host_template,
+                lora_cfg=self.lora_cfg,
+                lora_template=self._adapter_template,
+                quant_template=self._quant_template,
+                accept_quant=self.accept_quant,
+                max_delta_abs=self.max_delta_abs,
+                stale_deltas=self.stale_deltas,
+                workers=self.ingest_workers,
+                cache_bytes=self.ingest_cache_mb * (1 << 20),
+                span_prefix="val")
+        return self._ingestor
+
+    def close(self) -> None:
+        """Drop the ingest pool's worker threads (idempotent)."""
+        if self._ingestor is not None:
+            self._ingestor.close()
+
+    def _stage_many(self, hotkeys):
+        """Fetch + screen a cohort of submissions through the shared
+        ingest subsystem — concurrent fetches, per-miner revision cache,
+        one fused screen program for the cohort. Returns
+        ``[(hotkey, delta|None, reason), ...]`` in input order.
+
+        Correlation: the artifact's ``delta_id`` (read from the meta
+        rider during staging) tags the fetch/screen spans and the eval
+        span later, joining this round's records to the miner's push
+        spans in scripts/obs_report.py. On a pod the coordinator stages
+        and broadcasts (engine/ingest.py's lockstep rule)."""
+        from .train import wire_in
+        staged = self._ingest().stage(list(hotkeys),
+                                      base_revision=self._base_revision,
+                                      multi=self._multi())
+        out = []
+        for s in staged:
+            if s.cid is not None:
+                self._round_cids[s.hotkey] = s.cid
+            d = wire_in(self.engine, s.delta) if s.delta is not None else None
+            out.append((s.hotkey, d, s.reason))
+        return out
 
     def _stage_miner(self, hotkey: str):
-        """Fetch + screen one submission — the host-side staging shared by
-        the sequential and batched paths (and what the cohort pipeline
-        overlaps with device eval). Returns (hotkey, delta|None, reason).
-
-        Correlation: the artifact's ``delta_id`` (stamped into the meta
-        rider by the miner's publisher) tags the fetch/screen spans here
-        and the eval span later, joining this round's records to the
-        miner's push spans in scripts/obs_report.py. Single-host only —
-        on a pod the rider read would be a per-process transport touch."""
-        cid = None if self._multi() else obs.fetch_cid(self.transport, hotkey)
-        if cid is not None:
-            self._round_cids[hotkey] = cid
-        if self.stale_deltas == "skip" and self._is_stale(hotkey):
-            return hotkey, None, "stale_base"
-        with obs.span("val.fetch", cid=cid, miner=hotkey):
-            d = self._fetch_delta(hotkey)
-        if d is None:
-            return hotkey, None, "no_delta"
-        with obs.span("val.screen", cid=cid, miner=hotkey):
-            ok, reason = delta_lib.screen_delta(d, self.base_params,
-                                                max_abs=self.max_delta_abs)
-        if not ok:
-            return hotkey, None, reason
-        return hotkey, d, "ok"
+        """Single-miner spelling of ``_stage_many`` (the sequential
+        score_miner path and ad-hoc callers)."""
+        (res,) = self._stage_many([hotkey])
+        return res
 
     def _score_from(self, hotkey: str, loss: float, ppl: float) -> MinerScore:
         if self.metric == "perplexity":
@@ -319,7 +326,8 @@ class Validator:
         results: list[MinerScore] = []
         staged = stage_cohorts(hotkeys, self.cohort_size, self._stage_miner,
                                pipeline=pipeline,
-                               depth=max(self.pipeline_depth, 1))
+                               depth=max(self.pipeline_depth, 1),
+                               stage_many=self._stage_many)
         try:
             it = iter(staged)
             while True:

@@ -78,6 +78,14 @@ class Transport(Protocol):
         ...
 
     def delta_revision(self, miner_id: str) -> Revision:
+        """Current revision of the miner's delta artifact, or None when
+        absent. CONTRACT: this must be cheap relative to the artifact
+        fetch (a commit-SHA read, a stat-cached content hash) — the
+        ingest cache (engine/ingest.py) probes it once per miner per
+        round and skips the download entirely when it is unchanged, so a
+        probe that costs like a download erases the point. It must also
+        be stable: equal revisions MUST imply identical artifact bytes
+        (the cache serves the decoded tree keyed on it)."""
         ...
 
     # -- delta metadata rider (optional; absent = reference behavior) ------

@@ -134,6 +134,11 @@ class HFHubTransport:
             return None
 
     def _revision(self, repo_id: str) -> Revision:
+        """Commit-SHA probe (one small API call, no LFS pull) — cheap
+        enough for the ingest cache to issue once per miner per round
+        (engine/ingest.py); the counter makes the fleet's probe volume
+        visible next to its download volume."""
+        obs.count("transport.revision_probes")
         try:
             refs = self.api.list_repo_refs(repo_id)
             return refs.branches[0].target_commit if refs.branches else None

@@ -67,8 +67,31 @@ class LocalFSTransport:
     def __init__(self, root: str, *, max_bytes: int = ser.DEFAULT_MAX_BYTES):
         self.root = root
         self.max_bytes = max_bytes
+        # revision-probe cache: path -> ((mtime_ns, size, ino), sha256).
+        # The ingest pool probes every miner's revision every round
+        # (engine/ingest.py); without this each probe re-hashes the full
+        # artifact — O(model bytes) of pure I/O per miner per round for
+        # files that almost never changed. The stat signature includes
+        # the inode because _write_atomic's rename always lands a fresh
+        # one, so an overwrite inside mtime granularity still misses.
+        self._rev_cache: dict[str, tuple[tuple, str]] = {}
         os.makedirs(os.path.join(root, "deltas"), exist_ok=True)
         os.makedirs(os.path.join(root, "base"), exist_ok=True)
+
+    def _revision_of(self, path: str) -> Revision:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        sig = (st.st_mtime_ns, st.st_size, st.st_ino)
+        hit = self._rev_cache.get(path)
+        if hit is not None and hit[0] == sig:
+            return hit[1]
+        obs.count("transport.revision_hash")
+        h = _hash_file(path)
+        if h is not None:
+            self._rev_cache[path] = (sig, h)
+        return h
 
     @staticmethod
     def _safe_id(miner_id: str) -> str:
@@ -91,14 +114,14 @@ class LocalFSTransport:
         with obs.span("transport.publish_delta", miner=miner_id):
             path = self._delta_path(miner_id)
             ser.save_file(delta, path)
-            return _hash_file(path)
+            return self._revision_of(path)
 
     def publish_raw(self, miner_id: str, data: bytes) -> Revision:
         """Arbitrary (possibly signature-enveloped, possibly hostile) bytes
         as a 'delta' — signed publishes and loadgen both land here."""
         path = self._delta_path(miner_id)
         _write_atomic(path, data)
-        return _hash_file(path)
+        return self._revision_of(path)
 
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
@@ -121,7 +144,7 @@ class LocalFSTransport:
         return _read_capped(self._delta_path(miner_id), self.max_bytes)
 
     def delta_revision(self, miner_id: str) -> Revision:
-        return _hash_file(self._delta_path(miner_id))
+        return self._revision_of(self._delta_path(miner_id))
 
     def _meta_path(self, miner_id: str) -> str:
         return os.path.join(self.root, "deltas",
@@ -138,12 +161,12 @@ class LocalFSTransport:
     def publish_base(self, base: Params) -> Revision:
         with obs.span("transport.publish_base"):
             ser.save_file(base, self._base_path)
-            return _hash_file(self._base_path)
+            return self._revision_of(self._base_path)
 
     def publish_base_raw(self, data: bytes) -> Revision:
         """Pre-serialized (possibly signature-enveloped) base bytes."""
         _write_atomic(self._base_path, data)
-        return _hash_file(self._base_path)
+        return self._revision_of(self._base_path)
 
     def fetch_base_bytes(self) -> bytes | None:
         return _read_capped(self._base_path, self.max_bytes)
@@ -159,10 +182,10 @@ class LocalFSTransport:
             except ser.PayloadError:
                 # a torn/corrupt base reads as "absent", never a crash
                 return None
-            return tree, _hash_file(self._base_path)
+            return tree, self._revision_of(self._base_path)
 
     def base_revision(self) -> Revision:
-        return _hash_file(self._base_path)
+        return self._revision_of(self._base_path)
 
     def gc(self) -> None:
         pass  # overwrite-in-place layout never accumulates history

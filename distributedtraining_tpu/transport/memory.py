@@ -21,18 +21,25 @@ class InMemoryTransport:
         self._deltas: dict[str, bytes] = {}
         self._delta_meta: dict[str, bytes] = {}
         self._base: bytes | None = None
+        # revision cache, computed at publish: ingest probes every miner's
+        # revision every round (engine/ingest.py), and re-hashing a
+        # full-model payload per probe is O(model bytes) of pure CPU for
+        # bytes that did not change
+        self._delta_revs: dict[str, str] = {}
+        self._base_rev: str | None = None
 
     # -- miner side ---------------------------------------------------------
     def publish_delta(self, miner_id: str, delta: Params) -> Revision:
-        self._deltas[miner_id] = ser.to_msgpack(delta)
-        return self.delta_revision(miner_id)
+        return self.publish_raw(miner_id, ser.to_msgpack(delta))
 
     def publish_raw(self, miner_id: str, data: bytes) -> Revision:
         """Arbitrary bytes as a 'delta' — hostile-miner simulation for the
         admission screens (utils/loadgen.py); a real adversary is not
         obliged to run our serializer."""
         self._deltas[miner_id] = bytes(data)
-        return self.delta_revision(miner_id)
+        self._delta_revs[miner_id] = hashlib.sha256(
+            self._deltas[miner_id]).hexdigest()
+        return self._delta_revs[miner_id]
 
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:
@@ -53,8 +60,13 @@ class InMemoryTransport:
         return self._deltas.get(miner_id)
 
     def delta_revision(self, miner_id: str) -> Revision:
-        data = self._deltas.get(miner_id)
-        return None if data is None else hashlib.sha256(data).hexdigest()
+        if miner_id not in self._deltas:
+            return None
+        rev = self._delta_revs.get(miner_id)
+        if rev is None:  # bytes injected behind the API (test doubles)
+            rev = self._delta_revs[miner_id] = hashlib.sha256(
+                self._deltas[miner_id]).hexdigest()
+        return rev
 
     def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
         self._delta_meta[miner_id] = encode_delta_meta(meta)
@@ -64,13 +76,13 @@ class InMemoryTransport:
 
     # -- base model ---------------------------------------------------------
     def publish_base(self, base: Params) -> Revision:
-        self._base = ser.to_msgpack(base)
-        return self.base_revision()
+        return self.publish_base_raw(ser.to_msgpack(base))
 
     def publish_base_raw(self, data: bytes) -> Revision:
         """Pre-serialized (possibly signature-enveloped) base bytes."""
         self._base = bytes(data)
-        return self.base_revision()
+        self._base_rev = hashlib.sha256(self._base).hexdigest()
+        return self._base_rev
 
     def fetch_base_bytes(self) -> bytes | None:
         return self._base
@@ -86,7 +98,11 @@ class InMemoryTransport:
         return tree, self.base_revision()
 
     def base_revision(self) -> Revision:
-        return None if self._base is None else hashlib.sha256(self._base).hexdigest()
+        if self._base is None:
+            return None
+        if self._base_rev is None:  # bytes injected behind the API
+            self._base_rev = hashlib.sha256(self._base).hexdigest()
+        return self._base_rev
 
     def gc(self) -> None:
         pass  # nothing accumulates: publishes overwrite

@@ -324,6 +324,35 @@ def correlate(cid: str | None):
         tl.cid = prev
 
 
+def capture_context() -> tuple:
+    """Snapshot THIS thread's span context (open-span stack + current
+    correlation id) for hand-off to a worker thread. Span state is
+    thread-local by design (the publish worker re-enters its id via
+    ``correlate``); a worker POOL that fans one caller's work across
+    threads instead captures the submitting thread's context here and
+    installs it per job via ``use_context`` — concurrent ``avg.fetch``
+    spans then keep their parent nesting and inherited cid exactly as if
+    they had run inline (engine/ingest.py's pool does this)."""
+    tl = _tl()
+    return (tuple(tl.stack), tl.cid)
+
+
+@contextlib.contextmanager
+def use_context(ctx: tuple | None):
+    """Install a ``capture_context()`` snapshot on the CURRENT thread for
+    the duration. The worker gets a private COPY of the captured stack:
+    its spans nest under the submitter's open span without mutating the
+    submitter's own (still live) stack across threads."""
+    tl = _tl()
+    prev_stack, prev_cid = tl.stack, tl.cid
+    tl.stack = list(ctx[0]) if ctx else []
+    tl.cid = ctx[1] if ctx else None
+    try:
+        yield
+    finally:
+        tl.stack, tl.cid = prev_stack, prev_cid
+
+
 def rider_delta_id(meta: dict | None) -> str | None:
     """Defensive read of ``delta_id`` from a PEER-CONTROLLED meta rider:
     a short string or nothing (a hostile rider must not be able to

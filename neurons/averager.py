@@ -65,7 +65,9 @@ def main(argv=None) -> int:
                         metrics=c.metrics, lora_cfg=c.lora_cfg,
                         accept_quant=cfg.accept_quant,
                         stale_deltas=cfg.stale_deltas or "skip",
-                        publish_policy=cfg.publish_policy)
+                        publish_policy=cfg.publish_policy,
+                        ingest_workers=cfg.ingest_workers,
+                        ingest_cache_mb=cfg.ingest_cache_mb)
     loop.bootstrap(params=c.initial_params)
     try:
         merged = loop.run_periodic(interval=cfg.averaging_interval,
@@ -73,6 +75,7 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         merged = loop.report.rounds > 0
     finally:
+        loop.close()   # drain the ingest pool's worker threads
         # see neurons/miner.py: global obs state must not outlive the role
         from distributedtraining_tpu.utils import obs
         obs.reset()

@@ -41,7 +41,9 @@ def main(argv=None) -> int:
                           accept_quant=cfg.accept_quant,
                           stale_deltas=cfg.stale_deltas or "accept",
                           cohort_size=cfg.val_cohort,
-                          pipeline_depth=cfg.val_pipeline_depth)
+                          pipeline_depth=cfg.val_pipeline_depth,
+                          ingest_workers=cfg.ingest_workers,
+                          ingest_cache_mb=cfg.ingest_cache_mb)
     # the reference gates weight-setting to staked validators
     # (btt_connector.py:358-385); refuse up front instead of silently
     # burning eval compute on scores no one will ever see. On a pod the
@@ -74,6 +76,7 @@ def main(argv=None) -> int:
         logging.info("validator interrupted; exiting")
         return 0
     finally:
+        validator.close()   # drain the ingest pool's worker threads
         # see neurons/miner.py: global obs state must not outlive the role
         from distributedtraining_tpu.utils import obs
         obs.reset()

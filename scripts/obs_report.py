@@ -43,6 +43,7 @@ SPAN_PHASE = {
     "val.fetch": "fetch",
     "avg.fetch": "fetch",
     "val.screen": "screen",
+    "avg.screen": "screen",   # fused cohort screen (engine/ingest.py)
     "val.eval": "eval",
     "val.cohort_eval": "eval",
     "avg.merge": "merge",

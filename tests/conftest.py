@@ -52,6 +52,32 @@ def _no_leaked_nondaemon_threads():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _no_leaked_ingest_pool():
+    """Ingest-pool hygiene (the concurrent delta ingest, engine/ingest.py):
+    its workers are DAEMON threads — invisible to the non-daemon guard
+    above — named ``ingest-*`` and designed to idle out within ~2 s of
+    their last job (or immediately on DeltaIngestor.close()). A worker
+    still alive well past that means a wedged transport call or a pool
+    whose owner never drained it; either way the module leaked live
+    machinery into its successors. Daemon or not, fail the module."""
+    import threading
+    import time as _time
+
+    yield
+    deadline = _time.monotonic() + 6.0   # > IngestPool's 2 s idle timeout
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith("ingest-")]
+        if not leaked:
+            return
+        if _time.monotonic() > deadline:
+            raise AssertionError(
+                f"test module left ingest pool threads alive: {leaked}; "
+                "close() the DeltaIngestor (or its owning loop) in teardown")
+        _time.sleep(0.05)
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _no_leaked_obs_state():
     """Observability hygiene (mirrors the thread-leak guard above): the
     span/metric layer (utils/obs.py) is PROCESS-WIDE state — a test that

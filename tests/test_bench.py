@@ -106,6 +106,27 @@ def test_time_push_overlap_ab():
     assert out["push_stall_ms"] >= 80.0, out
 
 
+def test_time_gather_deltas_ab():
+    """The pooled+cached averager ingest A/B (ISSUE 4 acceptance): on a
+    cold round with >= 4 miners the concurrent pool beats the serial
+    gather (<= 0.5x wall-clock over localfs at the bench's simulated
+    latency), a warm round with unchanged revisions downloads ZERO
+    artifact bytes and beats serial outright, and accepted deltas are
+    byte-identical in both modes. Cheap spelling: shorter latency, same
+    contrasts (they are host/network time, present on every backend)."""
+    out = bench._time_gather_deltas(n_miners=4, latency_s=0.03, trials=2)
+    for key in ("averager_ingest_ms", "averager_ingest_serial_ms",
+                "averager_ingest_warm_ms", "ingest_speedup_cold",
+                "ingest_speedup_warm"):
+        assert key in out and out[key] > 0, out
+    assert out["ingest_parity"] is True, out
+    assert out["ingest_warm_downloads"] == 0, out
+    assert out["averager_ingest_ms"] <= 0.5 * \
+        out["averager_ingest_serial_ms"], out
+    assert out["averager_ingest_warm_ms"] < \
+        out["averager_ingest_serial_ms"], out
+
+
 def test_peak_flops_ladder(monkeypatch):
     monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5e")
     assert bench._peak_flops() == 197e12
