@@ -158,8 +158,23 @@ class RunConfig:
 
     # -- observability ------------------------------------------------------
     metrics_path: Optional[str] = None       # JSONL sink
+    # size-based JSONL rotation: rotate the --metrics-path file once it
+    # passes this many MB, keeping the newest --metrics-keep-segments
+    # rotated segments (0 = never rotate, the historical single-file
+    # behavior; obs_report/fleet_report read rotated runs transparently)
+    metrics_rotate_mb: int = 0
+    metrics_keep_segments: int = 3
     log_every: int = 1000                    # train steps between metric logs
                                              # (ref :394-402)
+    # fleet health plane (engine/health.py): >0 publishes a versioned
+    # heartbeat through the transport every N seconds; the validator and
+    # averager additionally run the FleetMonitor (contribution ledger +
+    # SLO rules) over the fleet's heartbeats. 0 disables the plane.
+    heartbeat_interval: float = 0.0
+    # zero-dependency Prometheus-text exporter (utils/obs_http.py):
+    # serve the obs registry (+ fleet ledger, where one exists) on
+    # http://127.0.0.1:<port>/metrics. 0 disables.
+    obs_port: int = 0
     mlflow_uri: Optional[str] = None
     profile_dir: Optional[str] = None        # jax.profiler trace capture
     profile_steps: int = 5                   # train steps per capture
@@ -533,6 +548,27 @@ def build_parser(role: str) -> argparse.ArgumentParser:
 
     g = p.add_argument_group("observability")
     g.add_argument("--metrics-path", dest="metrics_path", default=None)
+    g.add_argument("--metrics-rotate-mb", dest="metrics_rotate_mb",
+                   type=int, default=d.metrics_rotate_mb,
+                   help="rotate the --metrics-path JSONL once it exceeds "
+                        "this many MB (0 = never; soak runs otherwise grow "
+                        "one multi-GB file). obs_report/fleet_report read "
+                        "rotated segments transparently")
+    g.add_argument("--metrics-keep-segments", dest="metrics_keep_segments",
+                   type=int, default=d.metrics_keep_segments,
+                   help="rotated segments kept per metrics file")
+    g.add_argument("--heartbeat-interval", dest="heartbeat_interval",
+                   type=_nonneg_float, default=d.heartbeat_interval,
+                   help="fleet health plane (engine/health.py): publish a "
+                        "versioned heartbeat through the transport every N "
+                        "seconds; validator/averager also aggregate the "
+                        "fleet's heartbeats into the contribution ledger "
+                        "and evaluate SLO rules. 0 disables")
+    g.add_argument("--obs-port", dest="obs_port", type=int,
+                   default=d.obs_port,
+                   help="serve Prometheus-text metrics (obs registry + "
+                        "fleet ledger) on 127.0.0.1:<port>/metrics; "
+                        "0 disables")
     if role == "miner":
         g.add_argument("--log-every", dest="log_every", type=int,
                        default=d.log_every,

@@ -16,11 +16,14 @@ Everything here is a pure function on pytrees; the heavy ones are jittable.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .utils import obs
 
 Params = Any  # a pytree of arrays
 
@@ -197,6 +200,13 @@ def _screen_arity(k: int) -> int:
     return SCREEN_CHUNK
 
 
+# (arity, leaf shape/dtype signature) combinations already dispatched —
+# a NEW one means jit traces + compiles a fresh screen program, whose
+# cost is recorded in the shared ``compile.ms`` histogram (the
+# compile-time accounting the recompile counters alone don't give)
+_SCREEN_COMPILED: set = set()
+
+
 def screen_deltas(deltas: Sequence[Params], base: Params, *,
                   max_abs: float | None = None, check_dtype: bool = True,
                   extra_dtypes: Sequence[str] = ("bfloat16",),
@@ -230,7 +240,20 @@ def screen_deltas(deltas: Sequence[Params], base: Params, *,
             arity = _screen_arity(len(part))
             args = [deltas[i] for i in part]
             args += [args[0]] * (arity - len(args))
-            finite, mags = jax.device_get(_cohort_screen_stats_jit(*args))
+            ckey = (arity, tuple(
+                (tuple(np.asarray(l).shape), str(np.asarray(l).dtype))
+                for l in jax.tree_util.tree_leaves(args[0])))
+            fresh = ckey not in _SCREEN_COMPILED
+            if fresh:
+                _SCREEN_COMPILED.add(ckey)
+                obs.count("screen.fresh_compiles")
+                t0 = time.perf_counter()
+            stats = _cohort_screen_stats_jit(*args)
+            if fresh:
+                # first-dispatch wall time: trace + compile (+ the async
+                # dispatch); the fused program's execution overlaps
+                obs.observe("compile.ms", (time.perf_counter() - t0) * 1e3)
+            finite, mags = jax.device_get(stats)
             for slot, i in enumerate(part):
                 if not bool(finite[slot]):
                     results[i] = (False, "nonfinite")

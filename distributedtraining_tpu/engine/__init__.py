@@ -11,6 +11,8 @@ from .scheduler import Clock, RealClock, FakeClock, PeriodicAction
 from .train import TrainEngine, MinerLoop, TrainState, default_optimizer
 from .lora_train import LoRAEngine, LoRAMinerLoop, fetch_delta_any
 from .batched_eval import BatchedCohortEvaluator, stage_cohorts
+from .health import (FleetMonitor, HeartbeatPublisher, NodeHealth, SLORule,
+                     Vitals, default_slo_rules, report_vitals)
 from .ingest import DeltaCache, DeltaIngestor, IngestPool, StagedDelta
 from .publish import DeltaPublisher, PublishWorker, SupersedeQueue
 from .validate import Validator
@@ -29,6 +31,8 @@ __all__ = [
     "BatchedCohortEvaluator", "stage_cohorts",
     "DeltaCache", "DeltaIngestor", "IngestPool", "StagedDelta",
     "DeltaPublisher", "PublishWorker", "SupersedeQueue",
+    "FleetMonitor", "HeartbeatPublisher", "NodeHealth", "SLORule",
+    "Vitals", "default_slo_rules", "report_vitals",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
     "OuterOptMerge",

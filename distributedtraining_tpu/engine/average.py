@@ -495,8 +495,14 @@ class AveragerLoop:
                  stale_deltas: str = "skip",
                  publish_policy: str = "improved",
                  ingest_workers: int = 4,
-                 ingest_cache_mb: int = 2048):
+                 ingest_cache_mb: int = 2048,
+                 fleet=None):
         self.engine = engine
+        # fleet health plane (engine/health.py FleetMonitor): polled at
+        # the round cadence, fed the EXACT staging outcomes each gather
+        # acted on (the contribution ledger matches the merge decisions
+        # by construction), SLO-evaluated and ledger-flushed per round
+        self.fleet = fleet
         self.transport = transport
         self.chain = chain
         self.strategy = strategy
@@ -629,13 +635,17 @@ class AveragerLoop:
                 stale_deltas=self.stale_deltas,
                 workers=self.ingest_workers,
                 cache_bytes=self.ingest_cache_mb * (1 << 20),
-                span_prefix="avg")
+                span_prefix="avg",
+                observer=(self.fleet.record_staging
+                          if self.fleet is not None else None))
         return self._ingestor
 
     def close(self) -> None:
         """Drop the ingest pool's worker threads (idempotent)."""
         if self._ingestor is not None:
             self._ingestor.close()
+        if self.fleet is not None:
+            self.fleet.close()
 
     def gather_deltas(self) -> tuple[list[str], list[Params]]:
         from .train import wire_in
@@ -648,6 +658,15 @@ class AveragerLoop:
         self._round_revisions.clear()
         hotkeys = [h for h in meta.hotkeys
                    if h != getattr(self.chain, "my_hotkey", None)]
+        if self.fleet is not None and not self._multi():
+            # one observation round BEFORE staging: the staging observer
+            # then folds outcomes into the freshly-advanced round. Pods
+            # skip (the monitor is coordinator-only; the role entry point
+            # wires fleet=None off-coordinator anyway).
+            try:
+                self.fleet.poll(hotkeys)
+            except Exception:
+                logger.exception("averager: fleet heartbeat poll failed")
         staged = self._ingest().stage(hotkeys,
                                       base_revision=self._base_revision,
                                       multi=self._multi())
@@ -697,6 +716,20 @@ class AveragerLoop:
             out.append((h, rev))
         return frozenset(out)
 
+    def _fleet_round_end(self) -> None:
+        """SLO evaluation + ledger flush at the round cadence — called on
+        EVERY run_round exit (merged, declined, or empty), so staleness
+        advances and breaches fire even when nothing merges (a dead fleet
+        is exactly when the SLOs matter). Isolated: health-plane failures
+        never fail a round."""
+        if self.fleet is None:
+            return
+        try:
+            self.fleet.evaluate_slos()
+            self.fleet.flush(self.metrics, step=self.report.rounds)
+        except Exception:
+            logger.exception("averager: fleet round-end failed")
+
     def run_round(self) -> bool:
         """One averaging cycle; returns True when deltas were gathered and
         merged (whether or not the publish guard let the result replace
@@ -707,6 +740,7 @@ class AveragerLoop:
         ids, deltas = self.gather_deltas()
         if not ids:
             logger.info("averager: no valid deltas this round")
+            self._fleet_round_end()
             return False
         if (self._declined_fp is not None and not self._multi()
                 and self._delta_fingerprint(ids) == self._declined_fp):
@@ -715,6 +749,7 @@ class AveragerLoop:
             # the same eval passes for the same verdict
             logger.info("averager: submissions unchanged since the "
                         "declined merge; skipping recompute")
+            self._fleet_round_end()
             self.report.rounds += 1
             return True
         if getattr(self.engine, "mesh", None) is not None:
@@ -780,6 +815,7 @@ class AveragerLoop:
                          "merge_delta_ids": dict(self._round_cids)},
                         step=self.report.rounds)
                     obs.flush(self.metrics, step=self.report.rounds)
+                self._fleet_round_end()
                 self.report.rounds += 1
                 self._declined_fp = self._delta_fingerprint(ids)
                 self.transport.gc()   # storage bounding must not stall
@@ -809,6 +845,7 @@ class AveragerLoop:
             # registry flush at the round cadence (fetch/merge/publish
             # span histograms, retry counters)
             obs.flush(self.metrics, step=self.report.rounds)
+        self._fleet_round_end()
         self.report.rounds += 1
         return True
 

@@ -70,6 +70,18 @@ Params = Any
 BUCKETS = (1, 2, 4, 8, 16)
 
 
+def _timed_compile(fn, *args):
+    """Call ``fn`` (a jitted program on fresh shapes) and record its
+    first-dispatch wall time into the ``compile.ms`` registry histogram —
+    the compile-COST half of the recompile counters (which only count
+    occurrences). jit compiles synchronously at dispatch, so this wall
+    time is trace+compile plus one async dispatch."""
+    t0 = time.perf_counter()
+    out = fn(*args)
+    obs.observe("compile.ms", (time.perf_counter() - t0) * 1e3)
+    return out
+
+
 class BatchedCohortEvaluator:
     """Owns the per-bucket jitted cohort-eval programs for one engine."""
 
@@ -259,6 +271,7 @@ class BatchedCohortEvaluator:
                 return jax.tree_util.tree_map(leaf, *real)
 
             assemble = self._stack_cache[key] = jax.jit(assemble)
+            return _timed_compile(assemble, *deltas), k_real
         return assemble(*deltas), k_real
 
     def _place_batch(self, batch: dict) -> dict:
@@ -287,7 +300,8 @@ class BatchedCohortEvaluator:
         discipline as TrainEngine.evaluate)."""
         k_stack = delta_lib.miner_axis_size(stacked)
         k_pad = self.bucket_for(max(k_stack, k_real))
-        if k_pad not in self._buckets_seen:
+        fresh_bucket = k_pad not in self._buckets_seen
+        if fresh_bucket:
             self._buckets_seen.add(k_pad)
             obs.count("val.cohort_bucket_compiles")
         if k_stack != k_pad:
@@ -295,11 +309,23 @@ class BatchedCohortEvaluator:
             if pad is None:  # one program, not one concat dispatch per leaf
                 pad = self._stack_cache[("pad", k_pad)] = jax.jit(
                     lambda s: delta_lib.pad_stack(s, k_pad))
-            stacked = pad(stacked)
+                stacked = _timed_compile(pad, stacked)
+            else:
+                stacked = pad(stacked)
         prog = self._program()
         total = count = None
         for batch in batches:
-            l, t = prog(base, stacked, self._place_batch(batch))
+            placed = self._place_batch(batch)
+            if fresh_bucket:
+                # the counter above says a compile HAPPENED; this says
+                # what it COST — first-dispatch wall time (trace+compile;
+                # the jitted call returns before execution finishes, so
+                # device time stays out). compile.ms across all sites is
+                # what makes a compile storm visible in the fleet report.
+                l, t = _timed_compile(prog, base, stacked, placed)
+                fresh_bucket = False
+            else:
+                l, t = prog(base, stacked, placed)
             total = l if total is None else total + l
             count = t if count is None else count + t
         if count is None:

@@ -1,0 +1,766 @@
+"""Fleet health plane: transport-published heartbeats, a per-miner
+contribution ledger, and a declarative SLO engine.
+
+The swarm is otherwise observable only through on-chain scores: a miner
+that stalls, publishes garbage, or silently falls rounds behind is
+invisible until its score decays, and the averager has no view of the
+fleet it merges. PR 3's spans/registry (utils/obs.py) are *intra*-process;
+this module is the cross-role layer:
+
+- every role periodically publishes a compact, versioned **heartbeat**
+  (:class:`HeartbeatPublisher`) through the Transport it already uses for
+  deltas — the heartbeat rides the delta-META channel under a reserved
+  artifact id (transport/base.heartbeat_id), so all three backends and
+  both wrappers (SignedTransport rider pass-through, the pod coordinator
+  gate) carry it with zero new transport code. Publication reuses the
+  PR 2 :class:`~.publish.PublishWorker` machinery: the collection is
+  cheap and host-side, the upload runs on a background daemon thread,
+  and a beat still in flight when the next interval fires is SUPERSEDED,
+  never queued (only the newest snapshot matters — the same
+  replace-don't-accumulate rule as delta artifacts).
+- the delta-consuming roles run a :class:`FleetMonitor`: heartbeats are
+  fetched concurrently (the engine/ingest.py pool), folded into a
+  per-node :class:`NodeHealth` record, and joined with the role's own
+  staging/merge/score decisions into a **contribution ledger** — deltas
+  published / accepted / declined, score history, staleness in rounds,
+  last-seen. The averager feeds it the exact ``StagedDelta`` outcomes of
+  each gather, so the ledger matches the merge decisions it made, not a
+  reconstruction.
+- declarative **SLO rules** (:class:`SLORule`, vocabulary in
+  :func:`default_slo_rules`) are evaluated against the ledger each
+  round: a node stale for N observation rounds, a loss EMA diverging
+  from the fleet median, a push-failure streak, a step-rate collapse.
+  The FIRST breach arms the role's existing
+  :class:`~..utils.obs.AnomalyMonitor` one-shot (trigger_external), and
+  every breach is counted (``fleet.slo.<rule>``) and logged through the
+  metrics sink as an ``{"slo_breach": ...}`` record.
+
+Exposure: ``scripts/fleet_report.py`` joins the heartbeat/ledger JSONL
+records (plus the tagged registry flushes) into a fleet table, and
+``utils/obs_http.py`` serves the registry and the live ledger as
+Prometheus text on ``--obs-port``.
+
+Defensive rule: heartbeat contents are PEER-CONTROLLED. The producer
+side lints field names with the registry lint (``[a-z0-9_.]+``) and caps
+the encoded size; the consumer side re-validates every field and drops
+anything that does not conform — a hostile heartbeat can at worst make
+its own node look unhealthy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from ..transport.base import META_MAX_BYTES, heartbeat_id
+from ..utils import obs
+from ..utils.metrics import device_memory_watermarks
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_VERSION = 1
+
+# the versioned schema: field -> (kind, description). ``kind`` is "str"
+# or "num"; consumers drop non-conforming values, docs/observability.md
+# renders this table. Producers may add extra NUMERIC fields (linted
+# names) — consumers keep them, reports show what they know.
+HEARTBEAT_FIELDS: dict[str, tuple[str, str]] = {
+    "hb": ("num", f"schema version (currently {HEARTBEAT_VERSION})"),
+    "role": ("str", "publishing role: miner | validator | averager"),
+    "hotkey": ("str", "publishing hotkey"),
+    "t": ("num", "publisher wall-clock at collection (unix seconds)"),
+    "seq": ("num", "monotonic per-process beat sequence"),
+    "base_revision": ("str", "base-model revision the node is tracking"),
+    "steps": ("num", "lifetime train steps (miner) / rounds (others)"),
+    "step_rate": ("num", "steps per second over the last beat interval"),
+    "loss_ema": ("num", "EMA of the node's own loss signal"),
+    "pushes": ("num", "deltas published (MinerReport.pushes)"),
+    "pushes_failed": ("num", "publishes whose retries exhausted"),
+    "rounds": ("num", "validation/averaging rounds completed"),
+    "last_accepted": ("num", "deltas accepted into the last merge"),
+    "last_rejected": ("num", "deltas rejected at the last gather"),
+    "registry_digest": ("str", "obs registry vocabulary digest "
+                               "(version-drift detection)"),
+    "mem_in_use_bytes": ("num", "max per-device HBM bytes in use"),
+    "mem_peak_bytes": ("num", "max per-device HBM high-water mark"),
+}
+
+_MAX_STR = 200
+_MAX_EXTRA_FIELDS = 32
+
+
+def build_heartbeat(role: str, hotkey: str, seq: int, *, now: float,
+                    **fields) -> dict:
+    """Assemble one heartbeat body. Producer-side lint: every field name
+    must pass the registry name lint (the same ``[a-z0-9_.]+`` rule as
+    metric names — heartbeats feed reports and exporters, so a field
+    that cannot be a metric name must fail HERE, at the producer, not
+    parse-time at every consumer)."""
+    hb: dict[str, Any] = {"hb": HEARTBEAT_VERSION, "role": role,
+                          "hotkey": hotkey, "t": float(now),
+                          "seq": int(seq)}
+    for k, v in fields.items():
+        obs.check_metric_name(k)
+        if v is None:
+            continue
+        hb[k] = v if isinstance(v, str) else float(v)
+    return hb
+
+
+def parse_heartbeat(meta) -> dict | None:
+    """Defensive read of a PEER-CONTROLLED heartbeat rider (the dict the
+    transport's ``fetch_delta_meta`` returned, already size-capped by
+    parse_delta_meta). Returns a normalized dict or None; non-conforming
+    fields are dropped, never raised on."""
+    if not isinstance(meta, dict):
+        return None
+    v = meta.get("hb")
+    if not isinstance(v, (int, float)) or int(v) < 1:
+        return None  # not a heartbeat (e.g. a plain delta rider)
+    role, hotkey = meta.get("role"), meta.get("hotkey")
+    if not (isinstance(role, str) and 0 < len(role) <= _MAX_STR):
+        return None
+    if not (isinstance(hotkey, str) and 0 < len(hotkey) <= _MAX_STR):
+        return None
+    out: dict[str, Any] = {"hb": int(v), "role": role, "hotkey": hotkey}
+    extras = 0
+    for k, val in meta.items():
+        if k in out:
+            continue
+        try:
+            obs.check_metric_name(k)
+        except ValueError:
+            continue
+        kind = HEARTBEAT_FIELDS.get(k, (None,))[0]
+        if isinstance(val, str) and kind != "num":
+            if len(val) <= _MAX_STR:
+                out[k] = val
+        elif isinstance(val, (int, float)) and kind != "str":
+            out[k] = float(val)
+        else:
+            continue
+        if k not in HEARTBEAT_FIELDS:
+            extras += 1
+            if extras > _MAX_EXTRA_FIELDS:
+                out.pop(k, None)
+    if not isinstance(out.get("seq"), float):
+        return None
+    out["seq"] = int(out["seq"])
+    if not isinstance(out.get("t"), float):
+        out["t"] = 0.0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Vitals: what a role reports about itself
+# ---------------------------------------------------------------------------
+
+class Vitals:
+    """Derives a heartbeat body from zero-arg suppliers: ``steps`` (a
+    lifetime step/round counter — the step RATE is computed here from
+    consecutive samples), ``loss`` (latest loss; the EMA lives here so a
+    noisy sample cannot whipsaw fleet-median comparisons), ``counters``
+    (a numeric dict, e.g. from a MinerReport), ``base_revision``. The
+    registry digest and device memory watermarks ride along
+    automatically."""
+
+    def __init__(self, *, steps: Callable[[], float] | None = None,
+                 loss: Callable[[], float] | None = None,
+                 counters: Callable[[], dict] | None = None,
+                 base_revision: Callable[[], str | None] | None = None,
+                 ema_alpha: float = 0.2,
+                 clock=None):
+        from .scheduler import RealClock
+        self._steps = steps
+        self._loss = loss
+        self._counters = counters
+        self._base_revision = base_revision
+        self._ema_alpha = ema_alpha
+        self._clock = clock or RealClock()
+        self._last_steps: float | None = None
+        self._last_t: float | None = None
+        self._loss_ema: float | None = None
+
+    def collect(self) -> dict:
+        now = self._clock.now()
+        body: dict[str, Any] = {}
+        if self._steps is not None:
+            steps = float(self._steps())
+            body["steps"] = steps
+            if self._last_t is not None and now > self._last_t:
+                body["step_rate"] = max(
+                    0.0, (steps - self._last_steps) / (now - self._last_t))
+            self._last_steps, self._last_t = steps, now
+        if self._loss is not None:
+            loss = self._loss()
+            if loss is not None and math.isfinite(float(loss)):
+                loss = float(loss)
+                self._loss_ema = loss if self._loss_ema is None else (
+                    self._loss_ema
+                    + self._ema_alpha * (loss - self._loss_ema))
+            if self._loss_ema is not None:
+                body["loss_ema"] = self._loss_ema
+        if self._counters is not None:
+            for k, v in self._counters().items():
+                if v is not None and math.isfinite(float(v)):
+                    body[k] = float(v)
+        if self._base_revision is not None:
+            rev = self._base_revision()
+            if isinstance(rev, str) and rev:
+                body["base_revision"] = rev[:_MAX_STR]
+        body["registry_digest"] = obs.registry_digest()
+        body.update(device_memory_watermarks())
+        return body
+
+
+def report_vitals(report, *, base_revision=None, clock=None) -> Vitals:
+    """Vitals over a role report dataclass (MinerReport, AveragerReport):
+    every known numeric field becomes a heartbeat counter; ``steps``/
+    ``rounds`` drives the rate; ``last_loss`` drives the EMA."""
+    fields = [f for f in ("steps", "pushes", "pushes_failed",
+                          "pushes_superseded", "base_pulls", "val_reverts",
+                          "rounds", "last_accepted", "last_rejected",
+                          "skipped_publishes")
+              if hasattr(report, f)]
+    step_field = "steps" if hasattr(report, "steps") else (
+        "rounds" if hasattr(report, "rounds") else None)
+    return Vitals(
+        steps=(lambda: getattr(report, step_field))
+        if step_field else None,
+        loss=(lambda: getattr(report, "last_loss"))
+        if hasattr(report, "last_loss") else None,
+        counters=lambda: {f: getattr(report, f) for f in fields},
+        base_revision=base_revision, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# The publisher
+# ---------------------------------------------------------------------------
+
+class HeartbeatPublisher:
+    """Periodic background heartbeat publication for one (role, hotkey).
+
+    A daemon TIMER thread (named ``heartbeat-<role>-<hotkey>``; the
+    conftest hygiene guard fails any test that leaks one) wakes every
+    ``interval`` seconds, collects the vitals on ITS thread (cheap,
+    host-side — the training loop never stalls for a beat), and hands
+    the upload to a depth-1 :class:`~.publish.PublishWorker`: transport
+    latency lives on the worker, and a beat still uploading when the
+    next fires is superseded. Publish failures are counted and logged,
+    never raised — a flaky transport degrades the health plane, not the
+    role."""
+
+    def __init__(self, transport, role: str, hotkey: str, *,
+                 interval: float = 60.0, vitals: Vitals | None = None,
+                 collect: Callable[[], dict] | None = None,
+                 clock=None):
+        from .publish import PublishWorker
+        from .scheduler import RealClock
+        if interval <= 0:
+            raise ValueError(f"interval must be > 0, got {interval}")
+        self.transport = transport
+        self.role = role
+        self.hotkey = hotkey
+        self.interval = interval
+        self.node_id = heartbeat_id(role, hotkey)
+        # public + late-bindable: role entry points construct the plane
+        # before the loop whose report the vitals read, then bind here
+        self.vitals = vitals
+        self._collect = collect
+        self._clock = clock or RealClock()
+        self._worker = PublishWorker(
+            name=f"heartbeat-upload-{hotkey}", depth=1,
+            counter_prefix="health")
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.seq = 0
+        self.sent = 0
+        self.failed = 0
+        self._warned_no_channel = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "HeartbeatPublisher":
+        """Start the timer thread (idempotent). The first beat fires
+        immediately so a fresh node is visible within one poll, not one
+        interval."""
+        with self._lock:
+            if self._thread is not None or self._stop.is_set():
+                return self
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"heartbeat-{self.role}-{self.hotkey}")
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            self._submit_beat()
+            if self._stop.wait(self.interval):
+                return
+
+    def _submit_beat(self) -> None:
+        try:
+            body = self._body()
+        except Exception:
+            logger.exception("heartbeat %s: vitals collection failed",
+                             self.node_id)
+            return
+        self._worker.submit(lambda: self._publish(body))
+
+    def _body(self) -> dict:
+        self.seq += 1
+        fields: dict[str, Any] = {}
+        if self.vitals is not None:
+            fields.update(self.vitals.collect())
+        if self._collect is not None:
+            fields.update(self._collect())
+        return build_heartbeat(self.role, self.hotkey, self.seq,
+                               now=self._clock.now(), **fields)
+
+    def _publish(self, body: dict) -> None:
+        pm = getattr(self.transport, "publish_delta_meta", None)
+        if pm is None:
+            if not self._warned_no_channel:
+                self._warned_no_channel = True
+                logger.warning(
+                    "heartbeat %s: transport has no rider channel; "
+                    "health plane is publish-disabled", self.node_id)
+            return
+        import json as _json
+        if len(_json.dumps(body)) > META_MAX_BYTES:
+            # never ship a rider the size cap would make unreadable
+            logger.warning("heartbeat %s: body exceeds %d bytes, dropped",
+                           self.node_id, META_MAX_BYTES)
+            return
+        try:
+            with obs.span("health.beat", hotkey=self.hotkey):
+                pm(self.node_id, body)
+            self.sent += 1
+            obs.count("health.beats")
+        except Exception:
+            self.failed += 1
+            obs.count("health.beat_failures")
+            logger.warning("heartbeat %s: publish failed", self.node_id,
+                           exc_info=True)
+
+    def beat_now(self, *, wait: bool = True,
+                 timeout: float | None = 5.0) -> None:
+        """Collect and publish one beat immediately (loop flush / final
+        state before shutdown). ``wait`` drains the upload."""
+        self._submit_beat()
+        if wait:
+            self._worker.flush(timeout=timeout)
+
+    def flush(self, timeout: float | None = 5.0) -> bool:
+        return self._worker.flush(timeout=timeout)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the timer, drain in-flight uploads. Idempotent."""
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+        self._worker.close(timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# The ledger
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NodeHealth:
+    """One node's folded heartbeat state + contribution ledger entry."""
+    role: str
+    hotkey: str
+    # -- heartbeat-derived ---------------------------------------------------
+    beats: int = 0                      # distinct sequences observed
+    seq: int = -1
+    t: float = 0.0                      # publisher's own clock at last beat
+    last_seen_wall: float | None = None  # monitor clock at last fresh beat
+    last_seen_round: int | None = None
+    steps: float = 0.0
+    step_rate: float = 0.0
+    peak_step_rate: float = 0.0
+    loss_ema: float = float("nan")
+    pushes: float = 0.0
+    pushes_failed: float = 0.0
+    push_fail_streak: float = 0.0       # derived across beats
+    base_revision: str | None = None
+    registry_digest: str | None = None
+    mem_peak_bytes: float = 0.0
+    extra: dict = dataclasses.field(default_factory=dict)
+    # -- contribution ledger (this role's own staging/merge decisions) ------
+    published: int = 0                  # distinct delta revisions staged
+    accepted: int = 0                   # deltas that entered a merge/score
+    declined: int = 0                   # withheld (stale/screen/fetch error)
+    last_reason: str = ""
+    last_delta_revision: str | None = None
+    last_accepted_round: int | None = None
+    stale_rounds: int = 0               # rounds since the revision changed
+    score: float = float("nan")
+    score_history: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=32))
+    breaches: list = dataclasses.field(default_factory=list)
+
+    def as_record(self, now: float | None = None) -> dict:
+        rec = {
+            "role": self.role, "hotkey": self.hotkey, "beats": self.beats,
+            "seq": self.seq, "steps": self.steps,
+            "step_rate": round(self.step_rate, 4),
+            "loss_ema": self.loss_ema, "pushes": self.pushes,
+            "pushes_failed": self.pushes_failed,
+            "base_revision": self.base_revision,
+            "registry_digest": self.registry_digest,
+            "published": self.published, "accepted": self.accepted,
+            "declined": self.declined, "last_reason": self.last_reason,
+            "stale_rounds": self.stale_rounds, "score": self.score,
+            "breaches": list(self.breaches),
+        }
+        if self.mem_peak_bytes:
+            rec["mem_peak_bytes"] = self.mem_peak_bytes
+        if now is not None and self.last_seen_wall is not None:
+            rec["last_seen_age_s"] = round(now - self.last_seen_wall, 3)
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# SLO rules
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SLORule:
+    """One declarative service-level objective.
+
+    Kinds (the vocabulary; docs/observability.md):
+
+    - ``stale``: no fresh heartbeat for more than ``threshold``
+      observation rounds (a killed or wedged node).
+    - ``loss_divergence``: the node's ``loss_ema`` exceeds
+      ``factor`` x the fleet median AND sits more than ``threshold``
+      above it (needs >= 3 reporting nodes — a two-node fleet has no
+      meaningful median).
+    - ``push_failures``: ``threshold`` consecutive failed pushes with no
+      success in between, derived from heartbeat counter deltas (the
+      fleet-level twin of AnomalyMonitor's local streak rule).
+    - ``step_rate_collapse``: the node's step rate fell below
+      ``factor`` x its own observed peak (after ``warmup`` beats —
+      a cold start is not a collapse).
+    """
+    name: str
+    kind: str
+    threshold: float
+    factor: float = 1.0
+    warmup: int = 3
+
+    _KINDS = ("stale", "loss_divergence", "push_failures",
+              "step_rate_collapse")
+
+    def __post_init__(self):
+        obs.check_metric_name(self.name)
+        if self.kind not in self._KINDS:
+            raise ValueError(f"unknown SLO kind {self.kind!r}; "
+                             f"expected one of {self._KINDS}")
+
+    def evaluate(self, node: NodeHealth, *, round_num: int,
+                 fleet_median_loss: float | None) -> str | None:
+        """Breach detail string, or None when within objective."""
+        if node.beats < 1:
+            return None  # never-seen nodes are absent, not breaching
+        if self.kind == "stale":
+            last = node.last_seen_round
+            if last is not None and round_num - last > self.threshold:
+                return (f"no heartbeat for {round_num - last} rounds "
+                        f"(> {self.threshold:g})")
+            return None
+        if self.kind == "loss_divergence":
+            if (fleet_median_loss is None
+                    or not math.isfinite(node.loss_ema)):
+                return None
+            if (node.loss_ema > fleet_median_loss * self.factor
+                    and node.loss_ema - fleet_median_loss > self.threshold):
+                return (f"loss_ema {node.loss_ema:.4g} vs fleet median "
+                        f"{fleet_median_loss:.4g}")
+            return None
+        if self.kind == "push_failures":
+            if node.push_fail_streak >= self.threshold:
+                return f"{node.push_fail_streak:g} consecutive failed pushes"
+            return None
+        # step_rate_collapse
+        if (node.beats >= self.warmup and node.peak_step_rate > 0
+                and node.step_rate < self.factor * node.peak_step_rate):
+            return (f"step_rate {node.step_rate:.4g} < {self.factor:g} x "
+                    f"peak {node.peak_step_rate:.4g}")
+        return None
+
+
+def default_slo_rules() -> tuple[SLORule, ...]:
+    """The default objectives (docs/observability.md documents each)."""
+    return (
+        SLORule("stale_node", "stale", threshold=3),
+        SLORule("loss_divergence", "loss_divergence", threshold=0.5,
+                factor=1.5),
+        SLORule("push_failure_streak", "push_failures", threshold=3),
+        SLORule("step_rate_collapse", "step_rate_collapse", threshold=0.0,
+                factor=0.25, warmup=3),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The monitor
+# ---------------------------------------------------------------------------
+
+class FleetMonitor:
+    """Aggregates heartbeats + this role's own merge/score decisions into
+    the contribution ledger, and evaluates the SLO rules each round.
+
+    ``poll(hotkeys)`` is ONE observation round: heartbeat riders for
+    every (role, hotkey) pair are fetched concurrently (the ingest
+    pool's daemon threads — transport latency overlaps across nodes) and
+    folded in; each fresh heartbeat is also logged through ``metrics``
+    as an ``{"heartbeat": ...}`` record, which is what
+    scripts/fleet_report.py joins offline. Staleness is measured in
+    observation ROUNDS, so the verdicts are cadence-relative rather than
+    wall-clock-relative (a slow averaging cadence must not mark the
+    whole fleet stale).
+
+    Breaches fire ONCE per (node, rule) per monitor lifetime; the first
+    breach of any kind arms ``anomaly`` (AnomalyMonitor.trigger_external
+    — the same one-shot TraceCapture budget as the local detectors).
+
+    Pod discipline: the monitor issues plain transport READS and no
+    collectives; multi-host roles run it on the coordinator only (the
+    role entry points gate on multihost.is_coordinator()).
+    """
+
+    def __init__(self, transport, *, roles: Sequence[str] = ("miner",),
+                 rules: Sequence[SLORule] | None = None,
+                 anomaly=None, metrics=None, clock=None, workers: int = 4):
+        from .ingest import IngestPool
+        from .scheduler import RealClock
+        self.transport = transport
+        self.roles = tuple(roles)
+        self.rules = tuple(rules if rules is not None
+                           else default_slo_rules())
+        self.anomaly = anomaly
+        self.metrics = metrics
+        self.clock = clock or RealClock()
+        self.pool = IngestPool(workers)
+        self.nodes: dict[tuple[str, str], NodeHealth] = {}
+        self.round = 0
+        self._fired: set[tuple[str, str, str]] = set()
+        # the ledger is read/written across threads: the validator's
+        # staging observer runs on the cohort stager thread while the
+        # HTTP exporter renders ledger() from its handler threads
+        self._lock = threading.RLock()
+
+    def close(self) -> None:
+        self.pool.close()
+
+    # -- heartbeat ingestion -------------------------------------------------
+    def node(self, role: str, hotkey: str) -> NodeHealth:
+        key = (role, hotkey)
+        n = self.nodes.get(key)
+        if n is None:
+            n = self.nodes[key] = NodeHealth(role=role, hotkey=hotkey)
+        return n
+
+    def _fetch(self, key: tuple[str, str]) -> dict | None:
+        fm = getattr(self.transport, "fetch_delta_meta", None)
+        if fm is None:
+            return None
+        try:
+            return parse_heartbeat(fm(heartbeat_id(*key)))
+        except Exception:
+            obs.count("fleet.fetch_errors")
+            logger.warning("fleet: heartbeat fetch failed for %s", key,
+                           exc_info=True)
+            return None
+
+    def poll(self, hotkeys: Iterable[str], *,
+             roles: Sequence[str] | None = None) -> int:
+        """One observation round over ``hotkeys`` x ``roles``; returns how
+        many FRESH heartbeats (new sequence numbers) were folded in."""
+        self.round += 1
+        keys = [(role, h) for role in (roles or self.roles)
+                for h in dict.fromkeys(hotkeys)]
+        with obs.span("fleet.poll", nodes=len(keys)):
+            beats = self.pool.map(self._fetch, keys)
+        fresh = 0
+        with self._lock:
+            for key, hb in zip(keys, beats):
+                if hb is None:
+                    continue
+                if self._ingest(key, hb):
+                    fresh += 1
+        obs.count("fleet.polls")
+        obs.gauge("fleet.nodes", float(sum(1 for n in self.nodes.values()
+                                           if n.beats > 0)))
+        return fresh
+
+    def _ingest(self, key: tuple[str, str], hb: dict) -> bool:
+        node = self.node(*key)
+        if hb["seq"] == node.seq:
+            return False  # same beat as last round: the node went quiet
+        prev_pushes, prev_failed = node.pushes, node.pushes_failed
+        had_beats = node.beats > 0
+        node.beats += 1
+        node.seq = hb["seq"]
+        node.t = hb.get("t", 0.0)
+        node.last_seen_wall = self.clock.now()
+        node.last_seen_round = self.round
+        node.steps = hb.get("steps", node.steps)
+        node.step_rate = hb.get("step_rate", 0.0)
+        node.peak_step_rate = max(node.peak_step_rate, node.step_rate)
+        node.loss_ema = hb.get("loss_ema", float("nan"))
+        node.pushes = hb.get("pushes", node.pushes)
+        node.pushes_failed = hb.get("pushes_failed", node.pushes_failed)
+        node.base_revision = hb.get("base_revision", node.base_revision)
+        node.registry_digest = hb.get("registry_digest",
+                                      node.registry_digest)
+        node.mem_peak_bytes = hb.get("mem_peak_bytes", node.mem_peak_bytes)
+        node.extra = {k: v for k, v in hb.items()
+                      if k not in HEARTBEAT_FIELDS}
+        # failure-streak derivation (counter deltas, like
+        # AnomalyMonitor.observe_push_counters): successes reset it
+        if had_beats:
+            if node.pushes > prev_pushes:
+                node.push_fail_streak = 0
+            if node.pushes_failed > prev_failed:
+                node.push_fail_streak += node.pushes_failed - prev_failed
+        obs.count("fleet.heartbeats")
+        if self.metrics is not None:
+            try:
+                self.metrics.log({"heartbeat": dict(hb),
+                                  "observed_round": self.round})
+            except Exception:
+                logger.exception("fleet: heartbeat sink emit failed")
+        return True
+
+    # -- contribution ledger -------------------------------------------------
+    def record_staging(self, staged: Iterable) -> None:
+        """Fold one gather's ``StagedDelta`` outcomes (engine/ingest.py)
+        into the ledger — called by the role that made the decisions, so
+        accepted/declined counts ARE the merge decisions, not an
+        inference. Hotkeys with no submission and no history stay out of
+        the ledger (validator hotkeys never publish deltas)."""
+        with self._lock:
+            self._record_staging_locked(staged)
+
+    def _record_staging_locked(self, staged: Iterable) -> None:
+        for s in staged:
+            key = ("miner", s.hotkey)
+            if s.revision is None and key not in self.nodes \
+                    and s.reason == "no_delta":
+                continue
+            node = self.node(*key)
+            if s.revision is not None \
+                    and s.revision != node.last_delta_revision:
+                node.published += 1
+                node.last_delta_revision = s.revision
+                node.stale_rounds = 0
+            else:
+                node.stale_rounds += 1
+            node.last_reason = s.reason
+            if s.delta is not None:
+                node.accepted += 1
+                node.last_accepted_round = self.round
+            elif s.reason != "no_delta":
+                node.declined += 1
+
+    def record_scores(self, scores: dict[str, float]) -> None:
+        """Fold a validation round's per-hotkey scores (score history).
+        Only ACTIVE nodes get ledger rows: a validator scores every
+        metagraph hotkey (zero for the absent ones), and folding all ~100
+        of those in would bloat the ledger — and the exporter's label
+        space — with never-seen identities."""
+        with self._lock:
+            for hotkey, score in scores.items():
+                if ("miner", hotkey) not in self.nodes and not score:
+                    continue
+                node = self.node("miner", hotkey)
+                node.score = float(score)
+                node.score_history.append(float(score))
+
+    # -- SLO evaluation ------------------------------------------------------
+    def fleet_median_loss(self) -> float | None:
+        losses = [n.loss_ema for n in self.nodes.values()
+                  if n.beats > 0 and math.isfinite(n.loss_ema)]
+        if len(losses) < 3:
+            return None
+        return float(statistics.median(losses))
+
+    def evaluate_slos(self) -> list[dict]:
+        """Evaluate every rule against every node; returns this call's NEW
+        breaches. Each (node, rule) pair fires once per monitor lifetime;
+        the first breach overall arms the AnomalyMonitor one-shot."""
+        with self._lock:
+            median = self.fleet_median_loss()
+            node_list = list(self.nodes.values())
+        breaches = []
+        for node in node_list:
+            for rule in self.rules:
+                fired_key = (node.role, node.hotkey, rule.name)
+                if fired_key in self._fired:
+                    continue
+                detail = rule.evaluate(node, round_num=self.round,
+                                       fleet_median_loss=median)
+                if detail is None:
+                    continue
+                self._fired.add(fired_key)
+                node.breaches.append(rule.name)
+                rec = {"slo_breach": rule.name, "role": node.role,
+                       "hotkey": node.hotkey, "detail": detail,
+                       "round": self.round}
+                breaches.append(rec)
+                obs.count(f"fleet.slo.{rule.name}")
+                logger.warning("SLO breach: %s on %s/%s — %s", rule.name,
+                               node.role, node.hotkey, detail)
+                if self.metrics is not None:
+                    try:
+                        self.metrics.log(rec)
+                    except Exception:
+                        logger.exception("fleet: breach sink emit failed")
+                if self.anomaly is not None:
+                    self.anomaly.trigger_external(
+                        f"slo_{rule.name}", hotkey=node.hotkey,
+                        detail=detail)
+        obs.gauge("fleet.slo_breaches", float(len(self._fired)))
+        return breaches
+
+    # -- exposure ------------------------------------------------------------
+    def ledger(self) -> dict:
+        """JSON-able snapshot: ``{"<role>/<hotkey>": {...}}`` — ONE
+        structured record however many nodes, the same bounded-
+        cardinality rule as the validator's round_scores."""
+        now = self.clock.now()
+        with self._lock:
+            return {f"{n.role}/{n.hotkey}": n.as_record(now)
+                    for n in sorted(self.nodes.values(),
+                                    key=lambda n: (n.role, n.hotkey))}
+
+    def flush(self, sink=None, *, step: int | None = None) -> dict:
+        """Log the ledger snapshot through ``sink`` (default: the role's
+        metrics) and refresh the fleet gauges — the round-cadence twin of
+        obs.flush."""
+        led = self.ledger()
+        with self._lock:
+            stale = sum(1 for n in self.nodes.values()
+                        if n.beats > 0 and n.last_seen_round is not None
+                        and self.round - n.last_seen_round > 1)
+        obs.gauge("fleet.stale_nodes", float(stale))
+        sink = sink if sink is not None else self.metrics
+        if sink is not None and led:
+            try:
+                sink.log({"fleet_ledger": led, "fleet_round": self.round},
+                         step=step)
+            except Exception:
+                logger.exception("fleet: ledger sink emit failed")
+        return led

@@ -309,8 +309,15 @@ class DeltaIngestor:
                  workers: int = 4,
                  cache_bytes: int = DEFAULT_CACHE_BYTES,
                  span_prefix: str = "ingest",
-                 retry_policy: RetryPolicy | None = None):
+                 retry_policy: RetryPolicy | None = None,
+                 observer: Callable[[list], None] | None = None):
         self.transport = transport
+        # staging observer: called with the full StagedDelta list after
+        # every stage() — how the fleet health plane's contribution
+        # ledger (engine/health.py FleetMonitor.record_staging) sees the
+        # EXACT per-miner outcomes this role acted on. Isolated: an
+        # observer failure never affects the round.
+        self.observer = observer
         self._template_in = template
         self._template_cache = None
         self.lora_cfg = lora_cfg
@@ -369,6 +376,11 @@ class DeltaIngestor:
             staged = self.pool.map(
                 lambda h: self._stage_one(h, base_revision), hotkeys)
         self._screen_fresh(staged, cache=not multi)
+        if self.observer is not None:
+            try:
+                self.observer(staged)
+            except Exception:
+                logger.exception("ingest: staging observer failed")
         return staged
 
     # -- single-host path ----------------------------------------------------

@@ -130,10 +130,17 @@ class PublishWorker:
     path; see the leaked-thread guard in tests/conftest.py)."""
 
     def __init__(self, name: str = "publisher", *, depth: int = 1,
-                 on_error: Optional[Callable[[BaseException], None]] = None):
+                 on_error: Optional[Callable[[BaseException], None]] = None,
+                 counter_prefix: str = "publish"):
         self._q = SupersedeQueue(depth)
         self._on_error = on_error
         self._name = name
+        # registry namespace of the worker occupancy counters: the delta
+        # lane reads as publish.worker_*, while other users of this
+        # machinery (the heartbeat publisher, engine/health.py) report
+        # under their own prefix instead of polluting the push pipeline's
+        # occupancy numbers
+        self._counter_prefix = counter_prefix
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
         self.jobs_run = 0
@@ -163,7 +170,7 @@ class PublishWorker:
             # superseding each other.
             t0 = time.perf_counter()
             job = self._q.take()
-            obs.count("publish.worker_idle_ms",
+            obs.count(f"{self._counter_prefix}.worker_idle_ms",
                       (time.perf_counter() - t0) * 1e3)
             if job is _CLOSED:
                 return
@@ -182,7 +189,7 @@ class PublishWorker:
                     except Exception:
                         pass
             finally:
-                obs.count("publish.worker_busy_ms",
+                obs.count(f"{self._counter_prefix}.worker_busy_ms",
                           (time.perf_counter() - t1) * 1e3)
                 self._q.task_done()
 

@@ -707,8 +707,14 @@ class MinerLoop:
                  push_async: bool = False,
                  push_queue_depth: int = 1,
                  trace=None,
-                 anomaly=None):
+                 anomaly=None,
+                 heartbeat=None):
         self.engine = engine
+        # optional fleet heartbeat publisher (engine/health.py): started
+        # when the loop starts (its vitals read this loop's live report),
+        # final beat + close on flush(). Self-timing on its own daemon
+        # thread — the step loop never polls it.
+        self.heartbeat = heartbeat
         self.transport = transport
         self.miner_id = miner_id
         self.clock = clock or RealClock()
@@ -1247,6 +1253,8 @@ class MinerLoop:
             ) -> MinerReport:
         if self.state is None:
             self.bootstrap()
+        if self.heartbeat is not None:
+            self.heartbeat.start()   # idempotent across run() calls
         start_steps = self.report.steps  # max_steps bounds *this* call
         import time as _time
         try:
@@ -1284,6 +1292,12 @@ class MinerLoop:
                         self.anomaly.observe_loss(self.report.last_loss)
                         self.anomaly.observe_push_counters(
                             self.report.pushes, self.report.pushes_failed)
+                    # device memory watermarks as registry gauges at the
+                    # log cadence — the exporter and the heartbeat read
+                    # them from the registry, not from this one record
+                    from ..utils.metrics import device_memory_watermarks
+                    for k, v in device_memory_watermarks().items():
+                        obs.gauge(f"device.{k}", v)
                     self.metrics.log(
                         {"train_loss": self.report.last_loss,
                          "staleness_s": self.clock.now() - self._last_base_time,
@@ -1336,6 +1350,10 @@ class MinerLoop:
             self.trace.close()
         if self.anomaly is not None:
             self.anomaly.close()
+        if self.heartbeat is not None:
+            # final beat with the exit-state counters, then stop the timer
+            self.heartbeat.beat_now(wait=True)
+            self.heartbeat.close()
         # final registry flush: the drained publisher's worker counters and
         # the last partial log window must reach the sink before exit
         if self.metrics is not None:

@@ -57,8 +57,14 @@ class Validator:
                  cohort_size: int = 8,
                  pipeline_depth: int = 1,
                  ingest_workers: int = 4,
-                 ingest_cache_mb: int = 2048):
+                 ingest_cache_mb: int = 2048,
+                 fleet=None):
         self.engine = engine
+        # fleet health plane (engine/health.py FleetMonitor): heartbeats
+        # polled per round, staging outcomes folded via the ingest
+        # observer, per-miner scores recorded as the ledger's score
+        # history, SLOs evaluated + ledger flushed at the round cadence
+        self.fleet = fleet
         self.transport = transport
         self.chain = chain
         self.eval_batches = eval_batches
@@ -262,13 +268,17 @@ class Validator:
                 stale_deltas=self.stale_deltas,
                 workers=self.ingest_workers,
                 cache_bytes=self.ingest_cache_mb * (1 << 20),
-                span_prefix="val")
+                span_prefix="val",
+                observer=(self.fleet.record_staging
+                          if self.fleet is not None else None))
         return self._ingestor
 
     def close(self) -> None:
         """Drop the ingest pool's worker threads (idempotent)."""
         if self._ingestor is not None:
             self._ingestor.close()
+        if self.fleet is not None:
+            self.fleet.close()
 
     def _stage_many(self, hotkeys):
         """Fetch + screen a cohort of submissions through the shared
@@ -377,11 +387,31 @@ class Validator:
         meta = self._synced_metagraph()
         self._maybe_refresh_base()
         others = [h for h in meta.hotkeys if h != self.chain.my_hotkey]
+        if self.fleet is not None and not self._multi():
+            # heartbeat observation round BEFORE staging, so the staging
+            # observer folds this round's outcomes into the advanced
+            # round counter (pods run fleet=None off-coordinator)
+            try:
+                self.fleet.poll(others)
+            except Exception:
+                logger.exception("validator: fleet heartbeat poll failed")
         if self.cohort_size > 1:
             results = self._score_cohorts(others)
         else:
             results = [self.score_miner(h) for h in others]
         scored = {s.hotkey: s.score for s in results}
+        if self.fleet is not None:
+            try:
+                self.fleet.record_scores(scored)
+                self.fleet.evaluate_slos()
+                self.fleet.flush(self.metrics, step=self._round)
+            except Exception:
+                logger.exception("validator: fleet round-end failed")
+        # device memory watermarks as registry gauges at the round
+        # cadence: the numbers the heartbeat and the exporter surface
+        from ..utils.metrics import device_memory_watermarks
+        for k, v in device_memory_watermarks().items():
+            obs.gauge(f"device.{k}", v)
         if self.metrics:
             # BOUNDED metric-name cardinality: the reference logged
             # loss_<hotkey>/score_<hotkey> per miner — unbounded label

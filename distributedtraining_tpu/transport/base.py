@@ -27,6 +27,31 @@ Revision = Optional[str]
 
 META_MAX_BYTES = 4096
 
+# ---------------------------------------------------------------------------
+# Heartbeat naming contract (the fleet health plane, engine/health.py)
+# ---------------------------------------------------------------------------
+# Heartbeats are compact JSON documents that ride the DELTA-META channel
+# under a RESERVED artifact id, so every transport (and every wrapper:
+# SignedTransport passes riders through, CoordinatorGatedTransport gates
+# the write to the pod coordinator) carries them with zero new backend
+# code — they travel exactly like delta riders do today. The reserved
+# prefix keeps them out of the metagraph's hotkey namespace: chain
+# hotkeys never start with it, and delta consumers never stage it.
+
+HEARTBEAT_PREFIX = "__hb__"
+
+
+def heartbeat_id(role: str, node_id: str) -> str:
+    """The reserved per-node artifact id heartbeats publish under.
+    ``role`` disambiguates a hotkey running several roles on one fleet
+    (a validator and an averager may share storage)."""
+    return f"{HEARTBEAT_PREFIX}.{role}.{node_id}"
+
+
+def is_heartbeat_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(HEARTBEAT_PREFIX + ".")
+
 
 def encode_delta_meta(meta: dict) -> bytes:
     """Serialize a metadata rider (tiny JSON; size-capped on read)."""
@@ -89,6 +114,10 @@ class Transport(Protocol):
         ...
 
     # -- delta metadata rider (optional; absent = reference behavior) ------
+    # The same channel carries fleet heartbeats under the reserved
+    # ``heartbeat_id(role, hotkey)`` ids (module-level contract above):
+    # implementations must treat those ids like any other per-miner id
+    # (opaque strings), which all built-ins already do.
     def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
         """Small JSON rider next to the delta artifact. The one key the
         protocol reads is ``base_revision`` — the base the delta was

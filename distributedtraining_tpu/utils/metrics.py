@@ -35,6 +35,22 @@ class InMemorySink:
         self.params.update(params)
 
 
+def jsonl_segments(path: str) -> list[str]:
+    """Existing rotation segments of ``path``, OLDEST first, current file
+    last — the read-side contract of :class:`JSONLSink` rotation. Readers
+    (scripts/obs_report.py, scripts/fleet_report.py) concatenate these so
+    a rotated soak run reads exactly like an unrotated one."""
+    out = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        out.append(f"{path}.{n}")
+        n += 1
+    out.reverse()  # .N is the oldest, .1 the most recently rotated
+    if os.path.exists(path) or not out:
+        out.append(path)
+    return out
+
+
 class JSONLSink:
     """One JSON object per line; the default production sink.
 
@@ -45,13 +61,30 @@ class JSONLSink:
     handle kept open with line buffering (the old reopen-per-record
     spelling paid an open/close syscall pair per record and could
     interleave partial lines across threads). A reader that joins the
-    file mid-crash sees whole records or nothing."""
+    file mid-crash sees whole records or nothing.
 
-    def __init__(self, path: str):
+    ``max_bytes`` bounds the CURRENT file: once a write carries it past
+    the limit, the file rotates (``path`` -> ``path.1`` -> ``path.2`` ...)
+    and only the newest ``keep_segments`` rotated segments survive — a
+    multi-day soak at second-scale cadences otherwise grows one multi-GB
+    file (scripts/soak.py). Readers use :func:`jsonl_segments` to walk the
+    rotation transparently. 0/None disables (the historical behavior)."""
+
+    def __init__(self, path: str, *, max_bytes: int | None = None,
+                 keep_segments: int = 3):
         self.path = path
+        if max_bytes is not None and max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        if keep_segments < 1:
+            raise ValueError(
+                f"keep_segments must be >= 1, got {keep_segments}")
+        self.max_bytes = max_bytes or 0
+        self.keep_segments = keep_segments
+        self.rotations = 0
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._lock = threading.Lock()
         self._fh = None  # opened lazily: no file until the first record
+        self._written = None  # bytes in the current segment (lazy stat)
 
     def log(self, metrics: dict[str, Any], *, step: int | None = None) -> None:
         rec = {"ts": time.time(), "step": step, **metrics}
@@ -59,7 +92,33 @@ class JSONLSink:
         with self._lock:
             if self._fh is None:
                 self._fh = open(self.path, "a", buffering=1)
+                if self.max_bytes:
+                    self._written = self._fh.tell()  # append mode: resume
             self._fh.write(line)
+            if self.max_bytes:
+                self._written += len(line)
+                if self._written >= self.max_bytes:
+                    self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Shift path -> path.1 -> ... -> path.keep_segments (dropped).
+        Whole-line writes + the atomic rename chain mean a concurrent
+        reader sees complete segments or nothing torn."""
+        self._fh.close()
+        self._fh = None
+        try:
+            drop = f"{self.path}.{self.keep_segments}"
+            if os.path.exists(drop):
+                os.remove(drop)
+            for n in range(self.keep_segments - 1, 0, -1):
+                src = f"{self.path}.{n}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{n + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:  # a failed rotation must never lose records:
+            pass         # keep appending to the oversized current file
+        self._written = 0
+        self.rotations += 1
 
     def log_params(self, params: dict[str, Any]) -> None:
         self.log({"params": params})
@@ -214,6 +273,32 @@ def _psutil_state():
     return _PSUTIL_STATE
 
 
+def device_memory_watermarks() -> dict[str, float]:
+    """HBM watermarks aggregated across local devices, via JAX
+    ``memory_stats`` — ``mem_in_use_bytes`` (max per-device bytes live
+    now), ``mem_peak_bytes`` (max per-device high-water mark since start;
+    the number that says whether the next model size fits), and
+    ``mem_limit_bytes``. Silent empty dict when the backend exposes no
+    stats (CPU), so callers can surface these as registry gauges
+    unconditionally."""
+    import jax
+    out: dict[str, float] = {}
+    for d in jax.local_devices():
+        try:
+            stats = getattr(d, "memory_stats", lambda: None)()
+        except Exception:  # backends may raise instead of returning None
+            stats = None
+        if not stats:
+            continue
+        for key, name in (("bytes_in_use", "mem_in_use_bytes"),
+                          ("peak_bytes_in_use", "mem_peak_bytes"),
+                          ("bytes_limit", "mem_limit_bytes")):
+            v = stats.get(key)
+            if v:
+                out[name] = max(out.get(name, 0.0), float(v))
+    return out
+
+
 def device_metrics() -> dict[str, float]:
     """TPU-side system metrics (replaces torch.cuda.utilization,
     utils/mlflow_utils.py:15-29): per-device HBM in use, via JAX
@@ -224,6 +309,9 @@ def device_metrics() -> dict[str, float]:
         stats = getattr(d, "memory_stats", lambda: None)()
         if stats:
             out[f"device{i}_bytes_in_use"] = float(stats.get("bytes_in_use", 0))
+            peak = stats.get("peak_bytes_in_use")
+            if peak:
+                out[f"device{i}_peak_bytes"] = float(peak)
             lim = stats.get("bytes_limit")
             if lim:
                 out[f"device{i}_mem_fraction"] = (

@@ -92,6 +92,28 @@ class Counter:
         return self._value
 
 
+class Gauge:
+    """Last-value-wins gauge (thread-safe) — point-in-time levels the
+    counter/histogram pair can't express: device memory watermarks, cache
+    residency, fleet node counts. Snapshots as the bare name, like a
+    counter."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = check_metric_name(name)
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
 def percentile(sorted_vals, q: float) -> float:
     """numpy's default ('linear') percentile on an already-sorted list —
     implemented locally so the hot observability path never imports
@@ -184,9 +206,23 @@ class Registry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._metrics)
+
+    def digest(self) -> str:
+        """Short stable digest of the registered metric VOCABULARY (names,
+        not values). Rides in heartbeats (engine/health.py) so a fleet
+        report can flag nodes running a different instrumentation version
+        — after an auto-update that renames metrics, aggregating their
+        snapshots with the rest of the fleet's would silently compare
+        different quantities."""
+        import hashlib
+        return hashlib.sha256(
+            ",".join(self.names()).encode()).hexdigest()[:12]
 
     def __len__(self) -> int:
         return len(self._metrics)
@@ -199,7 +235,7 @@ class Registry:
             items = list(self._metrics.items())
         out: dict[str, float] = {}
         for name, m in items:
-            if isinstance(m, Counter):
+            if isinstance(m, (Counter, Gauge)):
                 out[name] = m.value
             else:
                 for k, v in m.snapshot().items():
@@ -276,15 +312,32 @@ def observe(name: str, value: float) -> None:
     _STATE.registry.histogram(name).observe(value)
 
 
+def gauge(name: str, value: float) -> None:
+    """Set a registry gauge — no-op when disabled."""
+    if _STATE.sink is None:
+        return
+    _STATE.registry.gauge(name).set(value)
+
+
+def registry_digest() -> str:
+    return _STATE.registry.digest()
+
+
 def flush(sink=None, *, step: int | None = None) -> dict[str, float]:
     """Snapshot the registry through ``sink`` (default: the configured
     one). The periodic-flush primitive each role calls at its natural
-    cadence."""
+    cadence. Flush records carry an ``obs_registry`` role marker so
+    offline joins (scripts/fleet_report.py) can attribute a snapshot to
+    its emitting role without relying on file names."""
     if sink is None:
         sink = _STATE.sink
     if sink is None:
         return {}
-    return _STATE.registry.flush_to(sink, step=step)
+    snap = _STATE.registry.snapshot()
+    if snap:
+        sink.log({"obs_registry": _STATE.role or "unknown", **snap},
+                 step=step)
+    return snap
 
 
 # ---------------------------------------------------------------------------
@@ -515,6 +568,14 @@ class AnomalyMonitor:
                 self._trigger("push_failure_streak",
                               streak=self._fail_streak)
 
+    def trigger_external(self, reason: str, **details) -> None:
+        """Arm on an externally-detected anomaly — the fleet health
+        plane's SLO breaches (engine/health.py) route through here so a
+        stale miner or a fleet-wide loss divergence arms the SAME
+        one-shot capture budget as the local detectors (first anomaly of
+        any origin wins, forever)."""
+        self._trigger(check_metric_name(reason), **details)
+
     # -- capture plumbing ---------------------------------------------------
     def tick(self) -> None:
         """Forward one step tick to the (possibly armed) capture."""
@@ -531,7 +592,9 @@ class AnomalyMonitor:
         self.triggered = reason
         count(f"obs.anomaly.{reason}")
         logger.warning("anomaly detected (%s%s)%s", reason,
-                       "".join(f" {k}={v:.4g}" for k, v in details.items()),
+                       "".join(f" {k}={v:.4g}" if isinstance(v, float)
+                               else f" {k}={v}"
+                               for k, v in details.items()),
                        "" if self.capture is None
                        else " — arming one-shot profiler capture")
         if _STATE.sink is not None:

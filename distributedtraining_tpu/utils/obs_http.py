@@ -1,0 +1,209 @@
+"""Zero-dependency Prometheus-text HTTP exporter for the obs registry and
+the fleet health ledger.
+
+``--obs-port N`` makes any role scrapeable: a stdlib
+``ThreadingHTTPServer`` on a daemon thread serves
+
+- ``/metrics`` — Prometheus text exposition (version 0.0.4): every
+  registry counter/gauge as ``dt_<name>`` (dots become underscores,
+  the registry lint guarantees the rest is legal), every histogram as
+  its flattened ``_count/_sum/_p50/_p95/_p99`` gauges, and — when a
+  :class:`~..engine.health.FleetMonitor` is attached — the live
+  contribution ledger as ``dt_fleet_*{role=...,hotkey=...}`` series
+  (label cardinality is bounded by the fleet size, the same reasoning
+  as the validator's one-structured-record rule).
+- ``/healthz`` — a JSON liveness probe (role, metric count, fleet size).
+
+No new dependencies, no TLS, binds 127.0.0.1 by default — this is a
+scrape endpoint for a co-located agent, not a public surface. Live
+exporters are tracked in a weak set so the tests/conftest.py hygiene
+guard can fail any test that leaves a socket listening.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import obs
+
+logger = logging.getLogger(__name__)
+
+_LIVE_EXPORTERS: "weakref.WeakSet[ObsHTTPExporter]" = weakref.WeakSet()
+
+
+def live_exporters() -> list["ObsHTTPExporter"]:
+    return list(_LIVE_EXPORTERS)
+
+
+def prom_name(name: str) -> str:
+    """Registry name -> Prometheus metric name. The registry lint
+    ([a-z0-9_.]+) plus the ``dt_`` namespace prefix guarantees the result
+    matches Prometheus's [a-zA-Z_][a-zA-Z0-9_]*."""
+    return "dt_" + name.replace(".", "_")
+
+
+def _prom_value(v) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def _label_escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+                 .replace("\n", r"\n")
+
+
+# ledger field -> (prometheus suffix, help) — the numeric per-node series
+_FLEET_SERIES = (
+    ("beats", "fleet_beats", "distinct heartbeats observed"),
+    ("last_seen_age_s", "fleet_last_seen_age_seconds",
+     "seconds since the last fresh heartbeat"),
+    ("steps", "fleet_steps", "lifetime steps reported"),
+    ("step_rate", "fleet_step_rate", "steps per second"),
+    ("loss_ema", "fleet_loss_ema", "node loss EMA"),
+    ("pushes", "fleet_pushes", "deltas the node reports published"),
+    ("pushes_failed", "fleet_pushes_failed", "exhausted publish retries"),
+    ("published", "fleet_published", "distinct delta revisions staged"),
+    ("accepted", "fleet_accepted", "deltas accepted into merges"),
+    ("declined", "fleet_declined", "deltas declined at staging"),
+    ("stale_rounds", "fleet_stale_rounds",
+     "rounds since the delta revision changed"),
+    ("score", "fleet_score", "latest validator score"),
+    ("mem_peak_bytes", "fleet_mem_peak_bytes",
+     "node device-memory high-water mark"),
+)
+
+
+def render(registry=None, fleet=None) -> str:
+    """The exposition body — separable from the server for tests and for
+    one-shot dumps."""
+    reg = registry if registry is not None else obs.registry()
+    lines: list[str] = []
+    snap = reg.snapshot()
+    for name in sorted(snap):
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_prom_value(snap[name])}")
+    if fleet is not None:
+        try:
+            ledger = fleet.ledger()
+        except Exception:  # a broken monitor must not 500 the registry
+            logger.exception("obs_http: fleet ledger render failed")
+            ledger = {}
+        for field, pn_suffix, help_txt in _FLEET_SERIES:
+            rows = [(rec, rec.get(field)) for rec in ledger.values()
+                    if isinstance(rec.get(field), (int, float))]
+            if not rows:
+                continue
+            pn = "dt_" + pn_suffix
+            lines.append(f"# HELP {pn} {help_txt}")
+            lines.append(f"# TYPE {pn} gauge")
+            for rec, v in rows:
+                labels = (f'role="{_label_escape(rec["role"])}",'
+                          f'hotkey="{_label_escape(rec["hotkey"])}"')
+                lines.append(f"{pn}{{{labels}}} {_prom_value(v)}")
+        breaches = [rec for rec in ledger.values() if rec.get("breaches")]
+        if breaches:
+            lines.append("# TYPE dt_fleet_slo_breached gauge")
+            for rec in breaches:
+                for rule in rec["breaches"]:
+                    lines.append(
+                        f'dt_fleet_slo_breached{{role='
+                        f'"{_label_escape(rec["role"])}",hotkey='
+                        f'"{_label_escape(rec["hotkey"])}",rule='
+                        f'"{_label_escape(rule)}"}} 1.0')
+    return "\n".join(lines) + "\n"
+
+
+class ObsHTTPExporter:
+    """Serve :func:`render` on ``http://host:port/metrics``.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is
+    returned by :meth:`start` and kept in ``.port``. The server thread
+    and every handler thread are daemons; :meth:`close` shuts the
+    listener down and joins the serve thread (idempotent)."""
+
+    def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
+                 registry=None, fleet=None, role: str | None = None):
+        self.host = host
+        self.port = port
+        self.registry = registry
+        self.fleet = fleet
+        self.role = role
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        if self._server is not None:
+            return self.port
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet: no per-scrape spam
+                logger.debug("obs_http: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    try:
+                        body = render(exporter.registry,
+                                      exporter.fleet).encode()
+                    except Exception:
+                        logger.exception("obs_http: render failed")
+                        self._send(500, b"render failed\n", "text/plain")
+                        return
+                    self._send(200, body,
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    reg = (exporter.registry if exporter.registry
+                           is not None else obs.registry())
+                    info = {"ok": True, "role": exporter.role,
+                            "metrics": len(reg),
+                            "fleet_nodes": (len(exporter.fleet.nodes)
+                                            if exporter.fleet is not None
+                                            else None)}
+                    self._send(200, (json.dumps(info) + "\n").encode(),
+                               "application/json")
+                else:
+                    self._send(404, b"not found\n", "text/plain")
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"obs-http-{self.port}",
+                                        daemon=True)
+        self._thread.start()
+        _LIVE_EXPORTERS.add(self)
+        logger.info("obs exporter serving on http://%s:%d/metrics",
+                    self.host, self.port)
+        return self.port
+
+    @property
+    def running(self) -> bool:
+        return self._server is not None
+
+    def close(self) -> None:
+        server, self._server = self._server, None
+        thread, self._thread = self._thread, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+        _LIVE_EXPORTERS.discard(self)

@@ -27,7 +27,7 @@ from distributedtraining_tpu.config import RunConfig           # noqa: E402
 from distributedtraining_tpu.engine import (                   # noqa: E402
     AveragerLoop, GeneticMerge, OuterOptMerge, ParameterizedMerge,
     WeightedAverage)
-from neurons.common import build                               # noqa: E402
+from neurons.common import build, build_health_plane           # noqa: E402
 
 
 def make_strategy(cfg: RunConfig, model):
@@ -57,6 +57,16 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(message)s")
     cfg = RunConfig.from_args("averager", argv)
     c = build(cfg)
+    # fleet health plane: the averager both heartbeats AND monitors —
+    # its FleetMonitor folds every gather's staging outcomes into the
+    # contribution ledger and evaluates the SLO rules each round; a
+    # breach arms the AnomalyMonitor one-shot (detection + counters —
+    # no train loop here to tick a profiler capture).
+    from distributedtraining_tpu.engine.health import report_vitals
+    from distributedtraining_tpu.utils.obs import AnomalyMonitor
+    plane = build_health_plane(cfg, c, monitor=True,
+                               anomaly=AnomalyMonitor(),
+                               start_heartbeat=False)
     loop = AveragerLoop(c.engine, c.transport, c.chain,
                         make_strategy(cfg, c.model),
                         val_batches=c.eval_batches(),
@@ -67,7 +77,12 @@ def main(argv=None) -> int:
                         stale_deltas=cfg.stale_deltas or "skip",
                         publish_policy=cfg.publish_policy,
                         ingest_workers=cfg.ingest_workers,
-                        ingest_cache_mb=cfg.ingest_cache_mb)
+                        ingest_cache_mb=cfg.ingest_cache_mb,
+                        fleet=plane.fleet)
+    if plane.heartbeat is not None:
+        plane.heartbeat.vitals = report_vitals(
+            loop.report, base_revision=lambda: loop._base_revision)
+        plane.heartbeat.start()
     loop.bootstrap(params=c.initial_params)
     try:
         merged = loop.run_periodic(interval=cfg.averaging_interval,
@@ -75,6 +90,7 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         merged = loop.report.rounds > 0
     finally:
+        plane.close()  # exporter socket + heartbeat timer + fleet pool
         loop.close()   # drain the ingest pool's worker threads
         # see neurons/miner.py: global obs state must not outlive the role
         from distributedtraining_tpu.utils import obs

@@ -142,6 +142,61 @@ class Components:
         return self._batches_over(tail[off:] + tail[:off])
 
 
+@dataclasses.dataclass
+class HealthPlane:
+    """The role's slice of the fleet health plane (engine/health.py):
+    its own heartbeat publisher, optionally a FleetMonitor (validator/
+    averager), and optionally the Prometheus exporter (--obs-port)."""
+    heartbeat: Any = None
+    fleet: Any = None
+    exporter: Any = None
+
+    def close(self) -> None:
+        """Idempotent teardown in dependency order (exporter may render
+        the fleet ledger until the moment it stops serving)."""
+        if self.exporter is not None:
+            self.exporter.close()
+        if self.heartbeat is not None:
+            self.heartbeat.close()
+        if self.fleet is not None:
+            self.fleet.close()
+
+
+def build_health_plane(cfg: RunConfig, c: Components, *,
+                       vitals=None, monitor: bool = False,
+                       anomaly=None,
+                       start_heartbeat: bool = True) -> HealthPlane:
+    """Assemble the role's health plane from config: a heartbeat
+    publisher when ``--heartbeat-interval`` > 0 (``vitals`` supplies the
+    body — engine/health.report_vitals over the role's report), a
+    FleetMonitor for the delta-consuming roles (``monitor=True``), and
+    the ``--obs-port`` exporter. Pod rule: only the coordinator
+    publishes heartbeats or monitors the fleet (writes are gated there
+    anyway, and N identical monitors would multiply probe traffic);
+    the exporter serves per host — per-process registries differ."""
+    from distributedtraining_tpu.parallel import multihost
+
+    plane = HealthPlane()
+    coordinator = multihost.is_coordinator()
+    if cfg.heartbeat_interval > 0 and coordinator:
+        from distributedtraining_tpu.engine.health import (FleetMonitor,
+                                                           HeartbeatPublisher)
+        if monitor:
+            plane.fleet = FleetMonitor(c.transport, metrics=c.metrics,
+                                       anomaly=anomaly)
+        plane.heartbeat = HeartbeatPublisher(
+            c.transport, cfg.role, cfg.hotkey,
+            interval=cfg.heartbeat_interval, vitals=vitals)
+        if start_heartbeat:
+            plane.heartbeat.start()
+    if cfg.obs_port:
+        from distributedtraining_tpu.utils.obs_http import ObsHTTPExporter
+        plane.exporter = ObsHTTPExporter(cfg.obs_port, fleet=plane.fleet,
+                                         role=cfg.role)
+        plane.exporter.start()
+    return plane
+
+
 def build(cfg: RunConfig) -> Components:
     import jax
 
@@ -339,7 +394,11 @@ def build(cfg: RunConfig) -> Components:
 
     sinks = []
     if cfg.metrics_path:
-        sinks.append(JSONLSink(cfg.metrics_path))
+        sinks.append(JSONLSink(
+            cfg.metrics_path,
+            max_bytes=(cfg.metrics_rotate_mb * (1 << 20)
+                       if cfg.metrics_rotate_mb > 0 else None),
+            keep_segments=max(1, cfg.metrics_keep_segments)))
     if cfg.mlflow_uri:
         from distributedtraining_tpu.utils.metrics import MLflowSink
         sinks.append(MLflowSink(tracking_uri=cfg.mlflow_uri,

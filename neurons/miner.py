@@ -25,7 +25,7 @@ force_platform_from_env()
 
 from distributedtraining_tpu.config import RunConfig   # noqa: E402
 from distributedtraining_tpu.engine import MinerLoop   # noqa: E402
-from neurons.common import build                       # noqa: E402
+from neurons.common import build, build_health_plane   # noqa: E402
 
 
 def _guard_kwargs(cfg, c) -> dict:
@@ -121,6 +121,15 @@ def main(argv=None) -> int:
                          push_queue_depth=cfg.push_queue_depth,
                          trace=trace, anomaly=anomaly,
                          **_guard_kwargs(cfg, c))
+    # fleet health plane: heartbeat publisher (loop-managed: starts with
+    # training, final beat + close in flush()) and the --obs-port
+    # exporter. Vitals read the loop's live report.
+    from distributedtraining_tpu.engine.health import report_vitals
+    plane = build_health_plane(
+        cfg, c, start_heartbeat=False,
+        vitals=report_vitals(loop.report,
+                             base_revision=lambda: loop._base_revision))
+    loop.heartbeat = plane.heartbeat
     try:
         loop.bootstrap(params=c.initial_params)
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)
@@ -131,6 +140,7 @@ def main(argv=None) -> int:
     finally:
         if store is not None:
             store.close()
+        plane.close()   # exporter socket + heartbeat timer (idempotent)
         # drop the process-wide observability state: sequential in-process
         # role runs (scripts/e2e_round.py, tests) must not bleed this
         # role's registry/sink into the next

@@ -25,7 +25,7 @@ force_platform_from_env()
 
 from distributedtraining_tpu.config import RunConfig   # noqa: E402
 from distributedtraining_tpu.engine import Validator   # noqa: E402
-from neurons.common import build                       # noqa: E402
+from neurons.common import build, build_health_plane   # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -68,6 +68,24 @@ def main(argv=None) -> int:
                 f"--allow-no-vpermit to run anyway without emitting weights")
         logging.warning("running WITHOUT a validator permit: weights will "
                         "not be emitted")
+    # fleet health plane (after the permit gate, so a refused boot never
+    # leaves an exporter socket or heartbeat timer behind): the validator
+    # heartbeats AND monitors — its ledger carries the per-miner score
+    # history alongside the staging outcomes; SLO breaches arm the
+    # AnomalyMonitor one-shot (detection + counters).
+    from distributedtraining_tpu.engine.health import Vitals
+    from distributedtraining_tpu.utils.obs import AnomalyMonitor
+    plane = build_health_plane(cfg, c, monitor=True,
+                               anomaly=AnomalyMonitor(),
+                               start_heartbeat=False)
+    validator.fleet = plane.fleet   # before the first round's lazy _ingest
+    if plane.heartbeat is not None:
+        plane.heartbeat.vitals = Vitals(
+            steps=lambda: validator._round,
+            loss=lambda: validator.base_loss,
+            counters=lambda: {"rounds": validator._round},
+            base_revision=lambda: validator._base_revision)
+        plane.heartbeat.start()
     validator.bootstrap(params=c.initial_params)
     try:
         ok = validator.run_periodic(interval=cfg.validation_interval,
@@ -76,6 +94,7 @@ def main(argv=None) -> int:
         logging.info("validator interrupted; exiting")
         return 0
     finally:
+        plane.close()       # exporter socket + heartbeat timer + pool
         validator.close()   # drain the ingest pool's worker threads
         # see neurons/miner.py: global obs state must not outlive the role
         from distributedtraining_tpu.utils import obs

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Join the fleet health plane's JSONL records into one fleet table.
+
+The monitor roles (validator/averager with ``--heartbeat-interval``) log
+three kinds of records through their ``--metrics-path`` sinks
+(engine/health.py):
+
+- ``{"heartbeat": {...}}`` — every FRESH heartbeat the FleetMonitor
+  observed (role, hotkey, seq, step rate, loss EMA, push counters,
+  registry digest, device memory watermark);
+- ``{"fleet_ledger": {...}}`` — the per-round contribution-ledger
+  snapshot (deltas published/accepted/declined, staleness in rounds,
+  score, SLO breaches) — the LAST one per file wins;
+- ``{"slo_breach": ...}`` — one record per breach, with detail.
+
+plus the span/registry records every role already writes; registry
+flushes are tagged ``obs_registry: <role>`` (utils/obs.py) and the last
+snapshot per role lands in the report's ``registry`` section (step
+timing, compile.ms, cache counters — the intra-process half of the
+story). Rotated sinks (JSONLSink ``--metrics-rotate-mb``) read
+transparently via obs_report.expand_segments.
+
+Usage:
+    python scripts/fleet_report.py averager.jsonl validator.jsonl
+    python scripts/fleet_report.py --work-dir ./run      # globs *.jsonl
+    python scripts/fleet_report.py ... --json            # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import obs_report  # noqa: E402 — same directory; shares record loading
+
+COLUMNS = ("role", "hotkey", "beats", "age_s", "step_rate", "loss_ema",
+           "published", "accepted", "declined", "stale_rounds", "score",
+           "slo")
+
+
+def build_report(paths: list[str]) -> dict:
+    records = obs_report.load_records(paths)
+    nodes: dict[str, dict] = {}
+    registry: dict[str, dict] = {}
+    breaches: list[dict] = []
+    heartbeats = 0
+    for rec in records:
+        hb = rec.get("heartbeat")
+        if isinstance(hb, dict) and isinstance(hb.get("hotkey"), str):
+            heartbeats += 1
+            key = f"{hb.get('role', '?')}/{hb['hotkey']}"
+            node = nodes.setdefault(key, {"role": hb.get("role"),
+                                          "hotkey": hb["hotkey"]})
+            # heartbeats arrive in file order; later seq wins
+            if hb.get("seq", -1) >= node.get("seq", -1):
+                node.update({k: v for k, v in hb.items() if k != "hb"})
+                if isinstance(rec.get("ts"), (int, float)):
+                    node["observed_ts"] = rec["ts"]
+            continue
+        led = rec.get("fleet_ledger")
+        if isinstance(led, dict):
+            for key, entry in led.items():
+                if isinstance(entry, dict):
+                    nodes.setdefault(key, {}).update(entry)
+            continue
+        if isinstance(rec.get("slo_breach"), str):
+            breaches.append({k: rec.get(k) for k in
+                             ("slo_breach", "role", "hotkey", "detail",
+                              "round", "ts")})
+            continue
+        role = rec.get("obs_registry")
+        if isinstance(role, str):
+            registry[role] = {k: v for k, v in rec.items()
+                              if isinstance(v, (int, float))
+                              and k not in ("ts", "step")}
+    # registry-digest drift: nodes whose instrumentation vocabulary
+    # differs from the fleet majority (usually a version skew)
+    digests = {}
+    for node in nodes.values():
+        d = node.get("registry_digest")
+        if isinstance(d, str):
+            digests[d] = digests.get(d, 0) + 1
+    majority = max(digests, key=digests.get) if digests else None
+    for node in nodes.values():
+        d = node.get("registry_digest")
+        if majority and isinstance(d, str) and d != majority:
+            node["registry_drift"] = True
+    return {
+        "files": paths,
+        "records": len(records),
+        "heartbeats": heartbeats,
+        "nodes": dict(sorted(nodes.items())),
+        "breaches": breaches,
+        "registry": registry,
+        "registry_digest_majority": majority,
+    }
+
+
+def _cell(node: dict, col: str) -> str:
+    if col == "age_s":
+        v = node.get("last_seen_age_s")
+        return "-" if v is None else f"{v:.1f}"
+    if col == "slo":
+        br = node.get("breaches") or []
+        drift = ["registry_drift"] if node.get("registry_drift") else []
+        return ",".join(br + drift) or "-"
+    v = node.get(col)
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def format_table(rep: dict) -> str:
+    rows = [[_cell(node, c) for c in COLUMNS]
+            for node in rep["nodes"].values()]
+    header = list(COLUMNS)
+    widths = [max(len(r[i]) for r in [header] + rows) if rows
+              else len(header[i]) for i in range(len(header))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    lines.append("")
+    lines.append(f"{rep['heartbeats']} heartbeats over "
+                 f"{len(rep['nodes'])} node(s); "
+                 f"{len(rep['breaches'])} SLO breach record(s)")
+    for b in rep["breaches"]:
+        lines.append(f"  breach: {b['slo_breach']} on "
+                     f"{b.get('role')}/{b.get('hotkey')} — {b.get('detail')}")
+    reg = rep.get("registry") or {}
+    interesting = ("miner.step_ms.p50", "compile.ms.count", "compile.ms.p95",
+                   "ingest.cache_hits", "ingest.cache_misses",
+                   "health.beats", "fleet.heartbeats",
+                   "device.mem_peak_bytes")
+    for role, snap in sorted(reg.items()):
+        picks = {k: snap[k] for k in interesting if k in snap}
+        if picks:
+            lines.append(f"registry[{role}]: " + "  ".join(
+                f"{k}={v:.4g}" for k, v in picks.items()))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*", help="per-role JSONL metric files")
+    p.add_argument("--work-dir", default=None,
+                   help="glob <work-dir>/*.jsonl instead of listing files")
+    p.add_argument("--json", dest="json_out", action="store_true",
+                   help="print the full report as JSON (machine-readable)")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON report to this path")
+    a = p.parse_args(argv)
+    paths = list(a.files)
+    if a.work_dir:
+        paths += sorted(glob.glob(os.path.join(a.work_dir, "*.jsonl")))
+    if not paths:
+        p.error("no input files (pass JSONL paths or --work-dir)")
+    rep = build_report(paths)
+    if not rep["nodes"]:
+        print(f"no fleet records found in {len(paths)} file(s) "
+              f"({rep['records']} records total — are the monitor roles "
+              "running with --heartbeat-interval and --metrics-path?)")
+        return 1
+    if a.json_out:
+        print(json.dumps(rep, indent=1, default=float))
+    else:
+        print(format_table(rep))
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(rep, f, indent=1, default=float)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head et al. closing stdout is not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -51,9 +51,27 @@ SPAN_PHASE = {
 }
 
 
+def expand_segments(paths: list[str]) -> list[str]:
+    """Fold JSONLSink rotation segments in (utils/metrics.py): for each
+    path, existing ``path.N`` segments are read OLDEST first, then the
+    current file — a rotated soak run reads exactly like an unrotated
+    one. Standalone reimplementation of metrics.jsonl_segments (scripts
+    stay import-free of the package)."""
+    out: list[str] = []
+    for path in paths:
+        segs = []
+        n = 1
+        while os.path.exists(f"{path}.{n}"):
+            segs.append(f"{path}.{n}")
+            n += 1
+        out.extend(reversed(segs))
+        out.append(path)
+    return out
+
+
 def load_records(paths: list[str]) -> list[dict]:
     records = []
-    for path in paths:
+    for path in expand_segments(paths):
         try:
             with open(path) as f:
                 for line in f:

@@ -100,6 +100,14 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
               # default saturated inside the first merge window
               "--n-docs", "4096",
               "--eval-batches", "2", "--batch-size", "4",
+              # fleet health plane: heartbeats every 30 s; the averager's
+              # FleetMonitor builds the contribution ledger the harvest
+              # step summarizes (a dead loop shows up as stale_node here
+              # long before the r04-style silent plateau)
+              "--heartbeat-interval", "30",
+              # bounded metrics files: hour-scale runs at second-scale
+              # cadences must not grow one multi-GB JSONL
+              "--metrics-rotate-mb", "256",
               "--seq-len", "32", "--eval-seq-len", "64"]
 
     def miner(i: int):
@@ -209,6 +217,23 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
     vpath = os.path.join(work_dir, "validator_metrics.jsonl")
     if os.path.exists(vpath):
         vrounds = sum(1 for _ in open(vpath))
+    # fleet health ledger (non-fatal: the soak's own criteria stand alone)
+    fleet = None
+    try:
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import fleet_report
+        rep = fleet_report.build_report(
+            [p for p in (apath, vpath) if os.path.exists(p)])
+        fleet = {
+            "nodes": {k: {f: n.get(f) for f in
+                          ("beats", "published", "accepted", "declined",
+                           "stale_rounds", "breaches")}
+                      for k, n in rep["nodes"].items()},
+            "heartbeats": rep["heartbeats"],
+            "breaches": rep["breaches"],
+        }
+    except Exception as e:
+        fleet = {"error": repr(e)}
 
     summary = {
         "scenario": f"3-role concurrent soak, {minutes} min, {model}; "
@@ -220,6 +245,7 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
         "miner0_resumed_from_checkpoint": resumed,
         "miner0_stale_checkpoint_fallback": stale_fallback,
         "miner0_pushes_after_restart": pushes_after_restart,
+        "fleet": fleet,
         "disk_samples": disk[:: max(1, len(disk) // 20)],
         "disk_first_bytes": disk[0]["bytes"] if disk else None,
         "disk_last_bytes": disk[-1]["bytes"] if disk else None,

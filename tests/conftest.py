@@ -78,6 +78,42 @@ def _no_leaked_ingest_pool():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _no_leaked_health_plane():
+    """Fleet-health-plane hygiene (engine/health.py + utils/obs_http.py):
+    a HeartbeatPublisher's timer thread (named ``heartbeat-*``) and an
+    ObsHTTPExporter's listening socket are long-lived background
+    machinery that their owners must close() — a leaked timer keeps
+    publishing into whatever transport the next module builds, and a
+    leaked socket holds the port (and a serve thread) for the rest of
+    the process. Force-clean so one offender cannot cascade, then fail
+    the module."""
+    import threading
+    import time as _time
+
+    yield
+    from distributedtraining_tpu.utils import obs_http
+
+    live = obs_http.live_exporters()
+    for exp in live:
+        exp.close()
+    deadline = _time.monotonic() + 6.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.is_alive() and t.name.startswith("heartbeat-")]
+        if not leaked:
+            break
+        if _time.monotonic() > deadline:
+            raise AssertionError(
+                f"test module left heartbeat publisher threads alive: "
+                f"{leaked}; close() the HeartbeatPublisher (or the loop "
+                "that owns it) in teardown")
+        _time.sleep(0.05)
+    assert not live, (
+        f"test module left HTTP exporters serving: {live}; call "
+        "ObsHTTPExporter.close() in teardown")
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _no_leaked_obs_state():
     """Observability hygiene (mirrors the thread-leak guard above): the
     span/metric layer (utils/obs.py) is PROCESS-WIDE state — a test that

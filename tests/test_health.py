@@ -1,0 +1,614 @@
+"""Fleet health plane (engine/health.py + utils/obs_http.py +
+scripts/fleet_report.py).
+
+Covers: heartbeat schema round-trip through a real transport and the
+defensive parse of hostile riders, producer-side field-name linting,
+Vitals rate/EMA derivation, the HeartbeatPublisher's background timer +
+clean shutdown, SLO rule evaluation (all four kinds, one-shot firing,
+AnomalyMonitor arming), the contribution ledger against real StagedDelta
+outcomes, JSONLSink rotation + transparent segment reads, compile-time
+accounting, and the full localfs fleet round: three miners heartbeat and
+push, the validator scores and the averager merges with FleetMonitors
+attached, one miner is "killed" mid-run and the stale-miner SLO fires,
+fleet_report joins the JSONL streams into a ledger that matches the
+averager's merge decisions exactly, and the Prometheus exporter serves
+both registry and ledger metrics.
+"""
+
+import json
+import math
+import os
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.engine import TrainEngine
+from distributedtraining_tpu.engine.average import (AveragerLoop,
+                                                    WeightedAverage)
+from distributedtraining_tpu.engine.health import (FleetMonitor,
+                                                   HeartbeatPublisher,
+                                                   NodeHealth, SLORule,
+                                                   Vitals, build_heartbeat,
+                                                   default_slo_rules,
+                                                   parse_heartbeat,
+                                                   report_vitals)
+from distributedtraining_tpu.engine.ingest import StagedDelta
+from distributedtraining_tpu.engine.scheduler import FakeClock
+from distributedtraining_tpu.engine.train import MinerLoop
+from distributedtraining_tpu.engine.validate import Validator
+from distributedtraining_tpu.chain.local import LocalChain
+from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.transport import (InMemoryTransport,
+                                               LocalFSTransport)
+from distributedtraining_tpu.transport.base import heartbeat_id
+from distributedtraining_tpu.utils import obs
+from distributedtraining_tpu.utils.metrics import (InMemorySink, JSONLSink,
+                                                   jsonl_segments)
+from distributedtraining_tpu.utils.obs import AnomalyMonitor
+from distributedtraining_tpu.utils.obs_http import (ObsHTTPExporter,
+                                                    live_exporters, render)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import fleet_report  # noqa: E402
+import obs_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat schema
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_roundtrip_via_transport():
+    t = InMemoryTransport()
+    hb = build_heartbeat("miner", "hk0", 7, now=123.5, steps=42,
+                         step_rate=1.5, loss_ema=2.25, pushes=3,
+                         pushes_failed=1, base_revision="abc123",
+                         registry_digest="deadbeef0123")
+    t.publish_delta_meta(heartbeat_id("miner", "hk0"), hb)
+    got = parse_heartbeat(t.fetch_delta_meta(heartbeat_id("miner", "hk0")))
+    assert got is not None
+    assert got["role"] == "miner" and got["hotkey"] == "hk0"
+    assert got["seq"] == 7 and got["t"] == 123.5
+    assert got["steps"] == 42.0 and got["loss_ema"] == 2.25
+    assert got["base_revision"] == "abc123"
+    # a heartbeat id never collides with a real hotkey's artifacts
+    assert t.fetch_delta_meta("hk0") is None
+
+
+def test_parse_heartbeat_rejects_junk():
+    assert parse_heartbeat(None) is None
+    assert parse_heartbeat([1, 2]) is None
+    assert parse_heartbeat({"base_revision": "x"}) is None   # delta rider
+    assert parse_heartbeat({"hb": 0, "role": "m", "hotkey": "h",
+                            "seq": 1}) is None               # bad version
+    assert parse_heartbeat({"hb": 1, "role": "m", "hotkey": "h"}) is None
+    assert parse_heartbeat({"hb": 1, "role": 9, "hotkey": "h",
+                            "seq": 1}) is None               # role not str
+    # non-conforming fields are DROPPED, not fatal: bad names, oversized
+    # strings, wrong-kind values
+    got = parse_heartbeat({"hb": 1, "role": "miner", "hotkey": "h",
+                           "seq": 2, "t": 1.0,
+                           "BadName": 1.0, "x/y": 2.0,
+                           "steps": "not-a-number",
+                           "note": "x" * 500,
+                           "loss_ema": 3.5})
+    assert got == {"hb": 1, "role": "miner", "hotkey": "h", "seq": 2,
+                   "t": 1.0, "loss_ema": 3.5}
+
+
+def test_build_heartbeat_lints_field_names():
+    # the registry name lint applies to heartbeat fields at the PRODUCER:
+    # a field that cannot be a metric name must fail here, not at every
+    # consumer (the conftest-era lint, extended to the heartbeat schema)
+    with pytest.raises(ValueError):
+        build_heartbeat("miner", "h", 1, now=0.0, **{"Bad Name": 1.0})
+    from distributedtraining_tpu.engine.health import HEARTBEAT_FIELDS
+    for name in HEARTBEAT_FIELDS:
+        obs.check_metric_name(name)  # the documented schema itself lints
+
+
+def test_vitals_step_rate_and_loss_ema():
+    clock = FakeClock(100.0)
+    state = {"steps": 0, "loss": 4.0}
+    v = Vitals(steps=lambda: state["steps"], loss=lambda: state["loss"],
+               counters=lambda: {"pushes": 2}, base_revision=lambda: "rev1",
+               ema_alpha=0.5, clock=clock)
+    first = v.collect()
+    assert first["steps"] == 0.0 and "step_rate" not in first
+    assert first["loss_ema"] == 4.0 and first["pushes"] == 2.0
+    assert first["base_revision"] == "rev1"
+    assert isinstance(first["registry_digest"], str)
+    state["steps"], state["loss"] = 50, 2.0
+    clock.advance(10.0)
+    second = v.collect()
+    assert second["step_rate"] == pytest.approx(5.0)
+    assert second["loss_ema"] == pytest.approx(3.0)  # 4.0 + 0.5*(2-4)
+    # non-finite losses never poison the EMA
+    state["loss"] = float("nan")
+    clock.advance(10.0)
+    assert v.collect()["loss_ema"] == pytest.approx(3.0)
+
+
+def test_report_vitals_reads_miner_report():
+    from distributedtraining_tpu.engine.train import MinerReport
+    r = MinerReport(steps=10, pushes=2, pushes_failed=1, last_loss=1.5)
+    body = report_vitals(r).collect()
+    assert body["steps"] == 10.0 and body["pushes"] == 2.0
+    assert body["pushes_failed"] == 1.0
+    assert body["loss_ema"] == 1.5
+
+
+# ---------------------------------------------------------------------------
+# Publisher
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_publisher_periodic_and_clean_shutdown():
+    t = InMemoryTransport()
+    hb = HeartbeatPublisher(t, "miner", "hk0", interval=0.01,
+                            vitals=Vitals(steps=lambda: 5))
+    hb.start()
+    deadline = time.monotonic() + 5.0
+    while hb.sent < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    hb.close()
+    assert hb.sent >= 3 and hb.failed == 0
+    got = parse_heartbeat(t.fetch_delta_meta(heartbeat_id("miner", "hk0")))
+    assert got is not None and got["seq"] >= 3 and got["steps"] == 5.0
+    # the timer and upload worker are gone (the conftest guard's rule)
+    assert not [th for th in threading.enumerate()
+                if th.name.startswith("heartbeat-")]
+    hb.close()  # idempotent
+
+
+def test_heartbeat_publisher_survives_transport_failure():
+    class Broken:
+        def publish_delta_meta(self, node_id, meta):
+            raise OSError("down")
+
+    hb = HeartbeatPublisher(Broken(), "miner", "hk0", interval=60.0)
+    hb.beat_now(wait=True)
+    hb.beat_now(wait=True)
+    hb.close()
+    assert hb.failed == 2 and hb.sent == 0  # counted, never raised
+
+
+# ---------------------------------------------------------------------------
+# SLO rules
+# ---------------------------------------------------------------------------
+
+def _beat(transport, role, hotkey, seq, **fields):
+    transport.publish_delta_meta(
+        heartbeat_id(role, hotkey),
+        build_heartbeat(role, hotkey, seq, now=float(seq), **fields))
+
+
+def test_slo_rule_vocabulary_validated():
+    with pytest.raises(ValueError):
+        SLORule("ok_name", "no_such_kind", threshold=1)
+    with pytest.raises(ValueError):
+        SLORule("Bad Name", "stale", threshold=1)
+    assert {r.kind for r in default_slo_rules()} == {
+        "stale", "loss_divergence", "push_failures", "step_rate_collapse"}
+
+
+def test_slo_stale_node_fires_once_and_arms_anomaly():
+    class _Cap:
+        arm_calls = 0
+        def arm(self):
+            self.arm_calls += 1
+        def tick(self):
+            pass
+        def close(self):
+            pass
+
+    cap = _Cap()
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule("stale_node", "stale", threshold=2)],
+                      anomaly=AnomalyMonitor(cap), metrics=InMemorySink())
+    try:
+        _beat(t, "miner", "hk0", 1, steps=1)
+        assert fm.poll(["hk0"]) == 1
+        assert fm.evaluate_slos() == []          # fresh: within objective
+        for _ in range(3):                       # hk0 goes silent
+            fm.poll(["hk0"])
+        breaches = fm.evaluate_slos()
+        assert [b["slo_breach"] for b in breaches] == ["stale_node"]
+        assert fm.evaluate_slos() == []          # one-shot per (node, rule)
+        assert cap.arm_calls == 1                # armed the monitor capture
+        assert fm.anomaly.triggered == "slo_stale_node"
+        assert fm.nodes[("miner", "hk0")].breaches == ["stale_node"]
+    finally:
+        fm.close()
+
+
+def test_slo_loss_divergence_needs_fleet_median():
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule(
+        "loss_divergence", "loss_divergence", threshold=0.5, factor=1.5)])
+    try:
+        _beat(t, "miner", "a", 1, loss_ema=2.0)
+        _beat(t, "miner", "b", 1, loss_ema=2.1)
+        fm.poll(["a", "b"])
+        assert fm.evaluate_slos() == []  # two nodes: no meaningful median
+        _beat(t, "miner", "c", 1, loss_ema=2.2)
+        _beat(t, "miner", "d", 1, loss_ema=9.0)  # the diverged node
+        fm.poll(["a", "b", "c", "d"])
+        breaches = fm.evaluate_slos()
+        assert [(b["slo_breach"], b["hotkey"]) for b in breaches] == [
+            ("loss_divergence", "d")]
+    finally:
+        fm.close()
+
+
+def test_slo_push_failure_streak_from_counter_deltas():
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule(
+        "push_failure_streak", "push_failures", threshold=3)])
+    try:
+        _beat(t, "miner", "hk", 1, pushes=5, pushes_failed=0)
+        fm.poll(["hk"])
+        _beat(t, "miner", "hk", 2, pushes=5, pushes_failed=2)
+        fm.poll(["hk"])
+        assert fm.evaluate_slos() == []          # streak 2 < 3
+        _beat(t, "miner", "hk", 3, pushes=6, pushes_failed=3)
+        fm.poll(["hk"])
+        assert fm.evaluate_slos() == []          # a success reset it
+        _beat(t, "miner", "hk", 4, pushes=6, pushes_failed=6)
+        fm.poll(["hk"])
+        assert [b["slo_breach"] for b in fm.evaluate_slos()] == [
+            "push_failure_streak"]
+    finally:
+        fm.close()
+
+
+def test_slo_step_rate_collapse_after_warmup():
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule(
+        "step_rate_collapse", "step_rate_collapse", threshold=0.0,
+        factor=0.25, warmup=3)])
+    try:
+        for seq, rate in ((1, 10.0), (2, 11.0)):
+            _beat(t, "miner", "hk", seq, step_rate=rate)
+            fm.poll(["hk"])
+        assert fm.evaluate_slos() == []          # still warming up
+        _beat(t, "miner", "hk", 3, step_rate=1.0)  # < 0.25 x peak 11
+        fm.poll(["hk"])
+        breaches = fm.evaluate_slos()
+        assert [b["slo_breach"] for b in breaches] == ["step_rate_collapse"]
+    finally:
+        fm.close()
+
+
+# ---------------------------------------------------------------------------
+# Contribution ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_counts_staging_outcomes():
+    fm = FleetMonitor(InMemoryTransport(), metrics=InMemorySink())
+    try:
+        fm.round = 1
+        fm.record_staging([
+            StagedDelta("a", delta={"w": np.ones(2)}, reason="ok",
+                        revision="r1", cid=None),
+            StagedDelta("b", delta=None, reason="nonfinite",
+                        revision="r9", cid=None),
+            StagedDelta("v91", delta=None, reason="no_delta",
+                        revision=None, cid=None),
+        ])
+        led = fm.ledger()
+        assert "miner/v91" not in led   # never-published hotkeys stay out
+        a, b = led["miner/a"], led["miner/b"]
+        assert a["published"] == 1 and a["accepted"] == 1
+        assert a["declined"] == 0 and a["stale_rounds"] == 0
+        assert b["published"] == 1 and b["accepted"] == 0
+        assert b["declined"] == 1 and b["last_reason"] == "nonfinite"
+        # same revision staged again: published stays, staleness grows
+        fm.round = 2
+        fm.record_staging([StagedDelta("a", delta={"w": np.ones(2)},
+                                       reason="ok", revision="r1",
+                                       cid=None)])
+        a = fm.ledger()["miner/a"]
+        assert a["published"] == 1 and a["accepted"] == 2
+        assert a["stale_rounds"] == 1
+        fm.record_scores({"a": 0.25})
+        assert fm.ledger()["miner/a"]["score"] == 0.25
+    finally:
+        fm.close()
+
+
+# ---------------------------------------------------------------------------
+# JSONL rotation (satellite) + segment-transparent reads
+# ---------------------------------------------------------------------------
+
+def test_jsonl_sink_rotation_and_segment_reads(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = JSONLSink(path, max_bytes=2000, keep_segments=2)
+    n = 120
+    for i in range(n):
+        sink.log({"i": i, "pad": "x" * 40})
+    sink.close()
+    assert sink.rotations >= 3
+    segs = jsonl_segments(path)
+    # bounded: at most keep_segments rotated files + the current file
+    # (absent when the last write itself rotated — reopen is lazy)
+    assert len(segs) <= 3
+    assert segs == [s for s in (f"{path}.2", f"{path}.1", path)
+                    if os.path.exists(s)]
+    # oldest-first concatenation yields a strictly increasing tail of i's
+    recs = obs_report.load_records([path])
+    idx = [r["i"] for r in recs if "i" in r]
+    assert idx == list(range(n - len(idx), n))  # newest kept, order intact
+    assert idx[-1] == n - 1
+    # every surviving line is a whole record (rotation never tears)
+    for seg in segs:
+        for line in open(seg):
+            json.loads(line)
+
+
+def test_jsonl_sink_no_rotation_by_default(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = JSONLSink(path)
+    for i in range(50):
+        sink.log({"i": i, "pad": "x" * 100})
+    sink.close()
+    assert sink.rotations == 0 and jsonl_segments(path) == [path]
+
+
+# ---------------------------------------------------------------------------
+# Compile-time accounting (satellite)
+# ---------------------------------------------------------------------------
+
+def test_screen_deltas_records_compile_ms():
+    from distributedtraining_tpu import delta as delta_lib
+    obs.configure(InMemorySink(), role="t")
+    # a shape no other test screens, so this test always sees a FRESH
+    # compile however many screens ran before it in the process
+    base = {"w": np.zeros((5, 7), np.float32)}
+    deltas = [{"w": np.ones((5, 7), np.float32) * i} for i in range(2)]
+    before = obs.registry().histogram("compile.ms").count
+    verdicts = delta_lib.screen_deltas(deltas, base)
+    assert all(ok for ok, _ in verdicts)
+    reg = obs.registry()
+    assert reg.histogram("compile.ms").count == before + 1
+    assert reg.counter("screen.fresh_compiles").value >= 1
+    # same shapes again: cached program, no new compile recorded
+    delta_lib.screen_deltas(deltas, base)
+    assert reg.histogram("compile.ms").count == before + 1
+
+
+def test_cohort_evaluator_records_compile_ms():
+    from distributedtraining_tpu.engine.batched_eval import (
+        BatchedCohortEvaluator)
+    obs.configure(InMemorySink(), role="t")
+    model, cfg = gpt2.make_model("tiny")
+    engine = TrainEngine(model, seq_len=8)
+    base = engine.place_params(model.init_params(jax.random.PRNGKey(0)))
+    zeros = jax.tree_util.tree_map(lambda x: np.zeros(x.shape, x.dtype),
+                                   jax.device_get(base))
+    batch = {"input_ids": np.zeros((2, 8), np.int32)}
+    ev = BatchedCohortEvaluator(engine)
+    ev.evaluate_cohort(base, [zeros, zeros], iter([batch]))
+    reg = obs.registry()
+    assert reg.counter("val.cohort_bucket_compiles").value == 1
+    assert reg.histogram("compile.ms").count >= 1
+
+
+# ---------------------------------------------------------------------------
+# Exporter
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[^}]*\})? (NaN|[+-]?[0-9eE.+-]+)$")
+
+
+def test_exporter_serves_registry_and_ledger(tmp_path):
+    obs.configure(InMemorySink(), role="t")
+    obs.count("publish.pushes", 3)
+    obs.observe("miner.step_ms", 12.5)
+    obs.gauge("device.mem_peak_bytes", 1e9)
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, metrics=InMemorySink())
+    exp = ObsHTTPExporter(0, fleet=fm, role="tester")
+    try:
+        _beat(t, "miner", "hk0", 1, steps=5, loss_ema=2.0, pushes=1)
+        fm.poll(["hk0"])
+        fm.record_staging([StagedDelta("hk0", delta={"w": np.ones(1)},
+                                       reason="ok", revision="r1",
+                                       cid=None)])
+        port = exp.start()
+        assert exp in live_exporters()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        lines = [ln for ln in body.splitlines() if ln]
+        assert lines, body
+        for ln in lines:
+            if not ln.startswith("#"):
+                assert _PROM_LINE.match(ln), ln
+        assert "dt_publish_pushes 3.0" in body
+        assert "dt_miner_step_ms_p50" in body       # histogram flattening
+        assert "dt_device_mem_peak_bytes" in body   # gauge
+        assert ('dt_fleet_accepted{role="miner",hotkey="hk0"} 1.0'
+                in body)                            # ledger series
+        assert 'dt_fleet_loss_ema{role="miner",hotkey="hk0"} 2.0' in body
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert hz["ok"] is True and hz["fleet_nodes"] == 1
+    finally:
+        exp.close()
+        fm.close()
+    assert exp not in live_exporters()
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                               timeout=1)
+
+
+def test_render_is_parseable_with_empty_state():
+    out = render(registry=obs.registry(), fleet=None)
+    assert out.endswith("\n")
+
+
+# ---------------------------------------------------------------------------
+# The full localfs fleet round
+# ---------------------------------------------------------------------------
+
+def _batch(cfg, n=2, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (n, seq)), np.int32)}
+
+
+def test_fleet_round_localfs_ledger_matches_merge_and_stale_slo(tmp_path):
+    """The acceptance round: 3 miners heartbeat + push over localfs, the
+    validator and averager run FleetMonitors, miner hotkey_2 is killed
+    after the first round (its publisher closes, no further beats), the
+    stale-miner SLO fires and arms the AnomalyMonitor, and the
+    fleet_report ledger matches the averager's merge decisions exactly."""
+    model, cfg = gpt2.make_model("tiny")
+    art = str(tmp_path / "artifacts")
+    chain_dir = str(tmp_path / "chain")
+    hotkeys = ["hotkey_0", "hotkey_1", "hotkey_2"]
+    paths = {r: str(tmp_path / f"{r}.jsonl")
+             for r in ("miner", "validator", "averager")}
+
+    def eval_batches():
+        yield _batch(cfg, seed=1)
+
+    # -- miners: train, heartbeat, push ------------------------------------
+    msink = JSONLSink(paths["miner"])
+    obs.configure(msink, role="miner")
+    publishers = {}
+    try:
+        for hk in hotkeys:
+            transport = LocalFSTransport(art)
+            loop = MinerLoop(TrainEngine(model, seq_len=16), transport, hk,
+                             send_interval=1e9, check_update_interval=1e9,
+                             metrics=msink, log_every=2)
+            hb = HeartbeatPublisher(transport, "miner", hk, interval=1e9,
+                                    vitals=report_vitals(loop.report))
+            loop.bootstrap(jax.random.PRNGKey(0))
+            loop.run(iter([_batch(cfg)] * 3), max_steps=3)
+            loop._push_delta()
+            loop._publisher.flush()
+            hb.beat_now(wait=True)
+            assert loop.report.pushes == 1
+            publishers[hk] = hb
+    finally:
+        obs.reset()
+        msink.close()
+
+    # -- validator: scores the fleet, ledger gets the score history --------
+    vsink = JSONLSink(paths["validator"])
+    obs.configure(vsink, role="validator")
+    vfm = FleetMonitor(LocalFSTransport(art), metrics=vsink)
+    try:
+        val = Validator(TrainEngine(model, seq_len=16),
+                        LocalFSTransport(art),
+                        LocalChain(chain_dir, my_hotkey="hotkey_91"),
+                        eval_batches=eval_batches, metrics=vsink,
+                        cohort_size=8, fleet=vfm)
+        val.bootstrap(rng=jax.random.PRNGKey(0))
+        results = val.validate_and_score()
+        scored = {s.hotkey: s for s in results}
+        for hk in hotkeys:
+            assert scored[hk].loss is not None
+        vled = vfm.ledger()
+        for hk in hotkeys:
+            assert vled[f"miner/{hk}"]["beats"] == 1
+            assert vled[f"miner/{hk}"]["accepted"] == 1
+            assert math.isfinite(vled[f"miner/{hk}"]["score"])
+    finally:
+        val.close()
+        obs.reset()
+        vsink.close()
+
+    # -- averager round 1: all three merge ---------------------------------
+    asink = JSONLSink(paths["averager"])
+    obs.configure(asink, role="averager")
+
+    class _Cap:
+        arm_calls = 0
+        def arm(self):
+            self.arm_calls += 1
+        def tick(self):
+            pass
+        def close(self):
+            pass
+
+    cap = _Cap()
+    afm = FleetMonitor(LocalFSTransport(art), metrics=asink,
+                       rules=[SLORule("stale_node", "stale", threshold=2)],
+                       anomaly=AnomalyMonitor(cap))
+    try:
+        avg = AveragerLoop(TrainEngine(model, seq_len=16),
+                           LocalFSTransport(art),
+                           LocalChain(chain_dir, my_hotkey="hotkey_99"),
+                           WeightedAverage(uniform=True),
+                           val_batches=eval_batches, metrics=asink,
+                           fleet=afm)
+        avg.bootstrap(rng=jax.random.PRNGKey(0))
+        assert avg.run_round() is True
+        assert avg.report.last_accepted == 3
+
+        led = afm.ledger()
+        # the ledger IS the merge decision record: per-miner counts match
+        # the averager's report exactly
+        assert sum(led[f"miner/{h}"]["accepted"] for h in hotkeys) \
+            == avg.report.last_accepted
+        for hk in hotkeys:
+            entry = led[f"miner/{hk}"]
+            assert entry["published"] == 1 and entry["accepted"] == 1
+            assert entry["declined"] == 0 and entry["beats"] == 1
+
+        # -- kill hotkey_2 mid-run: no further beats from it ---------------
+        publishers["hotkey_2"].close()
+        for r in range(3):
+            for hk in ("hotkey_0", "hotkey_1"):   # the living miners
+                publishers[hk].beat_now(wait=True)
+            assert avg.run_round() is True        # rounds keep merging
+        led = afm.ledger()
+        dead, alive = led["miner/hotkey_2"], led["miner/hotkey_0"]
+        assert dead["breaches"] == ["stale_node"]
+        assert alive["breaches"] == []
+        assert cap.arm_calls == 1                 # SLO armed the one-shot
+        assert afm.anomaly.triggered == "slo_stale_node"
+        # the dead miner's unchanged artifact kept merging (stale_rounds
+        # grows) — staleness is about HEARTBEATS, contribution about deltas
+        assert dead["stale_rounds"] >= 3 and dead["accepted"] == 4
+    finally:
+        for hb in publishers.values():
+            hb.close()
+        avg.close()   # also closes afm
+        obs.reset()
+        asink.close()
+
+    # -- fleet_report joins the streams ------------------------------------
+    rep = fleet_report.build_report([paths["validator"], paths["averager"]])
+    nodes = rep["nodes"]
+    assert set(nodes) >= {f"miner/{h}" for h in hotkeys}
+    assert nodes["miner/hotkey_2"]["accepted"] == 4
+    assert nodes["miner/hotkey_2"]["published"] == 1
+    assert nodes["miner/hotkey_0"]["published"] == 1
+    assert nodes["miner/hotkey_2"]["breaches"] == ["stale_node"]
+    assert rep["heartbeats"] >= 3
+    assert any(b["slo_breach"] == "stale_node" and b["hotkey"] == "hotkey_2"
+               for b in rep["breaches"])
+    # registry section: the averager's flush snapshots are attributed
+    assert "averager" in rep["registry"]
+    table = fleet_report.format_table(rep)
+    assert "hotkey_2" in table and "stale_node" in table
+    # machine-readable: the ledger the driver asserts against
+    out = json.dumps(rep, default=float)
+    assert json.loads(out)["nodes"]["miner/hotkey_1"]["accepted"] == 4

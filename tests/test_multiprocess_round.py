@@ -38,6 +38,7 @@ def test_three_miners_validator_averager(tmp_path):
         _run("miner", "--work-dir", work, *COMMON,
              "--hotkey", f"hotkey_{i}", "--max-steps", "25",
              "--send-interval", "1e9",        # flush publishes at exit
+             "--heartbeat-interval", "5",     # fleet health plane on
              "--checkpoint-interval", "0")
         for i in range(3)
     ]
@@ -50,9 +51,14 @@ def test_three_miners_validator_averager(tmp_path):
     deltas = [f for f in listing if f.endswith(".msgpack")]
     assert len(deltas) == 3, listing
     # every artifact ships its meta rider (base revision + the delta_id
-    # correlation id, utils/obs.py)
-    riders = [f for f in listing if f.endswith(".meta.json")]
+    # correlation id, utils/obs.py)...
+    riders = [f for f in listing if f.endswith(".meta.json")
+              and not f.startswith("__hb__")]
     assert len(riders) == 3, listing
+    # ...and every miner heartbeats under the reserved artifact id
+    # (transport/base.heartbeat_id — the fleet health plane's channel)
+    beats = [f for f in listing if f.startswith("__hb__.miner.")]
+    assert len(beats) == 3, listing
 
     v = _run("validator", "--work-dir", work, *COMMON,
              "--hotkey", "hotkey_91", "--rounds", "1")
@@ -64,8 +70,11 @@ def test_three_miners_validator_averager(tmp_path):
     positives = [h for h, s in emitted.items() if s > 0]
     assert set(positives) >= {"hotkey_0", "hotkey_1", "hotkey_2"}, positives
 
+    avg_metrics = os.path.join(work, "averager_metrics.jsonl")
     a = _run("averager", "--work-dir", work, *COMMON,
              "--hotkey", "hotkey_95", "--rounds", "1",
+             "--heartbeat-interval", "5",     # runs the FleetMonitor too
+             "--metrics-path", avg_metrics,
              "--strategy", "weighted")
     out, _ = a.communicate(timeout=420)
     assert a.returncode == 0, out[-2000:]
@@ -76,3 +85,16 @@ def test_three_miners_validator_averager(tmp_path):
     line = [ln for ln in out.splitlines() if "averager done" in ln][-1]
     loss = float(line.rsplit("loss=", 1)[1])
     assert np.isfinite(loss) and loss < 6.2, line
+
+    # the averager's FleetMonitor ledger (via scripts/fleet_report.py)
+    # matches its own merge decisions exactly: 3 miners, each 1 published
+    # + 1 accepted, heartbeats observed from all three
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import fleet_report
+    rep = fleet_report.build_report([avg_metrics])
+    for i in range(3):
+        node = rep["nodes"][f"miner/hotkey_{i}"]
+        assert node["published"] == 1 and node["accepted"] == 1, node
+        assert node["declined"] == 0 and node["beats"] >= 1, node
+        assert node["pushes"] >= 1, node     # from the heartbeat body
+    assert sum(n.get("accepted", 0) for n in rep["nodes"].values()) == 3
