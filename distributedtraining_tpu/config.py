@@ -152,6 +152,31 @@ class RunConfig:
     outer_momentum: float = 0.0              # >0 wraps strategy in OuterOptMerge
     outer_lr: float = 0.7                    # DiLoCo-style outer Nesterov step
 
+    # -- remediation / failover (engine/remediate.py) -----------------------
+    # --remediate closes the loop from SLO breach to action on the
+    # monitor roles: quarantine + probation for breaching miners, score
+    # decay, and elastic cohort sizing over the compiled-bucket ladder.
+    # Requires the health plane (--heartbeat-interval > 0) for breaches
+    # to exist at all.
+    remediate: bool = False
+    quarantine_rules: str = "push_failure_streak,loss_divergence,stale_node"
+    probation_beats: int = 3                 # clean beats to re-admit
+    probation_rounds: int = 2                # rounds on probation after
+    score_decay: float = 0.25                # per-round quarantined decay
+    # averager failover: --standby starts a PASSIVE averager that follows
+    # the primary's lease/heartbeat/base-revision and takes over
+    # publication (lease epoch + 1) after --failover-deadline seconds of
+    # silence (0 = 3x --averaging-interval). The primary holds the lease
+    # whenever --remediate or --standby fleets are in play.
+    standby: bool = False
+    failover_deadline: float = 0.0
+
+    # -- chaos injection (transport/chaos.py; soaks and tests only) ----------
+    # JSON ChaosSpec wrapping this role's transport, e.g.
+    # '{"fetch_error_rate": 0.1, "latency_s": 0.05, "seed": 7}' — faults
+    # are deterministic per (seed, op sequence). Never set in production.
+    chaos_spec: Optional[str] = None
+
     # -- bounded runs (tests / smoke) --------------------------------------
     max_steps: Optional[int] = None
     rounds: Optional[int] = None
@@ -541,6 +566,49 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                             "fitness for every candidate)")
         g.add_argument("--genetic-sigma", dest="genetic_sigma", type=float,
                        default=d.genetic_sigma)
+
+    g = p.add_argument_group("resilience")
+    if role in ("validator", "averager"):  # the monitor roles act on SLOs
+        g.add_argument("--remediate", dest="remediate", action="store_true",
+                       default=d.remediate,
+                       help="act on SLO breaches (engine/remediate.py): "
+                            "quarantine breaching miners out of the ingest "
+                            "set (probation re-admission after clean "
+                            "heartbeats), decay their scores, and size "
+                            "cohorts down the compiled-bucket ladder; "
+                            "needs --heartbeat-interval > 0")
+        g.add_argument("--quarantine-rules", dest="quarantine_rules",
+                       default=d.quarantine_rules,
+                       help="comma-separated SLO rule NAMES whose breach "
+                            "quarantines a miner")
+        g.add_argument("--probation-beats", dest="probation_beats",
+                       type=int, default=d.probation_beats,
+                       help="fresh clean heartbeats before a quarantined "
+                            "miner re-admits into probation")
+        g.add_argument("--probation-rounds", dest="probation_rounds",
+                       type=int, default=d.probation_rounds,
+                       help="rounds a re-admitted miner stays on "
+                            "probation (a breach there re-quarantines)")
+        g.add_argument("--score-decay", dest="score_decay", type=float,
+                       default=d.score_decay,
+                       help="multiplier applied to a quarantined miner's "
+                            "score each round")
+    if role == "averager":
+        g.add_argument("--standby", dest="standby", action="store_true",
+                       default=d.standby,
+                       help="start as a PASSIVE failover averager: follow "
+                            "the primary's lease/heartbeat/base revision "
+                            "and take over publication (lease epoch + 1) "
+                            "only after --failover-deadline of silence")
+        g.add_argument("--failover-deadline", dest="failover_deadline",
+                       type=_nonneg_float, default=d.failover_deadline,
+                       help="seconds of primary silence before a standby "
+                            "takes over (0 = 3x --averaging-interval)")
+    g.add_argument("--chaos-spec", dest="chaos_spec", default=None,
+                   help="JSON transport/chaos.py ChaosSpec wrapping this "
+                        "role's transport (deterministic fault injection "
+                        "for soaks/tests; NEVER set in production), e.g. "
+                        "'{\"fetch_error_rate\": 0.1, \"seed\": 7}'")
 
     g = p.add_argument_group("run bounds")
     g.add_argument("--max-steps", dest="max_steps", type=int, default=None)

@@ -15,6 +15,8 @@ from .health import (FleetMonitor, HeartbeatPublisher, NodeHealth, SLORule,
                      Vitals, default_slo_rules, report_vitals)
 from .ingest import DeltaCache, DeltaIngestor, IngestPool, StagedDelta
 from .publish import DeltaPublisher, PublishWorker, SupersedeQueue
+from .remediate import (LeaseManager, RemediationEngine, RemediationPolicy,
+                        StandbyAverager, elastic_cohort)
 from .validate import Validator
 from .average import (
     AveragerLoop,
@@ -33,6 +35,8 @@ __all__ = [
     "DeltaPublisher", "PublishWorker", "SupersedeQueue",
     "FleetMonitor", "HeartbeatPublisher", "NodeHealth", "SLORule",
     "Vitals", "default_slo_rules", "report_vitals",
+    "LeaseManager", "RemediationEngine", "RemediationPolicy",
+    "StandbyAverager", "elastic_cohort",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
     "OuterOptMerge",

@@ -496,13 +496,25 @@ class AveragerLoop:
                  publish_policy: str = "improved",
                  ingest_workers: int = 4,
                  ingest_cache_mb: int = 2048,
-                 fleet=None):
+                 fleet=None,
+                 remediation=None,
+                 lease=None):
         self.engine = engine
         # fleet health plane (engine/health.py FleetMonitor): polled at
         # the round cadence, fed the EXACT staging outcomes each gather
         # acted on (the contribution ledger matches the merge decisions
         # by construction), SLO-evaluated and ledger-flushed per round
         self.fleet = fleet
+        # remediation layer (engine/remediate.py RemediationEngine): its
+        # quarantine set is the staging exclude hook, and each round's
+        # SLO breaches drive its state machine at _fleet_round_end
+        self.remediation = remediation
+        # publication lease (engine/remediate.py LeaseManager): when set,
+        # ownership is re-confirmed immediately before every base publish
+        # and the publish stamps the held epoch — the failover arbitration
+        # that keeps base publication single-writer across a standby
+        # takeover. None = no failover configured (single-averager fleet).
+        self.lease = lease
         self.transport = transport
         self.chain = chain
         self.strategy = strategy
@@ -669,7 +681,10 @@ class AveragerLoop:
                 logger.exception("averager: fleet heartbeat poll failed")
         staged = self._ingest().stage(hotkeys,
                                       base_revision=self._base_revision,
-                                      multi=self._multi())
+                                      multi=self._multi(),
+                                      exclude=(self.remediation.is_excluded
+                                               if self.remediation is not None
+                                               else None))
         ids, deltas = [], []
         rejected = 0
         for s in staged:
@@ -680,6 +695,10 @@ class AveragerLoop:
                 if s.reason == "stale_base":
                     logger.info("averager: skipping %s (delta vs a "
                                 "superseded base)", s.hotkey)
+                    rejected += 1
+                elif s.reason == "quarantined":
+                    logger.info("averager: skipping %s (quarantined)",
+                                s.hotkey)
                     rejected += 1
                 elif s.reason != "no_delta":
                     # shape/NaN/magnitude screens (averaging_logic.py:
@@ -717,15 +736,20 @@ class AveragerLoop:
         return frozenset(out)
 
     def _fleet_round_end(self) -> None:
-        """SLO evaluation + ledger flush at the round cadence — called on
-        EVERY run_round exit (merged, declined, or empty), so staleness
-        advances and breaches fire even when nothing merges (a dead fleet
-        is exactly when the SLOs matter). Isolated: health-plane failures
-        never fail a round."""
+        """SLO evaluation + remediation + ledger flush at the round
+        cadence — called on EVERY run_round exit (merged, declined, or
+        empty), so staleness advances and breaches fire even when nothing
+        merges (a dead fleet is exactly when the SLOs matter). Isolated:
+        health-plane failures never fail a round."""
         if self.fleet is None:
             return
         try:
-            self.fleet.evaluate_slos()
+            breaches = self.fleet.evaluate_slos()
+            if self.remediation is not None:
+                # breaches become actions: quarantine, probation ticks,
+                # re-admission (engine/remediate.py) — BEFORE the flush so
+                # the ledger snapshot this round records the new state
+                self.remediation.observe_round(breaches)
             self.fleet.flush(self.metrics, step=self.report.rounds)
         except Exception:
             logger.exception("averager: fleet round-end failed")
@@ -822,16 +846,51 @@ class AveragerLoop:
                 # the round DID meaningful work (gathered + merged +
                 # evaluated); only the publish was declined
                 return True
+        if self.lease is not None:
+            held = False
+            try:
+                held = self.lease.renew()
+            except Exception:
+                logger.exception("averager: lease renewal failed")
+            if not held:
+                # a higher epoch exists (a standby took over while this
+                # averager was wedged/partitioned): publishing now would
+                # put TWO writers on the shared base. Stand down — keep
+                # merging locally so a later re-acquisition resumes warm,
+                # but the round publishes nothing.
+                logger.warning("averager: publication lease not held; "
+                               "standing down (merged but not published)")
+                obs.count("avg.lease_standdowns")
+                self.report.last_loss = loss
+                self.report.skipped_publishes += 1
+                if self.metrics:
+                    self.metrics.log(
+                        {"merged_loss": loss, "merged_ppl": ppl,
+                         "accepted": len(ids), "published": 0,
+                         "lease_lost": 1,
+                         "merge_delta_ids": dict(self._round_cids)},
+                        step=self.report.rounds)
+                    obs.flush(self.metrics, step=self.report.rounds)
+                self._fleet_round_end()
+                self.report.rounds += 1
+                return True
         self.report.last_loss = loss
         if self.metrics:
             self.metrics.log({"merged_loss": loss, "merged_ppl": ppl,
                               "accepted": len(ids), "published": 1,
+                              "lease_epoch": (self.lease.epoch
+                                              if self.lease else None),
                               "merge_delta_ids": dict(self._round_cids)},
                              step=self.report.rounds)
         from .train import wire_out
         with obs.span("avg.publish", cids=cids):
             self._base_revision = self.transport.publish_base(
                 wire_out(self.engine, merged))
+        if self.lease is not None:
+            # the publication carries the epoch: the token now names the
+            # revision just published under the held epoch
+            self.lease.stamp(self._base_revision)
+            obs.gauge("avg.lease_epoch", float(self.lease.epoch))
         # round-spanning strategy state (e.g. OuterOptMerge velocity) commits
         # only once the new base is actually out
         commit = getattr(self.strategy, "commit", None)

@@ -85,12 +85,20 @@ def _timed_compile(fn, *args):
 class BatchedCohortEvaluator:
     """Owns the per-bucket jitted cohort-eval programs for one engine."""
 
-    def __init__(self, engine, *, buckets: Sequence[int] = BUCKETS):
+    def __init__(self, engine, *, buckets: Sequence[int] = BUCKETS,
+                 prefer_compiled: bool = False):
         bs = tuple(sorted(set(int(b) for b in buckets)))
         if not bs or bs[0] < 1:
             raise ValueError(f"buckets must be positive ints, got {buckets}")
         self.engine = engine
         self.buckets = bs
+        # remediation's elastic-cohort discipline (engine/remediate.py):
+        # when the ladder bucket for k is NOT yet compiled but a larger
+        # one is, pad up to the compiled bucket instead of compiling the
+        # exact fit — a fleet whose healthy count wobbles then reuses one
+        # program (padding waste) rather than walking the ladder through
+        # fresh multi-second compiles (compile storm)
+        self.prefer_compiled = prefer_compiled
         # ONE jitted callable, built lazily; jax.jit's executable cache
         # keys on the padded stack's shapes, so the bucket ladder bounds
         # the compile count (the ParameterizedMerge._step_cache
@@ -130,7 +138,18 @@ class BatchedCohortEvaluator:
         if mesh is not None:
             n = mesh.shape[self._axis(mesh)]
             target = ((target + n - 1) // n) * n
+        if self.prefer_compiled and target not in self._buckets_seen:
+            # compiled buckets satisfied any mesh rounding when they were
+            # first dispatched, so they stay valid targets here
+            bigger = sorted(b for b in self._buckets_seen if b >= target)
+            if bigger:
+                target = bigger[0]
         return target
+
+    def compiled_buckets(self) -> frozenset:
+        """Bucket sizes with a compiled cohort program (the elastic-cohort
+        chooser in engine/remediate.py prefers these)."""
+        return frozenset(self._buckets_seen)
 
     @staticmethod
     def _axis(mesh) -> str:

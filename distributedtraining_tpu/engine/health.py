@@ -409,6 +409,9 @@ class NodeHealth:
     score_history: deque = dataclasses.field(
         default_factory=lambda: deque(maxlen=32))
     breaches: list = dataclasses.field(default_factory=list)
+    # -- remediation state (engine/remediate.py owns the transitions) --------
+    quarantined: bool = False           # dropped from the ingest hotkey set
+    probation: bool = False             # re-admitted, still under watch
 
     def as_record(self, now: float | None = None) -> dict:
         rec = {
@@ -423,6 +426,9 @@ class NodeHealth:
             "declined": self.declined, "last_reason": self.last_reason,
             "stale_rounds": self.stale_rounds, "score": self.score,
             "breaches": list(self.breaches),
+            # numeric so the exporter can serve dt_fleet_quarantined
+            "quarantined": int(self.quarantined),
+            "probation": int(self.probation),
         }
         if self.mem_peak_bytes:
             rec["mem_peak_bytes"] = self.mem_peak_bytes
@@ -587,10 +593,20 @@ class FleetMonitor:
     def poll(self, hotkeys: Iterable[str], *,
              roles: Sequence[str] | None = None) -> int:
         """One observation round over ``hotkeys`` x ``roles``; returns how
-        many FRESH heartbeats (new sequence numbers) were folded in."""
+        many FRESH heartbeats (new sequence numbers) were folded in.
+
+        ``hotkeys`` is the chain registry's CURRENT view, so it doubles as
+        the ledger's membership list: entries for (polled-role, hotkey)
+        pairs that are no longer registered are PRUNED — a deregistered
+        node would otherwise accumulate forever and keep skewing
+        ``fleet_median_loss`` with its final loss_ema. Pruned records are
+        tagged into the flush sink (``{"fleet_pruned": ...}``) so the
+        node's last ledger state survives in the JSONL stream even though
+        the live ledger forgets it."""
         self.round += 1
-        keys = [(role, h) for role in (roles or self.roles)
-                for h in dict.fromkeys(hotkeys)]
+        active_roles = tuple(roles or self.roles)
+        active = set(dict.fromkeys(hotkeys))
+        keys = [(role, h) for role in active_roles for h in active]
         with obs.span("fleet.poll", nodes=len(keys)):
             beats = self.pool.map(self._fetch, keys)
         fresh = 0
@@ -600,10 +616,35 @@ class FleetMonitor:
                     continue
                 if self._ingest(key, hb):
                     fresh += 1
+            pruned = self._prune_locked(active, active_roles)
+        for rec in pruned:
+            obs.count("fleet.pruned")
+            logger.info("fleet: pruned %s/%s (left the chain registry)",
+                        rec["role"], rec["hotkey"])
+            if self.metrics is not None:
+                try:
+                    self.metrics.log({"fleet_pruned": rec,
+                                      "fleet_round": self.round})
+                except Exception:
+                    logger.exception("fleet: prune sink emit failed")
         obs.count("fleet.polls")
         obs.gauge("fleet.nodes", float(sum(1 for n in self.nodes.values()
                                            if n.beats > 0)))
         return fresh
+
+    def _prune_locked(self, active: set, roles: Sequence[str]) -> list[dict]:
+        """Drop ledger entries (and their fired-breach memory) for hotkeys
+        the registry no longer lists. Only roles THIS poll covered are
+        pruned — an averager-role entry must not vanish because a
+        miner-only poll didn't name it."""
+        now = self.clock.now()
+        gone = [k for k, n in self.nodes.items()
+                if k[0] in roles and k[1] not in active]
+        records = []
+        for key in gone:
+            records.append(self.nodes.pop(key).as_record(now))
+            self._fired = {f for f in self._fired if (f[0], f[1]) != key}
+        return records
 
     def _ingest(self, key: tuple[str, str], hb: dict) -> bool:
         node = self.node(*key)
@@ -689,6 +730,21 @@ class FleetMonitor:
                 node.score = float(score)
                 node.score_history.append(float(score))
 
+    def clear_fired(self, role: str, hotkey: str,
+                    rule: str | None = None) -> None:
+        """Re-arm breach firing for a node (one rule, or all of them).
+        Breaches are one-shot per (node, rule) per monitor lifetime; the
+        remediation layer clears them when it re-admits a quarantined
+        node, so a RELAPSE can breach — and be quarantined — again."""
+        with self._lock:
+            self._fired = {f for f in self._fired
+                           if not (f[0] == role and f[1] == hotkey
+                                   and (rule is None or f[2] == rule))}
+            node = self.nodes.get((role, hotkey))
+            if node is not None:
+                node.breaches = [b for b in node.breaches
+                                 if rule is not None and b != rule]
+
     # -- SLO evaluation ------------------------------------------------------
     def fleet_median_loss(self) -> float | None:
         losses = [n.loss_ema for n in self.nodes.values()
@@ -755,7 +811,10 @@ class FleetMonitor:
             stale = sum(1 for n in self.nodes.values()
                         if n.beats > 0 and n.last_seen_round is not None
                         and self.round - n.last_seen_round > 1)
+            quarantined = sum(1 for n in self.nodes.values()
+                              if n.quarantined)
         obs.gauge("fleet.stale_nodes", float(stale))
+        obs.gauge("fleet.quarantined", float(quarantined))
         sink = sink if sink is not None else self.metrics
         if sink is not None and led:
             try:

@@ -363,18 +363,33 @@ class DeltaIngestor:
 
     # -- public entry --------------------------------------------------------
     def stage(self, hotkeys: Sequence[str], *, base_revision=None,
-              multi: bool = False) -> list[StagedDelta]:
+              multi: bool = False, exclude=None) -> list[StagedDelta]:
         """Stage every hotkey's current submission; returns one
         :class:`StagedDelta` per hotkey, in input order. Per-miner
-        failures are isolated (reason ``fetch_error``), never raised."""
+        failures are isolated (reason ``fetch_error``), never raised.
+
+        ``exclude``: optional ``hotkey -> bool`` filter hook (the
+        remediation layer's quarantine set, engine/remediate.py).
+        Excluded hotkeys stage to ``reason="quarantined"`` WITHOUT any
+        transport traffic — the refusal still flows to the staging
+        observer, so the contribution ledger records exactly why the
+        submission was withheld. On a pod only the coordinator holds the
+        quarantine state; its verdict broadcasts like every other staging
+        outcome."""
         hotkeys = list(hotkeys)
         if not hotkeys:
             return []
         if multi:
-            staged = self._stage_multi(hotkeys, base_revision)
+            staged = self._stage_multi(hotkeys, base_revision,
+                                       exclude=exclude)
         else:
-            staged = self.pool.map(
-                lambda h: self._stage_one(h, base_revision), hotkeys)
+            def one(h):
+                if exclude is not None and exclude(h):
+                    obs.count("ingest.quarantined_skips")
+                    return StagedDelta(h, None, "quarantined", None, None)
+                return self._stage_one(h, base_revision)
+
+            staged = self.pool.map(one, hotkeys)
         self._screen_fresh(staged, cache=not multi)
         if self.observer is not None:
             try:
@@ -557,8 +572,8 @@ class DeltaIngestor:
             out["data"] = None
         return out
 
-    def _stage_multi(self, hotkeys: list[str],
-                     base_revision) -> list[StagedDelta]:
+    def _stage_multi(self, hotkeys: list[str], base_revision,
+                     exclude=None) -> list[StagedDelta]:
         """Pod spelling: the coordinator's pool prefetches everything, the
         main thread broadcasts per hotkey IN LIST ORDER (verdict JSON,
         then bytes) — the same lockstep rule as every other pod transport
@@ -570,8 +585,14 @@ class DeltaIngestor:
         coord = multihost.is_coordinator()
         pre: dict[str, dict] = {}
         if coord:
-            pre = dict(zip(hotkeys, self.pool.map(
-                lambda h: self._prefetch_raw(h, base_revision), hotkeys)))
+            def prefetch(h):
+                if exclude is not None and exclude(h):
+                    obs.count("ingest.quarantined_skips")
+                    return {"rev": None, "cid": None,
+                            "reason": "quarantined", "data": None}
+                return self._prefetch_raw(h, base_revision)
+
+            pre = dict(zip(hotkeys, self.pool.map(prefetch, hotkeys)))
         staged: list[StagedDelta] = []
         for h in hotkeys:
             rec = pre.get(h) or {}

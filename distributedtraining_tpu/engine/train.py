@@ -758,10 +758,20 @@ class MinerLoop:
         # SAME publisher runs inline (publish_now) — one implementation,
         # byte-identical artifacts either way.
         self.push_async = push_async
+        from ..transport.retry import DEFAULT_PUBLISH_RETRY
         from .publish import DeltaPublisher
+        # cap the publish retry loop's TOTAL elapsed time at the push
+        # cadence: on a partitioned backend each try can block for its
+        # full transport timeout, and a retry loop outliving its own
+        # send interval just queues stale supersede work behind the wedge
+        publish_retry = DEFAULT_PUBLISH_RETRY
+        if 0 < send_interval < (publish_retry.max_elapsed or float("inf")):
+            publish_retry = dataclasses.replace(publish_retry,
+                                                max_elapsed=send_interval)
         self._publisher = DeltaPublisher(
             transport, miner_id, report=self.report, nan_guard=nan_guard,
-            queue_depth=push_queue_depth, sleep=self.clock.sleep)
+            queue_depth=push_queue_depth, sleep=self.clock.sleep,
+            publish_retry=publish_retry)
         self._push_program_cache = None
         # device-resident copy of the newest step's loss; fetched to
         # report.last_loss only at log boundaries and loop exit (a per-step
@@ -1139,11 +1149,24 @@ class MinerLoop:
                     self.report.steps)
         # the published base may have moved while we were down — resuming
         # against a superseded revision would push deltas the validator
-        # applies to the wrong base
-        if self.transport.base_revision() not in (None, self._base_revision):
-            logger.info("miner %s: base moved while preempted, pulling",
-                        self.miner_id)
-            self._check_pull()
+        # applies to the wrong base. The probe must not be able to crash
+        # the resume: a preemption restart is exactly when the backend may
+        # still be partitioned (the very outage that killed us), and under
+        # supervise.sh a raise here burns the crash-loop budget against a
+        # fault the periodic pull retries through on its own cadence.
+        try:
+            if self.transport.base_revision() not in (None,
+                                                      self._base_revision):
+                logger.info("miner %s: base moved while preempted, pulling",
+                            self.miner_id)
+                self._check_pull()
+        except Exception:
+            obs.count("miner.resume_probe_errors")
+            logger.warning(
+                "miner %s: post-resume base probe failed (transport "
+                "unreachable?); training from the checkpoint — the "
+                "periodic base check will pull once the backend answers",
+                self.miner_id, exc_info=True)
         return True
 
     def _refetch_base(self, revision) -> Params | None:

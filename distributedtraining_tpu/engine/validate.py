@@ -58,13 +58,19 @@ class Validator:
                  pipeline_depth: int = 1,
                  ingest_workers: int = 4,
                  ingest_cache_mb: int = 2048,
-                 fleet=None):
+                 fleet=None,
+                 remediation=None):
         self.engine = engine
         # fleet health plane (engine/health.py FleetMonitor): heartbeats
         # polled per round, staging outcomes folded via the ingest
         # observer, per-miner scores recorded as the ledger's score
         # history, SLOs evaluated + ledger flushed at the round cadence
         self.fleet = fleet
+        # remediation layer (engine/remediate.py): quarantined miners are
+        # excluded from staging (scored 0, reason "quarantined"), their
+        # chain scores decay, and the effective cohort size steps down
+        # the compiled-bucket ladder when the healthy count drops
+        self.remediation = remediation
         self.transport = transport
         self.chain = chain
         self.eval_batches = eval_batches
@@ -189,7 +195,11 @@ class Validator:
     def _evaluator(self):
         if self._cohort_eval is None:
             from .batched_eval import BatchedCohortEvaluator
-            self._cohort_eval = BatchedCohortEvaluator(self.engine)
+            # with remediation attached, a shrunken cohort pads up to an
+            # already-compiled bucket instead of compiling the exact fit
+            # (the elastic-cohort anti-compile-storm rule)
+            self._cohort_eval = BatchedCohortEvaluator(
+                self.engine, prefer_compiled=self.remediation is not None)
         return self._cohort_eval
 
     def _eval_base(self) -> None:
@@ -294,7 +304,10 @@ class Validator:
         from .train import wire_in
         staged = self._ingest().stage(list(hotkeys),
                                       base_revision=self._base_revision,
-                                      multi=self._multi())
+                                      multi=self._multi(),
+                                      exclude=(self.remediation.is_excluded
+                                               if self.remediation is not None
+                                               else None))
         out = []
         for s in staged:
             if s.cid is not None:
@@ -334,7 +347,18 @@ class Validator:
         evaluator = self._evaluator()
         pipeline = self.pipeline_depth > 0 and not self._multi()
         results: list[MinerScore] = []
-        staged = stage_cohorts(hotkeys, self.cohort_size, self._stage_miner,
+        cohort = self.cohort_size
+        if self.remediation is not None:
+            # elastic cohort: quarantine can leave far fewer stageable
+            # miners than the configured cohort — step the group size down
+            # the ladder (preferring compiled buckets) so padded slots
+            # shrink without a fresh compile (engine/remediate.py)
+            healthy = len(self.remediation.filter_hotkeys(hotkeys))
+            cohort = self.remediation.cohort_size(
+                self.cohort_size, healthy,
+                compiled=evaluator.compiled_buckets())
+            obs.gauge("val.effective_cohort", float(cohort))
+        staged = stage_cohorts(hotkeys, cohort, self._stage_miner,
                                pipeline=pipeline,
                                depth=max(self.pipeline_depth, 1),
                                stage_many=self._stage_many)
@@ -400,10 +424,17 @@ class Validator:
         else:
             results = [self.score_miner(h) for h in others]
         scored = {s.hotkey: s.score for s in results}
+        if self.remediation is not None:
+            # quarantined miners' scores decay toward zero instead of the
+            # chain EMA holding their pre-breach weight (the "scores
+            # decayed" half of quarantine, engine/remediate.py)
+            scored = self.remediation.decay_scores(scored)
         if self.fleet is not None:
             try:
                 self.fleet.record_scores(scored)
-                self.fleet.evaluate_slos()
+                breaches = self.fleet.evaluate_slos()
+                if self.remediation is not None:
+                    self.remediation.observe_round(breaches)
                 self.fleet.flush(self.metrics, step=self._round)
             except Exception:
                 logger.exception("validator: fleet round-end failed")

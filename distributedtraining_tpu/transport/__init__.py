@@ -19,13 +19,15 @@ pickle.
 """
 
 from .base import Transport, Revision
+from .chaos import ChaosError, ChaosEvent, ChaosSpec, ChaosTransport
 from .memory import InMemoryTransport
 from .localfs import LocalFSTransport
 from .retry import RetryPolicy, call_with_retry
 
 __all__ = ["Transport", "Revision", "InMemoryTransport", "LocalFSTransport",
            "SignedTransport", "HFHubTransport", "RetryPolicy",
-           "call_with_retry"]
+           "call_with_retry", "ChaosTransport", "ChaosSpec", "ChaosEvent",
+           "ChaosError"]
 
 
 def __getattr__(name):

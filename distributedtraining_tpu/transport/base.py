@@ -40,6 +40,12 @@ META_MAX_BYTES = 4096
 
 HEARTBEAT_PREFIX = "__hb__"
 
+# Failover leases (engine/remediate.py) ride the same reserved-id channel:
+# a tiny JSON token naming the current publication holder and a
+# monotonically increasing epoch. One reserved id per contended role —
+# today only the averager's base publication is single-writer.
+LEASE_PREFIX = "__lease__"
+
 
 def heartbeat_id(role: str, node_id: str) -> str:
     """The reserved per-node artifact id heartbeats publish under.
@@ -51,6 +57,19 @@ def heartbeat_id(role: str, node_id: str) -> str:
 def is_heartbeat_id(artifact_id: str) -> bool:
     return isinstance(artifact_id, str) and \
         artifact_id.startswith(HEARTBEAT_PREFIX + ".")
+
+
+def lease_id(role: str = "averager") -> str:
+    """The reserved artifact id a role's publication lease lives under."""
+    return f"{LEASE_PREFIX}.{role}"
+
+
+def is_reserved_id(artifact_id: str) -> bool:
+    """True for any id in the reserved control-plane namespace (heartbeats,
+    leases) — delta consumers must never stage these as submissions."""
+    return isinstance(artifact_id, str) and (
+        artifact_id.startswith(HEARTBEAT_PREFIX + ".")
+        or artifact_id.startswith(LEASE_PREFIX + "."))
 
 
 def encode_delta_meta(meta: dict) -> bytes:

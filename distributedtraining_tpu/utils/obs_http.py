@@ -79,6 +79,10 @@ _FLEET_SERIES = (
     ("score", "fleet_score", "latest validator score"),
     ("mem_peak_bytes", "fleet_mem_peak_bytes",
      "node device-memory high-water mark"),
+    ("quarantined", "fleet_quarantined",
+     "1 while the node is quarantined out of the ingest set"),
+    ("probation", "fleet_probation",
+     "1 while the node is re-admitted on probation"),
 )
 
 

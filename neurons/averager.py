@@ -67,6 +67,13 @@ def main(argv=None) -> int:
     plane = build_health_plane(cfg, c, monitor=True,
                                anomaly=AnomalyMonitor(),
                                start_heartbeat=False)
+    # publication lease (engine/remediate.py): held and renewed whenever
+    # a remediating or standby-backed fleet runs, so base publication
+    # stays single-writer across an averager failover
+    lease = None
+    if cfg.remediate or cfg.standby:
+        from distributedtraining_tpu.engine.remediate import LeaseManager
+        lease = LeaseManager(c.transport, cfg.hotkey)
     loop = AveragerLoop(c.engine, c.transport, c.chain,
                         make_strategy(cfg, c.model),
                         val_batches=c.eval_batches(),
@@ -78,15 +85,42 @@ def main(argv=None) -> int:
                         publish_policy=cfg.publish_policy,
                         ingest_workers=cfg.ingest_workers,
                         ingest_cache_mb=cfg.ingest_cache_mb,
-                        fleet=plane.fleet)
+                        fleet=plane.fleet,
+                        remediation=plane.remediation,
+                        lease=lease)
     if plane.heartbeat is not None:
         plane.heartbeat.vitals = report_vitals(
             loop.report, base_revision=lambda: loop._base_revision)
         plane.heartbeat.start()
-    loop.bootstrap(params=c.initial_params)
     try:
-        merged = loop.run_periodic(interval=cfg.averaging_interval,
-                                   rounds=cfg.rounds)
+        if cfg.standby:
+            # passive failover replica: NO bootstrap (a standby must
+            # never publish a genesis base or steal the lease at boot) —
+            # it follows the primary and bootstraps at takeover
+            from distributedtraining_tpu.engine.remediate import (
+                StandbyAverager)
+            standby = StandbyAverager(
+                loop, lease,
+                deadline_s=(cfg.failover_deadline
+                            or 3 * cfg.averaging_interval),
+                poll_s=max(1.0, min(cfg.averaging_interval / 4, 30.0)))
+            merged = standby.run(interval=cfg.averaging_interval,
+                                 rounds=cfg.rounds)
+        else:
+            if lease is not None:
+                try:
+                    if not lease.acquire():
+                        logging.warning(
+                            "averager: lease held elsewhere at boot; "
+                            "rounds will merge but stand down at publish "
+                            "until the lease is reclaimed")
+                except Exception:
+                    logging.warning("averager: lease acquisition failed "
+                                    "at boot; will retry lazily",
+                                    exc_info=True)
+            loop.bootstrap(params=c.initial_params)
+            merged = loop.run_periodic(interval=cfg.averaging_interval,
+                                       rounds=cfg.rounds)
     except KeyboardInterrupt:
         merged = loop.report.rounds > 0
     finally:

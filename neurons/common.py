@@ -146,9 +146,12 @@ class Components:
 class HealthPlane:
     """The role's slice of the fleet health plane (engine/health.py):
     its own heartbeat publisher, optionally a FleetMonitor (validator/
-    averager), and optionally the Prometheus exporter (--obs-port)."""
+    averager), optionally the remediation engine acting on that
+    monitor's breaches (engine/remediate.py, ``--remediate``), and
+    optionally the Prometheus exporter (--obs-port)."""
     heartbeat: Any = None
     fleet: Any = None
+    remediation: Any = None
     exporter: Any = None
 
     def close(self) -> None:
@@ -184,11 +187,29 @@ def build_health_plane(cfg: RunConfig, c: Components, *,
         if monitor:
             plane.fleet = FleetMonitor(c.transport, metrics=c.metrics,
                                        anomaly=anomaly)
+            if cfg.remediate:
+                from distributedtraining_tpu.engine.remediate import (
+                    RemediationEngine, RemediationPolicy)
+                rules = tuple(r.strip()
+                              for r in cfg.quarantine_rules.split(",")
+                              if r.strip())
+                plane.remediation = RemediationEngine(
+                    plane.fleet, metrics=c.metrics,
+                    policy=RemediationPolicy(
+                        quarantine_rules=rules,
+                        probation_beats=cfg.probation_beats,
+                        probation_rounds=cfg.probation_rounds,
+                        score_decay=cfg.score_decay))
         plane.heartbeat = HeartbeatPublisher(
             c.transport, cfg.role, cfg.hotkey,
             interval=cfg.heartbeat_interval, vitals=vitals)
         if start_heartbeat:
             plane.heartbeat.start()
+    elif cfg.remediate and coordinator:
+        logger.warning(
+            "--remediate has no effect without --heartbeat-interval > 0: "
+            "remediation acts on SLO breaches, and breaches come from the "
+            "heartbeat-fed FleetMonitor")
     if cfg.obs_port:
         from distributedtraining_tpu.utils.obs_http import ObsHTTPExporter
         plane.exporter = ObsHTTPExporter(cfg.obs_port, fleet=plane.fleet,
@@ -351,6 +372,18 @@ def build(cfg: RunConfig) -> Components:
                 f"hotkey {cfg.hotkey} has a different registered "
                 f"pubkey; restore the original wallet file or use a "
                 f"new hotkey")
+    if cfg.chaos_spec:
+        # deterministic fault injection (transport/chaos.py): wraps the
+        # OUTERMOST transport layer so injected faults hit signed
+        # publishes and verified fetches exactly like network faults
+        # would. Soak/test machinery — the flag warns on every boot.
+        from distributedtraining_tpu.transport.chaos import (ChaosSpec,
+                                                             ChaosTransport)
+        logger.warning("CHAOS INJECTION ACTIVE for role %s: %s",
+                       cfg.role, cfg.chaos_spec)
+        transport = ChaosTransport(transport,
+                                   ChaosSpec.from_json(cfg.chaos_spec),
+                                   role=cfg.role)
     # only the coordinator process of a pod role may write to the outside
     # world (delta pushes, base publishes, weight sets)
     transport, chain = multihost.gate_io(transport, chain)

@@ -130,8 +130,30 @@ def main(argv=None) -> int:
         vitals=report_vitals(loop.report,
                              base_revision=lambda: loop._base_revision))
     loop.heartbeat = plane.heartbeat
+
+    def _bootstrap():
+        # bounded retry on TRANSPORT errors only: a preemption restart is
+        # exactly when the backend may still be partitioned (the outage
+        # that killed us), and an instant crash here burns supervise.sh's
+        # crash-loop budget against a fault a short backoff rides out.
+        # Programming errors re-raise immediately. bootstrap is
+        # idempotent (restore + fetch, no partial publishes), so a retry
+        # re-runs it whole.
+        import time as _time
+        for attempt in range(3):
+            try:
+                return loop.bootstrap(params=c.initial_params)
+            except OSError:
+                if attempt == 2:
+                    raise
+                delay = 2.0 * (attempt + 1)
+                logging.warning("miner bootstrap: transport unreachable "
+                                "(attempt %d/3); retrying in %.0fs",
+                                attempt + 1, delay, exc_info=True)
+                _time.sleep(delay)
+
     try:
-        loop.bootstrap(params=c.initial_params)
+        _bootstrap()
         report = loop.run(c.train_batches(), max_steps=cfg.max_steps)
         loop.flush()  # final delta + checkpoint so short runs still publish
     except KeyboardInterrupt:

@@ -79,6 +79,7 @@ def main(argv=None) -> int:
                                anomaly=AnomalyMonitor(),
                                start_heartbeat=False)
     validator.fleet = plane.fleet   # before the first round's lazy _ingest
+    validator.remediation = plane.remediation  # and the lazy evaluator
     if plane.heartbeat is not None:
         plane.heartbeat.vitals = Vitals(
             steps=lambda: validator._round,

@@ -39,7 +39,7 @@ import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "hotkey", "beats", "age_s", "step_rate", "loss_ema",
            "published", "accepted", "declined", "stale_rounds", "score",
-           "slo")
+           "quar", "slo")
 
 
 def build_report(paths: list[str]) -> dict:
@@ -47,6 +47,8 @@ def build_report(paths: list[str]) -> dict:
     nodes: dict[str, dict] = {}
     registry: dict[str, dict] = {}
     breaches: list[dict] = []
+    remediations: list[dict] = []
+    pruned: list[dict] = []
     heartbeats = 0
     for rec in records:
         hb = rec.get("heartbeat")
@@ -72,6 +74,19 @@ def build_report(paths: list[str]) -> dict:
                              ("slo_breach", "role", "hotkey", "detail",
                               "round", "ts")})
             continue
+        if isinstance(rec.get("remediation"), str):
+            # quarantine / readmission / failover actions
+            # (engine/remediate.py) — the what-was-DONE half of the
+            # breach records above
+            remediations.append({k: rec.get(k) for k in
+                                 ("remediation", "hotkey", "rule",
+                                  "round", "detail", "ts")})
+            continue
+        pr = rec.get("fleet_pruned")
+        if isinstance(pr, dict):
+            # the node's final ledger state before it left the registry
+            pruned.append(pr)
+            continue
         role = rec.get("obs_registry")
         if isinstance(role, str):
             registry[role] = {k: v for k, v in rec.items()
@@ -95,6 +110,8 @@ def build_report(paths: list[str]) -> dict:
         "heartbeats": heartbeats,
         "nodes": dict(sorted(nodes.items())),
         "breaches": breaches,
+        "remediations": remediations,
+        "pruned": pruned,
         "registry": registry,
         "registry_digest_majority": majority,
     }
@@ -104,6 +121,12 @@ def _cell(node: dict, col: str) -> str:
     if col == "age_s":
         v = node.get("last_seen_age_s")
         return "-" if v is None else f"{v:.1f}"
+    if col == "quar":
+        if node.get("quarantined"):
+            return "Q"
+        if node.get("probation"):
+            return "P"
+        return "-"
     if col == "slo":
         br = node.get("breaches") or []
         drift = ["registry_drift"] if node.get("registry_drift") else []
@@ -133,6 +156,13 @@ def format_table(rep: dict) -> str:
     for b in rep["breaches"]:
         lines.append(f"  breach: {b['slo_breach']} on "
                      f"{b.get('role')}/{b.get('hotkey')} — {b.get('detail')}")
+    for r in rep.get("remediations", []):
+        lines.append(f"  remediation: {r['remediation']} {r.get('hotkey')} "
+                     f"({r.get('rule')}) round {r.get('round')} "
+                     f"{r.get('detail') or ''}".rstrip())
+    for pr in rep.get("pruned", []):
+        lines.append(f"  pruned: {pr.get('role')}/{pr.get('hotkey')} "
+                     f"(left the registry after {pr.get('beats')} beats)")
     reg = rep.get("registry") or {}
     interesting = ("miner.step_ms.p50", "compile.ms.count", "compile.ms.p95",
                    "ingest.cache_hits", "ingest.cache_misses",

@@ -65,7 +65,8 @@ def _du(path: str) -> int:
 def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
         dataset: str = "files:/usr/share/doc/*/copyright",
         tokenizer: str = "byte",
-        record: str | None = None) -> dict:
+        record: str | None = None,
+        chaos_spec: str | None = None) -> dict:
     os.makedirs(work_dir, exist_ok=True)
     logs = {r: os.path.join(work_dir, f"{r}.log")
             for r in ("miner0", "miner1", "validator", "averager")}
@@ -110,9 +111,19 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
               "--metrics-rotate-mb", "256",
               "--seq-len", "32", "--eval-seq-len", "64"]
 
+    # chaos injection (transport/chaos.py): MINER-side faults only — the
+    # soak's merge/compounding criteria stay meaningful while the fleet
+    # absorbs flaky publishes (retry deadlines, supersede, heartbeat
+    # failure counters all get exercised under real concurrency)
+    chaos = (["--chaos-spec", chaos_spec] if chaos_spec else [])
+    # remediation (engine/remediate.py): the monitor roles run the full
+    # breach -> quarantine/probation loop live; a healthy soak emits no
+    # actions, a chaotic one shows them in the fleet ledger harvest
+    remediate = ["--remediate"]
+
     def miner(i: int):
         return _spawn(
-            "miner", *common, "--hotkey", f"hotkey_{i}",
+            "miner", *common, *chaos, "--hotkey", f"hotkey_{i}",
             "--send-interval", "30", "--check-update-interval", "15",
             "--checkpoint-interval", "60", "--log-every", "50",
             # a gentle LR stretches the descent across MANY merge windows
@@ -138,7 +149,7 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
     procs = {"miner0": miner(0), "miner1": miner(1)}
     time.sleep(20)  # let a genesis base + first deltas appear
     procs["validator"] = _spawn(
-        "validator", *common, "--hotkey", "hotkey_91",
+        "validator", *common, *remediate, "--hotkey", "hotkey_91",
         "--validation-interval", "120",
         "--metrics-path", os.path.join(work_dir, "validator_metrics.jsonl"),
         log=logs["validator"])
@@ -148,7 +159,7 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
     # — at 45 s on a contended host the transient dominated and the
     # fleet hovered just above the base forever (first r05 soak)
     procs["averager"] = _spawn(
-        "averager", *common, "--hotkey", "hotkey_99",
+        "averager", *common, *remediate, "--hotkey", "hotkey_99",
         "--averaging-interval", "90", "--strategy", "weighted",
         "--metrics-path", os.path.join(work_dir, "averager_metrics.jsonl"),
         log=logs["averager"])
@@ -227,10 +238,12 @@ def run(work_dir: str, *, minutes: float = 120.0, model: str = "mini",
         fleet = {
             "nodes": {k: {f: n.get(f) for f in
                           ("beats", "published", "accepted", "declined",
-                           "stale_rounds", "breaches")}
+                           "stale_rounds", "breaches", "quarantined",
+                           "probation")}
                       for k, n in rep["nodes"].items()},
             "heartbeats": rep["heartbeats"],
             "breaches": rep["breaches"],
+            "remediations": rep.get("remediations", []),
         }
     except Exception as e:
         fleet = {"error": repr(e)}
@@ -326,9 +339,13 @@ def main() -> int:
     p.add_argument("--dataset", default="files:/usr/share/common-licenses/*")
     p.add_argument("--tokenizer", default="byte")
     p.add_argument("--record", default=None)
+    p.add_argument("--chaos-spec", default=None,
+                   help="JSON transport/chaos.py ChaosSpec injected into "
+                        "the MINER processes (publish-side faults; the "
+                        "monitor roles remediate through them)")
     a = p.parse_args()
     run(a.work_dir, minutes=a.minutes, model=a.model, dataset=a.dataset,
-        tokenizer=a.tokenizer, record=a.record)
+        tokenizer=a.tokenizer, record=a.record, chaos_spec=a.chaos_spec)
     return 0
 
 

@@ -1,0 +1,720 @@
+"""Self-healing fleet (engine/remediate.py + transport/chaos.py).
+
+Covers: deterministic chaos injection (seeded fault sequences, partitions,
+per-role kill switches, op-indexed schedules), the retry loop's
+total-elapsed deadline, ledger pruning for deregistered hotkeys, the
+quarantine/probation state machine against a live FleetMonitor, elastic
+cohort sizing over the compiled-bucket ladder, the publication lease
+protocol, miner preemption-resume hardening over localfs, and the
+acceptance round: a localfs fleet where one miner is killed mid-round and
+the averager mid-run under ChaosTransport — rounds keep completing, the
+killed miner is quarantined in the ledger and re-admitted after clean
+heartbeats, and exactly ONE averager publishes per round with a
+monotonically increasing lease epoch across the standby failover.
+"""
+
+import math
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.engine import TrainEngine
+from distributedtraining_tpu.engine.average import (AveragerLoop,
+                                                    WeightedAverage)
+from distributedtraining_tpu.engine.batched_eval import (
+    BatchedCohortEvaluator)
+from distributedtraining_tpu.engine.health import (FleetMonitor, SLORule,
+                                                   build_heartbeat)
+from distributedtraining_tpu.engine.remediate import (LeaseManager,
+                                                      RemediationEngine,
+                                                      RemediationPolicy,
+                                                      StandbyAverager,
+                                                      elastic_cohort,
+                                                      parse_lease)
+from distributedtraining_tpu.engine.scheduler import FakeClock
+from distributedtraining_tpu.engine.train import MinerLoop
+from distributedtraining_tpu.engine.validate import Validator
+from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.transport import (InMemoryTransport,
+                                               LocalFSTransport)
+from distributedtraining_tpu.transport.base import heartbeat_id, lease_id
+from distributedtraining_tpu.transport.chaos import (ChaosError, ChaosEvent,
+                                                     ChaosSpec,
+                                                     ChaosTransport)
+from distributedtraining_tpu.transport.retry import (RetryPolicy,
+                                                     call_with_retry)
+from distributedtraining_tpu.utils import obs
+from distributedtraining_tpu.utils.metrics import InMemorySink
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import fleet_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# ChaosTransport
+# ---------------------------------------------------------------------------
+
+def _fault_sequence(transport, n=12):
+    out = []
+    for _ in range(n):
+        try:
+            transport.delta_revision("m0")
+            out.append(0)
+        except ChaosError:
+            out.append(1)
+    return out
+
+
+def test_chaos_error_rates_are_seed_deterministic():
+    spec = ChaosSpec(fetch_error_rate=0.4, seed=11)
+    a = _fault_sequence(ChaosTransport(InMemoryTransport(), spec))
+    b = _fault_sequence(ChaosTransport(InMemoryTransport(), spec))
+    assert a == b and 0 < sum(a) < len(a)
+    # a different seed produces a different (still deterministic) sequence
+    c = _fault_sequence(ChaosTransport(
+        InMemoryTransport(), ChaosSpec(fetch_error_rate=0.4, seed=12)))
+    assert c != a
+
+
+def test_chaos_partition_and_kill_switch():
+    t = ChaosTransport(InMemoryTransport(), role="miner")
+    t.inner.publish_raw("m0", b"x")
+    assert t.delta_revision("m0") is not None
+    t.partition("m0")
+    with pytest.raises(ChaosError):
+        t.fetch_delta_bytes("m0")
+    t.heal("m0")
+    assert t.fetch_delta_bytes("m0") == b"x"
+    t.kill_role("miner")
+    with pytest.raises(ChaosError):
+        t.publish_raw("m0", b"y")
+    with pytest.raises(ChaosError):
+        t.base_revision()
+    t.revive_role("miner")
+    assert t.publish_raw("m0", b"y") is not None
+    # a kill for a DIFFERENT role leaves this transport alone
+    t.kill_role("averager")
+    assert t.delta_revision("m0") is not None
+    assert t.faults == 3
+
+
+def test_chaos_schedule_fires_at_op_index():
+    t = ChaosTransport(
+        InMemoryTransport(), role="miner",
+        schedule=[ChaosEvent(at_op=3, action="kill_role", target="miner"),
+                  ChaosEvent(at_op=5, action="revive_role",
+                             target="miner")])
+    t.inner.publish_raw("m0", b"x")
+    seq = _fault_sequence(t, 6)
+    # ops 1-2 pass, 3-4 dead, 5+ revived — deterministic however the
+    # surrounding test machinery paces its calls
+    assert seq == [0, 0, 1, 1, 0, 0]
+
+
+def test_chaos_spec_from_json_validates():
+    spec = ChaosSpec.from_json(
+        '{"fetch_error_rate": 0.25, "partitioned": ["hk0"], "seed": 2}')
+    assert spec.fetch_error_rate == 0.25 and spec.partitioned == ("hk0",)
+    with pytest.raises(ValueError):
+        ChaosSpec.from_json('{"fetch_errr_rate": 0.25}')   # typo'd key
+    with pytest.raises(ValueError):
+        ChaosSpec.from_json('{"publish_error_rate": 1.5}')
+    with pytest.raises(ValueError):
+        ChaosSpec.from_json('[1, 2]')
+
+
+def test_chaos_latency_uses_injected_sleep():
+    slept = []
+    t = ChaosTransport(InMemoryTransport(), ChaosSpec(latency_s=0.5),
+                       sleep=slept.append)
+    t.inner.publish_raw("m0", b"x")
+    t.delta_revision("m0")
+    assert slept == [0.5]
+
+
+# ---------------------------------------------------------------------------
+# Retry deadline (satellite)
+# ---------------------------------------------------------------------------
+
+def test_retry_max_elapsed_abandons_remaining_attempts():
+    obs.configure(InMemorySink(), role="t")
+    clock = FakeClock(0.0)
+    calls = []
+
+    def fail():
+        calls.append(1)
+        clock.advance(4.0)          # each try "blocks" 4 s (partition-ish)
+        raise OSError("partitioned")
+
+    policy = RetryPolicy(attempts=10, base_delay=1.0, max_delay=1.0,
+                         jitter=0.0, max_elapsed=10.0)
+    with pytest.raises(OSError):
+        call_with_retry(fail, policy=policy, sleep=clock.sleep,
+                        monotonic=clock.now, describe="probe")
+    # tries at t=4, 9, 14 (4 s call + 1 s backoff each): after the third
+    # try the next backoff would cross the 10 s deadline -> abandoned
+    # with 7 of the 10 attempts unspent
+    assert len(calls) == 3
+    reg = obs.registry()
+    assert reg.counter("transport.retry_deadline").value == 1
+    assert reg.counter("transport.retry.exhausted").value == 0
+
+
+def test_retry_without_deadline_spends_full_budget():
+    clock = FakeClock(0.0)
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise OSError("nope")
+
+    with pytest.raises(OSError):
+        call_with_retry(fail, policy=RetryPolicy(attempts=4, base_delay=0.1,
+                                                 jitter=0.0),
+                        sleep=clock.sleep, monotonic=clock.now)
+    assert len(calls) == 4
+
+
+def test_retry_policy_validates_max_elapsed():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_elapsed=0.0)
+    RetryPolicy(max_elapsed=None)   # explicit None stays legal
+
+
+# ---------------------------------------------------------------------------
+# Ledger pruning (satellite)
+# ---------------------------------------------------------------------------
+
+def _beat(transport, role, hotkey, seq, **fields):
+    transport.publish_delta_meta(
+        heartbeat_id(role, hotkey),
+        build_heartbeat(role, hotkey, seq, now=float(seq), **fields))
+
+
+def test_fleet_prune_on_registry_departure():
+    obs.configure(InMemorySink(), role="t")
+    sink = InMemorySink()
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, metrics=sink)
+    try:
+        _beat(t, "miner", "a", 1, loss_ema=9.0)
+        _beat(t, "miner", "b", 1, loss_ema=2.0)
+        fm.poll(["a", "b"])
+        assert set(fm.nodes) == {("miner", "a"), ("miner", "b")}
+        # "a" leaves the chain registry: pruned, tagged into the sink,
+        # counted — and its loss_ema stops skewing the fleet median
+        fm.poll(["b"])
+        assert set(fm.nodes) == {("miner", "b")}
+        assert obs.registry().counter("fleet.pruned").value == 1
+        tagged = [r for r in sink.records if "fleet_pruned" in r]
+        assert len(tagged) == 1
+        assert tagged[0]["fleet_pruned"]["hotkey"] == "a"
+        assert tagged[0]["fleet_pruned"]["loss_ema"] == 9.0
+    finally:
+        fm.close()
+
+
+def test_fleet_prune_clears_fired_breaches():
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule("stale_node", "stale", threshold=1)])
+    try:
+        _beat(t, "miner", "a", 1)
+        _beat(t, "miner", "b", 1)
+        fm.poll(["a", "b"])
+        for _ in range(3):          # both go silent
+            fm.poll(["a", "b"])
+        assert {b["hotkey"] for b in fm.evaluate_slos()} == {"a", "b"}
+        fm.poll(["b"])              # "a" deregisters
+        assert all(key != ("miner", "a") for key in fm.nodes)
+        assert all(f[1] != "a" for f in fm._fired)
+    finally:
+        fm.close()
+
+
+# ---------------------------------------------------------------------------
+# Quarantine state machine
+# ---------------------------------------------------------------------------
+
+def test_quarantine_probation_readmission_and_relapse():
+    sink = InMemorySink()
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule("stale_node", "stale", threshold=1)],
+                      metrics=sink)
+    rem = RemediationEngine(
+        fm, metrics=sink,
+        policy=RemediationPolicy(quarantine_rules=("stale_node",),
+                                 probation_beats=2, probation_rounds=3))
+    try:
+        seq = 1
+        _beat(t, "miner", "hk", seq)
+        fm.poll(["hk"])
+        rem.observe_round(fm.evaluate_slos())
+        assert not rem.is_excluded("hk")
+        for _ in range(3):          # hk goes silent -> stale breach
+            fm.poll(["hk"])
+            rem.observe_round(fm.evaluate_slos())
+        assert rem.is_excluded("hk")
+        assert fm.nodes[("miner", "hk")].quarantined
+        assert rem.filter_hotkeys(["hk", "other"]) == ["other"]
+        assert rem.decay_scores({"hk": 0.8, "other": 0.4}) == {
+            "hk": 0.8 * 0.25, "other": 0.4}
+        # silent rounds do NOT count toward re-admission
+        fm.poll(["hk"])
+        rem.observe_round(fm.evaluate_slos())
+        assert rem.is_excluded("hk")
+        # two clean fresh beats -> probation (re-admitted, watched)
+        for _ in range(2):
+            seq += 1
+            _beat(t, "miner", "hk", seq)
+            fm.poll(["hk"])
+            rem.observe_round(fm.evaluate_slos())
+        assert not rem.is_excluded("hk")
+        node = fm.nodes[("miner", "hk")]
+        assert not node.quarantined and node.probation
+        assert rem.readmissions == 1
+        # relapse DURING probation: the re-armed rule fires and
+        # re-quarantines immediately
+        for _ in range(3):
+            fm.poll(["hk"])
+            rem.observe_round(fm.evaluate_slos())
+        assert rem.is_excluded("hk")
+        acts = [r["remediation"] for r in sink.records
+                if "remediation" in r]
+        assert acts == ["quarantined", "readmitted", "requarantined"]
+    finally:
+        fm.close()
+
+
+def test_probation_expires_to_healthy():
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule("stale_node", "stale", threshold=1)])
+    rem = RemediationEngine(
+        fm, policy=RemediationPolicy(quarantine_rules=("stale_node",),
+                                     probation_beats=1, probation_rounds=1))
+    try:
+        seq = 1
+        _beat(t, "miner", "hk", seq)
+        fm.poll(["hk"])
+        for _ in range(3):
+            fm.poll(["hk"])
+            rem.observe_round(fm.evaluate_slos())
+        assert rem.is_excluded("hk")
+        for _ in range(3):          # beats keep coming, rounds pass
+            seq += 1
+            _beat(t, "miner", "hk", seq)
+            fm.poll(["hk"])
+            rem.observe_round(fm.evaluate_slos())
+        assert "hk" not in rem.cases          # healthy again
+        node = fm.nodes[("miner", "hk")]
+        assert not node.quarantined and not node.probation
+    finally:
+        fm.close()
+
+
+def test_quarantine_only_configured_rules():
+    t = InMemoryTransport()
+    fm = FleetMonitor(t, rules=[SLORule("stale_node", "stale", threshold=1)])
+    rem = RemediationEngine(
+        fm, policy=RemediationPolicy(quarantine_rules=("loss_divergence",)))
+    try:
+        _beat(t, "miner", "hk", 1)
+        fm.poll(["hk"])
+        for _ in range(3):
+            fm.poll(["hk"])
+            rem.observe_round(fm.evaluate_slos())
+        # the stale breach fired but is not a quarantining rule here
+        assert not rem.is_excluded("hk")
+        assert fm.nodes[("miner", "hk")].breaches == ["stale_node"]
+    finally:
+        fm.close()
+
+
+# ---------------------------------------------------------------------------
+# Elastic cohorts
+# ---------------------------------------------------------------------------
+
+def test_elastic_cohort_ladder_and_compiled_preference():
+    assert elastic_cohort(8, 8) == 8            # healthy: unchanged
+    assert elastic_cohort(8, 12) == 8
+    assert elastic_cohort(1, 0) == 1
+    assert elastic_cohort(8, 3) == 4            # ladder bucket covering 3
+    assert elastic_cohort(8, 3, compiled=[8]) == 8   # reuse the compiled one
+    assert elastic_cohort(16, 5, compiled=[8, 16]) == 8
+    assert elastic_cohort(16, 5, compiled=[2]) == 8  # too small to cover 5
+    assert elastic_cohort(8, 0) == 1
+
+
+def test_cohort_evaluator_prefers_compiled_bucket():
+    obs.configure(InMemorySink(), role="t")
+    model, cfg = gpt2.make_model("tiny")
+    engine = TrainEngine(model, seq_len=8)
+    base = engine.place_params(model.init_params(jax.random.PRNGKey(0)))
+    zeros = jax.tree_util.tree_map(lambda x: np.zeros(x.shape, x.dtype),
+                                   jax.device_get(base))
+    batch = {"input_ids": np.zeros((2, 8), np.int32)}
+    ev = BatchedCohortEvaluator(engine, prefer_compiled=True)
+    ev.evaluate_cohort(base, [zeros] * 8, iter([batch]))   # compiles k=8
+    assert ev.compiled_buckets() == frozenset({8})
+    reg = obs.registry()
+    assert reg.counter("val.cohort_bucket_compiles").value == 1
+    # a shrunken fleet (3 candidates -> ladder bucket 4) pads UP to the
+    # compiled 8-bucket instead of compiling the 4-bucket
+    assert ev.bucket_for(3) == 8
+    ev.evaluate_cohort(base, [zeros] * 3, iter([batch]))
+    assert reg.counter("val.cohort_bucket_compiles").value == 1
+    assert ev.compiled_buckets() == frozenset({8})
+    # without the preference, the same shrink walks the ladder
+    ev2 = BatchedCohortEvaluator(engine)
+    ev2._buckets_seen.add(8)
+    assert ev2.bucket_for(3) == 4
+
+
+# ---------------------------------------------------------------------------
+# The lease protocol
+# ---------------------------------------------------------------------------
+
+def test_lease_acquire_renew_supersede():
+    t = InMemoryTransport()
+    a = LeaseManager(t, "avg0")
+    b = LeaseManager(t, "avg1")
+    assert not a.holds()
+    assert a.acquire() and a.epoch == 1
+    assert a.renew() is True                    # uncontested renewal
+    assert b.acquire() and b.epoch == 2         # successor epoch
+    assert a.renew() is False and not a.holds()  # superseded: stand down
+    assert b.renew() is True
+    b.stamp("rev-42")
+    cur = parse_lease(t.fetch_delta_meta(lease_id()))
+    assert cur["epoch"] == 2 and cur["holder"] == "avg1"
+    assert cur["base_revision"] == "rev-42"
+    # a re-acquisition by the old holder moves PAST the observed epoch
+    assert a.acquire() and a.epoch == 3
+
+
+def test_lease_renew_fail_safe_on_unreadable_token():
+    class Flaky(InMemoryTransport):
+        broken = False
+
+        def fetch_delta_meta(self, miner_id):
+            if self.broken:
+                raise OSError("partitioned")
+            return super().fetch_delta_meta(miner_id)
+
+    t = Flaky()
+    a = LeaseManager(t, "avg0")
+    assert a.acquire()
+    t.broken = True
+    # cannot confirm ownership -> must NOT publish this round
+    assert a.renew() is False
+    t.broken = False
+    assert a.renew() is True                    # still epoch-1 holder
+
+
+def test_parse_lease_rejects_junk():
+    assert parse_lease(None) is None
+    assert parse_lease({"epoch": 1}) is None
+    assert parse_lease({"lease": 1, "epoch": 0, "holder": "x"}) is None
+    assert parse_lease({"lease": 1, "epoch": 2, "holder": ""}) is None
+    got = parse_lease({"lease": 1, "epoch": 2, "holder": "h", "t": 5,
+                       "base_revision": 9})
+    assert got == {"lease": 1, "epoch": 2, "holder": "h", "t": 5.0}
+
+
+# ---------------------------------------------------------------------------
+# Miner preemption-resume hardening (satellite; localfs regression)
+# ---------------------------------------------------------------------------
+
+def _mini_batches(cfg, n=3):
+    rng = np.random.default_rng(0)
+    return iter([{"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (2, 16)), np.int32)}] * n)
+
+
+def test_miner_stale_checkpoint_falls_back_to_current_base(tmp_path):
+    from distributedtraining_tpu.checkpoint import CheckpointStore
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    model, cfg = gpt2.make_model("tiny")
+    transport = LocalFSTransport(str(tmp_path / "artifacts"))
+    engine = TrainEngine(model, seq_len=16)
+    # a published base the first miner run trains against
+    template = host_wire_template(engine)
+    base1 = jax.tree_util.tree_map(
+        lambda x: np.full(x.shape, 0.01, x.dtype), template)
+    transport.publish_base(base1)
+    with CheckpointStore(str(tmp_path / "ckpt")) as store:
+        loop = MinerLoop(TrainEngine(model, seq_len=16), transport, "hk",
+                         send_interval=1e9, check_update_interval=1e9,
+                         checkpoint_store=store, checkpoint_interval=1e9)
+        loop.bootstrap(jax.random.PRNGKey(0))
+        rev1 = loop._base_revision
+        assert rev1 is not None
+        loop.run(_mini_batches(cfg), max_steps=2)
+        loop._save_checkpoint()
+        assert store.latest_step() is not None
+
+    # while preempted: the checkpointed revision VANISHES (a new base
+    # replaces it — the averager moved on)
+    base2 = jax.tree_util.tree_map(
+        lambda x: np.full(x.shape, 0.02, x.dtype), template)
+    rev2 = transport.publish_base(base2)
+    assert rev2 != rev1
+
+    with CheckpointStore(str(tmp_path / "ckpt")) as store2:
+        loop2 = MinerLoop(TrainEngine(model, seq_len=16), transport, "hk",
+                          send_interval=1e9, check_update_interval=1e9,
+                          checkpoint_store=store2, checkpoint_interval=1e9)
+        # must not crash: the stale snapshot's base is gone, so bootstrap
+        # pulls the CURRENT base fresh
+        loop2.bootstrap(jax.random.PRNGKey(0))
+        assert loop2._base_revision == rev2
+        assert loop2.state is not None
+        # and it can keep training + pushing against the new base
+        loop2.run(_mini_batches(cfg), max_steps=1)
+        loop2._push_delta()
+        loop2._publisher.flush()
+        assert loop2.report.pushes == 1
+
+
+def test_miner_resume_survives_partitioned_base_probe(tmp_path):
+    from distributedtraining_tpu.checkpoint import CheckpointStore
+
+    model, cfg = gpt2.make_model("tiny")
+    transport = InMemoryTransport()     # NO base: genesis self-init, so the
+    #                                     base travels inside the snapshot
+    with CheckpointStore(str(tmp_path / "ckpt")) as store:
+        loop = MinerLoop(TrainEngine(model, seq_len=16), transport, "hk",
+                         send_interval=1e9, check_update_interval=1e9,
+                         checkpoint_store=store, checkpoint_interval=1e9)
+        loop.bootstrap(jax.random.PRNGKey(0))
+        loop.run(_mini_batches(cfg), max_steps=2)
+        loop._save_checkpoint()
+
+    class Partitioned(InMemoryTransport):
+        def base_revision(self):
+            raise OSError("backend unreachable")
+
+    with CheckpointStore(str(tmp_path / "ckpt")) as store2:
+        loop2 = MinerLoop(TrainEngine(model, seq_len=16), Partitioned(),
+                          "hk", send_interval=1e9, check_update_interval=1e9,
+                          checkpoint_store=store2, checkpoint_interval=1e9)
+        # the post-resume "did the base move" probe hits the partition;
+        # the resume must survive on the checkpoint instead of crashing
+        # (under supervise.sh a raise here burns the crash-loop budget)
+        loop2.bootstrap(jax.random.PRNGKey(0))
+        assert loop2.state is not None
+        assert loop2.report.steps == 2
+
+
+# ---------------------------------------------------------------------------
+# The acceptance round: chaos, quarantine, failover — one localfs fleet
+# ---------------------------------------------------------------------------
+
+def _batch(cfg, n=2, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (n, seq)), np.int32)}
+
+
+class _StubChain:
+    """A 4-node registry (LocalChain's fixed 100-hotkey metagraph would
+    make every partitioned round pay ~100 retry-fetch timeouts)."""
+
+    def __init__(self, hotkeys, my_hotkey):
+        self._hotkeys = list(hotkeys)
+        self.my_hotkey = my_hotkey
+
+    def sync(self):
+        from types import SimpleNamespace
+        return SimpleNamespace(hotkeys=list(self._hotkeys))
+
+
+def test_chaos_round_quarantine_and_averager_failover(tmp_path):
+    """Miner killed mid-round + averager killed mid-run, both under
+    ChaosTransport: rounds keep completing, the quarantine lands in the
+    ledger (with probation re-admission), and exactly one averager
+    publication per round carries a monotonically increasing epoch."""
+    model, cfg = gpt2.make_model("tiny")
+    art = str(tmp_path / "artifacts")
+    hotkeys = ["hotkey_0", "hotkey_1", "hotkey_2"]
+    sink = InMemorySink()
+    obs.configure(sink, role="averager")
+    clock = FakeClock(1000.0)
+    plain = LocalFSTransport(art)
+
+    def eval_batches():
+        yield _batch(cfg, seed=1)
+
+    # -- miners: synthetic deltas + heartbeats ------------------------------
+    from distributedtraining_tpu.engine.train import host_wire_template
+    engine = TrainEngine(model, seq_len=16)
+    template = host_wire_template(engine)
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    key = jax.random.PRNGKey(1)
+    for hk in hotkeys:
+        key, k = jax.random.split(key)
+        ks = jax.random.split(k, len(leaves))
+        plain.publish_delta(hk, jax.tree_util.tree_unflatten(
+            treedef, [0.01 * np.asarray(jax.random.normal(s, l.shape),
+                                        l.dtype)
+                      for s, l in zip(ks, leaves)]))
+    seqs = dict.fromkeys(hotkeys, 0)
+
+    def beat(hk):
+        seqs[hk] += 1
+        _beat(plain, "miner", hk, seqs[hk], steps=float(seqs[hk]))
+
+    # -- the primary averager: chaos transport + fleet + remediation + lease
+    chaos = ChaosTransport(LocalFSTransport(art), role="averager")
+    afm = FleetMonitor(chaos, metrics=sink, clock=clock,
+                       rules=[SLORule("stale_node", "stale", threshold=1)])
+    rem = RemediationEngine(
+        afm, metrics=sink,
+        policy=RemediationPolicy(quarantine_rules=("stale_node",),
+                                 probation_beats=2, probation_rounds=1))
+    lease = LeaseManager(chaos, "hotkey_99", clock=clock)
+    avg = AveragerLoop(engine, chaos,
+                       _StubChain(hotkeys + ["hotkey_99"], "hotkey_99"),
+                       WeightedAverage(uniform=True),
+                       val_batches=eval_batches, metrics=sink, clock=clock,
+                       publish_policy="always", fleet=afm,
+                       remediation=rem, lease=lease)
+    assert lease.acquire() and lease.epoch == 1
+    avg.bootstrap(rng=jax.random.PRNGKey(0))
+
+    epochs = []                     # (epoch, base_revision) per publish
+
+    def record_publish():
+        cur = parse_lease(plain.fetch_delta_meta(lease_id()))
+        assert cur is not None
+        assert cur["base_revision"] == plain.base_revision(), \
+            "the publication must carry the epoch that published it"
+        epochs.append(cur["epoch"])
+
+    def live_round(*miners):
+        for hk in miners:
+            beat(hk)
+        prev = plain.base_revision()
+        assert avg.run_round() is True
+        assert plain.base_revision() != prev, "round did not publish"
+        record_publish()
+
+    # round 1: everyone healthy, all three merge
+    live_round(*hotkeys)
+    assert avg.report.last_accepted == 3
+    assert math.isfinite(avg.report.last_loss)
+
+    # -- miner hotkey_2 is KILLED mid-round: no more beats, and its
+    # artifact partitions away (the averager sees fetch errors, not bytes)
+    chaos.partition("hotkey_2")
+    live_round("hotkey_0", "hotkey_1")          # r2: 1 silent round
+    live_round("hotkey_0", "hotkey_1")          # r3: stale breach fires
+    assert rem.is_excluded("hotkey_2")
+    led = afm.ledger()
+    assert led["miner/hotkey_2"]["quarantined"] == 1
+    assert led["miner/hotkey_2"]["breaches"] == ["stale_node"]
+
+    # steady state under quarantine: rounds keep merging the healthy two,
+    # the exclusion shows in the ledger, and NO fresh screen/compile work
+    # happens (everything rides the ingest cache + compiled programs)
+    reg = obs.registry()
+    fresh_before = reg.counter("screen.fresh_compiles").value
+    compile_before = reg.histogram("compile.ms").count
+    live_round("hotkey_0", "hotkey_1")          # r4
+    assert avg.report.last_accepted == 2
+    assert afm.ledger()["miner/hotkey_2"]["last_reason"] == "quarantined"
+    assert reg.counter("screen.fresh_compiles").value == fresh_before
+    assert reg.histogram("compile.ms").count == compile_before
+
+    # -- hotkey_2 revives: clean heartbeats re-admit it into probation,
+    # then it merges again
+    chaos.heal("hotkey_2")
+    live_round(*hotkeys)                        # r5: clean beat 1
+    assert rem.is_excluded("hotkey_2")
+    live_round(*hotkeys)                        # r6: clean beat 2 -> probation
+    assert not rem.is_excluded("hotkey_2")
+    assert afm.ledger()["miner/hotkey_2"]["probation"] == 1
+    accepted_before = afm.ledger()["miner/hotkey_2"]["accepted"]
+    live_round(*hotkeys)                        # r7: staged + merged again
+    assert avg.report.last_accepted == 3
+    assert afm.ledger()["miner/hotkey_2"]["accepted"] == accepted_before + 1
+    assert "hotkey_2" not in rem.cases          # probation expired: healthy
+
+    # every publish so far carried epoch 1
+    assert epochs == [1] * len(epochs) and len(epochs) == 7
+
+    # -- the averager is KILLED mid-run: its transport goes dark ------------
+    chaos.kill_role("averager")
+    for hk in hotkeys:
+        beat(hk)
+    prev_rev = plain.base_revision()
+    assert avg.run_round() is False             # survives; nothing merges
+    assert plain.base_revision() == prev_rev    # and nothing publishes
+
+    # -- the standby detects the silence and takes over ---------------------
+    lease2 = LeaseManager(plain, "hotkey_98", clock=clock)
+    loop2 = AveragerLoop(TrainEngine(model, seq_len=16),
+                         LocalFSTransport(art),
+                         _StubChain(hotkeys + ["hotkey_98"], "hotkey_98"),
+                         WeightedAverage(uniform=True),
+                         val_batches=eval_batches, clock=clock,
+                         publish_policy="always", lease=lease2)
+    standby = StandbyAverager(loop2, lease2, deadline_s=100.0, poll_s=10.0,
+                              clock=clock)
+    assert standby.poll_once() == "following"   # baseline signature
+    clock.advance(150.0)                        # primary silent past deadline
+    assert standby.poll_once() == "takeover"
+    assert standby.active and lease2.epoch == 2  # the successor epoch
+
+    prev_rev = plain.base_revision()
+    assert loop2.run_round() is True            # the standby's first round
+    assert plain.base_revision() != prev_rev
+    cur = parse_lease(plain.fetch_delta_meta(lease_id()))
+    assert cur["epoch"] == 2 and cur["holder"] == "hotkey_98"
+    assert cur["base_revision"] == plain.base_revision(), \
+        "the standby's first publication carries the successor epoch"
+    epochs.append(cur["epoch"])
+
+    # -- the old primary comes back: it must STAND DOWN, not dual-publish ---
+    chaos.revive_role("averager")
+    for hk in hotkeys:
+        beat(hk)
+    skipped_before = avg.report.skipped_publishes
+    standby_rev = plain.base_revision()
+    assert avg.run_round() is True              # merges, refuses to publish
+    assert avg.report.skipped_publishes == skipped_before + 1
+    assert plain.base_revision() == standby_rev
+    assert not lease.holds()
+
+    # monotone epoch sequence across the whole run, exactly one writer
+    assert epochs == sorted(epochs) and epochs[-1] == 2
+    assert epochs.count(2) == 1 and epochs.count(1) == 7
+
+    # the remediation + breach story is joinable offline too
+    import json
+    jsonl = tmp_path / "averager.jsonl"
+    with open(jsonl, "w") as f:
+        for r in sink.records:
+            try:
+                f.write(json.dumps(r, default=float) + "\n")
+            except (TypeError, ValueError):
+                pass
+    rep = fleet_report.build_report([str(jsonl)])
+    acts = [r["remediation"] for r in rep["remediations"]]
+    assert acts[:2] == ["quarantined", "readmitted"]
+    table = fleet_report.format_table(rep)
+    assert "stale_node" in table
+
+    avg.close()
+    loop2.close()

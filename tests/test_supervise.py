@@ -76,6 +76,54 @@ def test_crash_detected_promptly_not_after_update_poll():
     assert time.time() - t0 < 30, "crash detection waited on the update poll"
 
 
+def test_chaos_killed_role_relaunches_with_args_intact(tmp_path):
+    """A role SIGKILLed out from under the supervisor (the chaos-kill
+    scenario, transport/chaos.py's process-level twin) must be relaunched
+    promptly WITH ITS ORIGINAL ARGS — a supervisor that drops or reorders
+    role flags on restart silently changes the node's config mid-soak."""
+    import subprocess as sp
+    import tempfile
+
+    marker = "31259"
+    helper = tmp_path / "role.sh"
+    # exec makes the helper BECOME the sleep, so the kill hits the role
+    # process itself (and the supervisor's TERM trap cleans it up at exit)
+    helper.write_text(f'#!/bin/bash\necho "ARGS:$@"\nexec sleep {marker}\n')
+    helper.chmod(0o755)
+    args = ["--hotkey", "hk0", "--seq-len", "32"]
+    with tempfile.NamedTemporaryFile("r") as logf:
+        proc = sp.Popen(
+            ["bash", SCRIPT, "miner", *args],
+            env=_env(SUPERVISE_CMD=str(helper), MAX_RESTARTS="5",
+                     MIN_UPTIME_S="1"),
+            stdout=open(logf.name, "w"), stderr=sp.STDOUT, text=True)
+        try:
+            deadline = time.time() + 45
+            killed = False
+            out = ""
+            while time.time() < deadline:
+                out = logf.read()
+                logf.seek(0)
+                if not killed:
+                    r = sp.run(["pgrep", "-f", f"sleep {marker}"],
+                               capture_output=True, text=True)
+                    if r.stdout.strip():
+                        sp.run(["pkill", "-9", "-f", f"sleep {marker}"])
+                        killed = True
+                elif out.count("ARGS:") >= 2:
+                    break
+                assert proc.poll() is None, out
+                time.sleep(0.3)
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    lines = [ln for ln in out.splitlines() if ln.startswith("ARGS:")]
+    assert len(lines) >= 2, out
+    assert lines[0] == "ARGS:--hotkey hk0 --seq-len 32"
+    assert len(set(lines)) == 1, lines        # every relaunch: args intact
+    assert "giving up" not in out
+
+
 def test_term_kills_role_child_too():
     """Supervisor TERM must take the role down with it — an orphaned child
     would hold the TPU/hotkey against the next service start."""
