@@ -451,6 +451,162 @@ def _time_gather_deltas(*, n_miners: int = 4, latency_s: float = 0.05,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _time_wire_v2(*, trials: int = 2) -> dict:
+    """Delta wire A/B over localfs (round-12 tentpole): the dense v1
+    msgpack push+gather vs the v2 sparse+quantized shard wire (density
+    1/64, int8) on the IDENTICAL delta tree.
+
+      wire_dense_bytes_per_push   bytes one v1 push lands on the
+                                  transport (full f32 msgpack)
+      wire_v2_bytes_per_push      bytes a COLD v2 push lands (all
+                                  shards + manifest)
+      wire_v2_warm_push_bytes     bytes a warm push lands when ONE
+                                  layer changed (changed shard +
+                                  manifest only — publisher dedupe)
+      wire_bytes_ratio            dense / v2 cold (acceptance: >= 10)
+      wire_encode_ms/decode_ms    pack+shard / assemble+densify host
+                                  cost per push
+      wire_warm_fetch_bytes       ingest bytes for the warm 1-layer
+                                  round (manifest + 1 shard)
+      wire_unchanged_layer_bytes  ingest bytes for unchanged layers in
+                                  that round (acceptance: exactly 0 —
+                                  shard-granular dedupe)
+      wire_warm_shard_hit_rate    shard-cache hit fraction that round
+      wire_parity                 staged v2 delta == reference
+                                  sparsify+quantize decode, dense
+                                  staging unchanged
+
+    CPU-measurable: the contrast is artifact BYTES and host codec work —
+    transport-independent quantities that exist identically on the Hub
+    (where each byte additionally pays LFS round trips)."""
+    import shutil
+    import tempfile
+
+    from distributedtraining_tpu import delta as delta_lib
+    from distributedtraining_tpu import serialization as ser
+    from distributedtraining_tpu.engine.ingest import DeltaIngestor
+    from distributedtraining_tpu.engine.publish import DeltaPublisher
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import LocalFSTransport
+
+    model, _ = gpt2.make_model("tiny")
+    base = jax.device_get(model.init_params(jax.random.PRNGKey(0)))
+    template = jax.tree_util.tree_map(
+        lambda x: np.zeros(x.shape, np.float32), base)
+    rs = np.random.RandomState(0)
+    delta = jax.tree_util.tree_map(
+        lambda x: (rs.randn(*np.shape(x)) * 0.01).astype(np.float32),
+        template)
+
+    class Report:
+        pushes = pushes_failed = pushes_superseded = 0
+
+    tmp = tempfile.mkdtemp(prefix="wire_bench_")
+    published: list[tuple[str, int]] = []
+    fetched: list[tuple[str, int]] = []
+
+    class CountFS(LocalFSTransport):
+        def publish_raw(self, mid, data):
+            published.append((mid, len(data)))
+            return super().publish_raw(mid, data)
+
+        def fetch_delta_bytes(self, mid):
+            d = super().fetch_delta_bytes(mid)
+            if d is not None:
+                fetched.append((mid, len(d)))
+            return d
+
+    try:
+        transport = CountFS(tmp)
+        # -- dense v1 push (file size IS the artifact bytes) ------------
+        pub_dense = DeltaPublisher(transport, "dense0", report=Report())
+        assert pub_dense.publish_now(delta, None, "r1")
+        dense_bytes = os.path.getsize(
+            os.path.join(tmp, "deltas", "dense0.msgpack"))
+
+        # -- v2 cold push ----------------------------------------------
+        pub = DeltaPublisher(
+            transport, "m0", report=Report(),
+            wire_spec={"format": 2, "density": 1 / 64, "quant": "int8"})
+        # warm the pack programs first (one trace+compile per leaf shape;
+        # a miner pays that once per run, not per push) so encode_ms is
+        # the steady-state number
+        pack = jax.jit(lambda d: delta_lib.pack_delta_v2(d, density=1 / 64))
+        jax.block_until_ready(pack(delta))
+        enc_ms = []
+        t0 = time.perf_counter()
+        packed, _res = jax.device_get(pack(delta))
+        enc_ms.append((time.perf_counter() - t0) * 1e3)
+        published.clear()
+        assert pub.publish_now(packed, None, "r1")
+        v2_cold_bytes = sum(n for _, n in published)
+
+        # -- cold gather + parity --------------------------------------
+        ing = DeltaIngestor(transport, template, workers=2,
+                            max_delta_abs=1e3)
+        try:
+            staged = {s.hotkey: s for s in ing.stage(["dense0", "m0"])}
+            ref = delta_lib.densify_packed_v2(packed, template)
+            parity = all(
+                np.array_equal(a, b) for a, b in
+                zip(jax.tree_util.tree_leaves(staged["m0"].delta),
+                    jax.tree_util.tree_leaves(ref))) and all(
+                np.allclose(a, b) for a, b in
+                zip(jax.tree_util.tree_leaves(staged["dense0"].delta),
+                    jax.tree_util.tree_leaves(delta)))
+
+            # -- warm rounds: ONE layer changes per trial ---------------
+            warm_push, warm_fetch, unchanged_bytes, hits = [], [], [], []
+            dec_ms = []
+            d2 = delta
+            for i in range(trials):
+                d2 = dict(d2)
+                # perturb one LARGE tensor (wte) so exactly one sharded
+                # layer changes
+                d2["wte"] = (d2["wte"] + 0.001 * (i + 1)).astype(np.float32)
+                # the SAME jitted program as the cold push: shard bytes
+                # are reproducible within one compiled encoder (how a
+                # real miner runs), which is what makes unchanged layers
+                # hash-identical push over push
+                p2, _ = jax.device_get(pack(d2))
+                published.clear()
+                assert pub.publish_now(p2, None, "r1")
+                warm_push.append(sum(n for _, n in published))
+                fetched.clear()
+                t0 = time.perf_counter()
+                s = ing.stage(["m0"])[0]
+                dec_ms.append((time.perf_counter() - t0) * 1e3)
+                assert s.ok
+                warm_fetch.append(sum(n for _, n in fetched))
+                unchanged_bytes.append(sum(
+                    n for mid, n in fetched
+                    if mid.startswith("__shard__.") and "wte" not in mid))
+                n_layers = len(delta_lib.packed_layer_entries(p2))
+                n_fetched_shards = sum(
+                    1 for mid, _ in fetched if mid.startswith("__shard__."))
+                hits.append(1.0 - n_fetched_shards / n_layers)
+        finally:
+            ing.close()
+            pub.close()
+            pub_dense.close()
+
+        return {
+            "wire_dense_bytes_per_push": int(dense_bytes),
+            "wire_v2_bytes_per_push": int(v2_cold_bytes),
+            "wire_v2_warm_push_bytes": int(np.mean(warm_push)),
+            "wire_bytes_ratio": round(dense_bytes / max(v2_cold_bytes, 1),
+                                      2),
+            "wire_encode_ms": round(float(np.mean(enc_ms)), 2),
+            "wire_decode_ms": round(float(np.mean(dec_ms)), 2),
+            "wire_warm_fetch_bytes": int(np.mean(warm_fetch)),
+            "wire_unchanged_layer_bytes": int(sum(unchanged_bytes)),
+            "wire_warm_shard_hit_rate": round(float(np.mean(hits)), 3),
+            "wire_parity": bool(parity),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _time_metrics_overhead(*, steps: int = 100, trials: int = 2,
                            log_every: int = 5) -> dict:
     """Observability-layer A/B (round-8 satellite): the production
@@ -987,6 +1143,14 @@ def main() -> None:
         extras.update(_time_gather_deltas())
     except Exception as e:
         extras["gather_deltas_error"] = repr(e)
+
+    try:
+        # dense v1 vs sparse+quantized shard-addressed v2 delta wire over
+        # localfs (round-12 tentpole): bytes-per-push ratio, encode/decode
+        # cost, and warm-round shard dedupe (unchanged layers fetch zero)
+        extras.update(_time_wire_v2())
+    except Exception as e:
+        extras["wire_v2_error"] = repr(e)
 
     try:
         # fleet health plane cost: production loop with the heartbeat

@@ -92,6 +92,14 @@ class RunConfig:
     logits_dtype: Optional[str] = None       # "bfloat16": half-size logits buf
     delta_dtype: Optional[str] = None        # bf16/int8/sparse8 wire deltas
     delta_density: float = 1.0 / 64.0        # sparse8 kept-coordinate ratio
+    # wire v2 (ROADMAP item 1): sparse+quantized packed deltas published
+    # as content-addressed per-layer shards + manifest (delta.pack_delta_v2,
+    # serialization shard container, engine/publish.py uploads only
+    # changed shards, engine/ingest.py fetches only changed shards)
+    wire_v2: bool = False                    # miner: publish the v2 wire
+    wire_density: float = 1.0 / 64.0         # v2 kept-coordinate ratio
+    wire_quant: str = "int8"                 # v2 kept values: int8 | none
+    accept_wire_v2: bool = True              # receivers: decode v2 manifests
     remat: Optional[bool] = None             # per-block rematerialization
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
     accum_steps: int = 1                     # microbatches per optimizer step
@@ -325,6 +333,11 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        help="fleet is known all-float: reject int8-wire "
                             "submissions instead of dequantizing, and skip "
                             "the quant-template alloc on garbage")
+        g.add_argument("--no-wire-v2", dest="accept_wire_v2",
+                       action="store_false", default=d.accept_wire_v2,
+                       help="refuse v2 shard-manifest submissions (the "
+                            "v1-only receiver posture); v2 miners then "
+                            "stage as no_delta")
         g.add_argument("--stale-deltas", dest="stale_deltas",
                        choices=("skip", "accept"), default=d.stale_deltas,
                        help="submissions whose rider names a superseded "
@@ -397,6 +410,29 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        help="sparse8 kept-coordinate ratio per tensor "
                             "(default 1/64; small tensors <= 4096 elements "
                             "always ship dense)")
+        g.add_argument("--wire-v2", dest="wire_v2", action="store_true",
+                       default=d.wire_v2,
+                       help="publish deltas on the v2 shard-addressed "
+                            "wire: top-k + quantized packed per-layer "
+                            "form, split into content-addressed shards + "
+                            "a small manifest — only CHANGED shards "
+                            "upload each push, receivers fetch only "
+                            "changed shards, and a miner-side "
+                            "error-feedback residual keeps repeated "
+                            "lossy publishes from drifting. Receivers "
+                            "negotiate v1 fallback via the delta META "
+                            "rider, so mixed fleets keep working")
+        g.add_argument("--wire-density", dest="wire_density", type=float,
+                       default=d.wire_density,
+                       help="v2 kept-coordinate ratio per wire tensor "
+                            "(default 1/64; tensors <= 4096 elements "
+                            "ship dense)")
+        g.add_argument("--wire-quant", dest="wire_quant",
+                       choices=("int8", "none"), default=d.wire_quant,
+                       help="v2 kept-value encoding: int8 (per-tensor "
+                            "symmetric scale, 5 bytes/coordinate) or "
+                            "none (f32 kept values, 8 bytes/coordinate, "
+                            "zero quantization error)")
     g.add_argument("--logits-dtype", dest="logits_dtype",
                    choices=("float32", "bfloat16"), default=d.logits_dtype,
                    help="storage dtype of the [batch, seq, vocab] logits "

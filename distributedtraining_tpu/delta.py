@@ -222,10 +222,27 @@ def screen_deltas(deltas: Sequence[Params], base: Params, *,
     screened ``chunk`` at a time. Short chunks are arity-padded by
     REPEATING a member (no zero-tree allocation) up to a small bucket
     ladder so a wobbling cohort size hits cached compiles.
+
+    v2 PACKED deltas (is_packed_v2) screen in their packed form — no
+    densify: admission is ``packed_matches`` (the field-wise analogue of
+    the shape check), then a fused ``_packed_screen_stats`` program per
+    chunk whose finite/max verdicts equal the dense screen's on the
+    densified tree. Packed entries group by their full leaf
+    shape/dtype signature (k varies per publisher, so shapes do too).
     """
     results: list[tuple[bool, str] | None] = [None] * len(deltas)
     by_sig: dict[tuple, list[int]] = {}
+    packed_by_sig: dict[tuple, list[int]] = {}
     for i, d in enumerate(deltas):
+        if is_packed_v2(d):
+            if not packed_matches(d, base):
+                results[i] = (False, "shape_mismatch")
+                continue
+            sig = ("packed",) + tuple(
+                (tuple(np.shape(l)), str(np.asarray(l).dtype))
+                for l in jax.tree_util.tree_leaves(d["leaves"]))
+            packed_by_sig.setdefault(sig, []).append(i)
+            continue
         if not shapes_match(d, base, check_dtype=check_dtype,
                             extra_dtypes=extra_dtypes):
             results[i] = (False, "shape_mismatch")
@@ -234,35 +251,44 @@ def screen_deltas(deltas: Sequence[Params], base: Params, *,
                     for l in jax.tree_util.tree_leaves(d))
         by_sig.setdefault(sig, []).append(i)
     cap = max_abs is not None and max_abs > 0
-    for idxs in by_sig.values():
-        for c in range(0, len(idxs), max(1, chunk)):
-            part = idxs[c:c + max(1, chunk)]
-            arity = _screen_arity(len(part))
-            args = [deltas[i] for i in part]
-            args += [args[0]] * (arity - len(args))
-            ckey = (arity, tuple(
-                (tuple(np.asarray(l).shape), str(np.asarray(l).dtype))
-                for l in jax.tree_util.tree_leaves(args[0])))
-            fresh = ckey not in _SCREEN_COMPILED
-            if fresh:
-                _SCREEN_COMPILED.add(ckey)
-                obs.count("screen.fresh_compiles")
-                t0 = time.perf_counter()
-            stats = _cohort_screen_stats_jit(*args)
-            if fresh:
-                # first-dispatch wall time: trace + compile (+ the async
-                # dispatch); the fused program's execution overlaps
-                obs.observe("compile.ms", (time.perf_counter() - t0) * 1e3)
-            finite, mags = jax.device_get(stats)
-            for slot, i in enumerate(part):
-                if not bool(finite[slot]):
-                    results[i] = (False, "nonfinite")
-                elif cap and float(mags[slot]) > max_abs:
-                    results[i] = (
-                        False, f"magnitude_exceeded({float(mags[slot]):.3e}"
-                               f">{max_abs:.3e})")
-                else:
-                    results[i] = (True, "ok")
+
+    def run_chunks(idx_groups, stats_fn, tree_of):
+        for idxs in idx_groups:
+            for c in range(0, len(idxs), max(1, chunk)):
+                part = idxs[c:c + max(1, chunk)]
+                arity = _screen_arity(len(part))
+                args = [tree_of(deltas[i]) for i in part]
+                args += [args[0]] * (arity - len(args))
+                ckey = (stats_fn is _packed_screen_stats_jit, arity, tuple(
+                    (tuple(np.asarray(l).shape), str(np.asarray(l).dtype))
+                    for l in jax.tree_util.tree_leaves(args[0])))
+                fresh = ckey not in _SCREEN_COMPILED
+                if fresh:
+                    _SCREEN_COMPILED.add(ckey)
+                    obs.count("screen.fresh_compiles")
+                    t0 = time.perf_counter()
+                stats = stats_fn(*args)
+                if fresh:
+                    # first-dispatch wall time: trace + compile (+ the
+                    # async dispatch); the fused program's execution
+                    # overlaps
+                    obs.observe("compile.ms",
+                                (time.perf_counter() - t0) * 1e3)
+                finite, mags = jax.device_get(stats)
+                for slot, i in enumerate(part):
+                    if not bool(finite[slot]):
+                        results[i] = (False, "nonfinite")
+                    elif cap and float(mags[slot]) > max_abs:
+                        results[i] = (
+                            False,
+                            f"magnitude_exceeded({float(mags[slot]):.3e}"
+                            f">{max_abs:.3e})")
+                    else:
+                        results[i] = (True, "ok")
+
+    run_chunks(by_sig.values(), _cohort_screen_stats_jit, lambda d: d)
+    run_chunks(packed_by_sig.values(), _packed_screen_stats_jit,
+               lambda d: d["leaves"])
     return results  # type: ignore[return-value]
 
 
@@ -621,6 +647,94 @@ def _walk_state_dict(tree, path=()):
         yield path, tree
 
 
+# kept-value dtypes a packed entry's "q" may carry: int8 (the quantized
+# wire) or f32 (--wire-quant none — kept values ship unquantized, scale
+# pinned to 1). Anything else is a hostile substitution (f64 parses at
+# 8x the advertised bytes) and fails validation.
+_PACKED_Q_DTYPES = (np.int8, np.float32)
+
+
+def _validate_packed_entry(entry, n: int, *,
+                           q_dtypes: tuple = (np.int8,)) -> tuple | None:
+    """Field-wise validation of one top-k packed leaf entry
+    ``{"idx", "q", "scale"}`` against a template leaf of ``n`` elements —
+    everything an attacker controls: key set, dtypes (idx int32, q in
+    ``q_dtypes``, scale f32 scalar), k <= n, finite scale, index bounds.
+    Returns host ``(idx, q, scale)`` or None. Shared by the sparse8
+    densifier (int8 q only, its historical contract) and the v2 packed
+    wire (int8 or f32 kept values), so the formats cannot drift apart in
+    what they accept."""
+    if not isinstance(entry, dict) or set(entry) != {"idx", "q", "scale"}:
+        return None
+    idx, q, scale = (np.asarray(entry["idx"]), np.asarray(entry["q"]),
+                     np.asarray(entry["scale"]))
+    if (idx.dtype != np.int32 or q.dtype not in q_dtypes
+            or scale.dtype != np.float32):
+        return None
+    if idx.ndim != 1 or q.ndim != 1 or scale.shape != ():
+        return None
+    if not np.isfinite(scale):
+        return None
+    if idx.shape[0] == 0 and q.shape[0] == n and n > 0:
+        # DENSE-form entry (k == n): the index array would be arange(n),
+        # pure redundancy at 4 bytes/coordinate — below-cutoff tensors
+        # ship empty-idx + full q instead (1 byte/element under int8,
+        # vs 5 for the indexed spelling)
+        return idx, q, scale
+    if q.shape != idx.shape or idx.shape[0] > n:
+        return None
+    if idx.shape[0] and (idx.min() < 0 or idx.max() >= n):
+        return None
+    return idx, q, scale
+
+
+def _densify_packed_entry(idx, q, scale, shape) -> np.ndarray:
+    """Validated entry -> dense f32 host array. Duplicate indices resolve
+    last-wins (deterministic; screens run on the result regardless)."""
+    n = int(np.prod(shape, dtype=np.int64))
+    if idx.shape[0] == 0 and q.shape[0] == n and n > 0:
+        # dense-form entry (empty idx, full q — see _validate_packed_entry)
+        return (q.astype(np.float32) * float(scale)).reshape(shape)
+    dense = np.zeros((n,), np.float32)
+    dense[idx] = q.astype(np.float32) * float(scale)
+    return dense.reshape(shape)
+
+
+def _packed_tree_fields(leaves, template, *, q_dtypes: tuple = (np.int8,)):
+    """Validate a packed-leaves tree against ``template`` leaf-by-leaf:
+    path parity (each template leaf maps to exactly one
+    ``{"idx","q","scale"}`` entry), then :func:`_validate_packed_entry`
+    per entry. Returns ``[(path, shape, (idx, q, scale)), ...]`` in
+    template walk order, or None on any mismatch — the one validator
+    behind the sparse8 densifier, the v2 packed densifier, and the
+    packed-form admission screen, so a payload accepted by one is
+    accepted by all."""
+    import flax.serialization as flax_ser
+
+    if not isinstance(leaves, dict):
+        return None
+    t_flat = list(_walk_state_dict(flax_ser.to_state_dict(template)))
+    s_by_parent: dict = {}
+    for path, leaf in _walk_state_dict(leaves):
+        if len(path) < 1:
+            return None
+        s_by_parent.setdefault(path[:-1], {})[path[-1]] = leaf
+    if len(s_by_parent) != len(t_flat):
+        return None
+    out = []
+    for path, t_leaf in t_flat:
+        entry = s_by_parent.get(path)
+        if entry is None:
+            return None
+        fields = _validate_packed_entry(
+            entry, int(np.prod(np.shape(t_leaf), dtype=np.int64)),
+            q_dtypes=q_dtypes)
+        if fields is None:
+            return None
+        out.append((path, np.shape(t_leaf), fields))
+    return out
+
+
 def densify_sparse_delta(sparse: Params, template: Params) -> Params:
     """sparse8 wire tree -> dense f32 HOST delta shaped like ``template``.
 
@@ -652,43 +766,255 @@ def densify_sparse_delta(sparse: Params, template: Params) -> Params:
     if not isinstance(leaves, dict) or set(sparse) != {
             SPARSE_FORMAT_KEY, "leaves"}:
         return None
-    t_state = flax_ser.to_state_dict(template)
-    t_flat = list(_walk_state_dict(t_state))
-    s_flat = list(_walk_state_dict(leaves))
-    # paths must match 1:1 — but sparse leaves are {"idx","q","scale"}
-    # dicts, so each template leaf corresponds to THREE sparse paths
-    s_by_parent: dict = {}
-    for path, leaf in s_flat:
-        if len(path) < 1:
-            return None
-        s_by_parent.setdefault(path[:-1], {})[path[-1]] = leaf
-    if len(s_by_parent) != len(t_flat):
+    # sparse8 pins q to int8 exactly (its historical wire contract); the
+    # v2 packed wire additionally admits f32 kept values (--wire-quant)
+    fields = _packed_tree_fields(leaves, template, q_dtypes=(np.int8,))
+    if fields is None:
         return None
-    out_state = t_state
-    for path, t_leaf in t_flat:
-        entry = s_by_parent.get(path)
-        if entry is None or set(entry) != {"idx", "q", "scale"}:
-            return None
-        idx, q, scale = (np.asarray(entry["idx"]), np.asarray(entry["q"]),
-                         np.asarray(entry["scale"]))
-        n = int(np.prod(np.shape(t_leaf), dtype=np.int64))
-        if (idx.dtype != np.int32 or q.dtype != np.int8
-                or scale.dtype != np.float32):
-            return None
-        if idx.ndim != 1 or q.shape != idx.shape or scale.shape != ():
-            return None
-        if idx.shape[0] > n or not np.isfinite(scale):
-            return None
-        if idx.shape[0] and (idx.min() < 0 or idx.max() >= n):
-            return None
-        dense = np.zeros((n,), np.float32)
-        dense[idx] = q.astype(np.float32) * float(scale)
-        # write into the state dict at `path`
+    return _densify_fields(fields, template)
+
+
+def _densify_fields(fields, template) -> Params:
+    """Validated ``_packed_tree_fields`` output -> dense f32 host tree
+    shaped like ``template``."""
+    import flax.serialization as flax_ser
+
+    out_state = flax_ser.to_state_dict(template)
+    for path, shape, entry_fields in fields:
         node = out_state
         for key in path[:-1]:
             node = node[key]
-        node[path[-1]] = dense.reshape(np.shape(t_leaf))
+        node[path[-1]] = _densify_packed_entry(*entry_fields, shape)
     return flax_ser.from_state_dict(template, out_state)
+
+
+# ---------------------------------------------------------------------------
+# Wire v2: packed per-layer top-k form (the shard-addressed publication
+# channel). Same per-leaf layout as sparse8 ({"idx","q","scale"}) and the
+# same top-k/quantization math, but (a) the tree stays split per WIRE
+# TENSOR so engine/publish.py can ship each layer as its own
+# content-addressed shard and engine/ingest.py can dedupe/fetch at shard
+# granularity, (b) the encoder carries an ERROR-FEEDBACK residual, and
+# (c) the cohort screen runs directly on the packed form (no densify).
+#
+# On error feedback vs the replace-don't-accumulate rule above: v1
+# artifacts replace each other, so carrying a residual into the next v1
+# push would re-add a superseded push's rounding error. The v2 regime is
+# different in kind: top-k sparsification DROPS coordinates outright
+# (not rounds them), and a coordinate that stays small forever would
+# otherwise never ship at all — the residual accumulates exactly that
+# dropped mass until it crosses the top-k threshold, so repeated lossy
+# publishes converge on the true cumulative delta instead of drifting
+# (the NeuronFabric Local-Adam regime: fewer, fatter, compressed
+# publishes). The residual lives at the MINER and resets on base pulls
+# (the cumulative delta it tracks resets there too).
+# ---------------------------------------------------------------------------
+
+WIRE_V2_KEY = "__wire_v2__"
+WIRE_V2_FORMAT = 2
+# --wire-quant vocabulary: int8 kept values (scale = max|kept|/127, the
+# sparse8 math) or unquantized f32 kept values (scale pinned to 1)
+WIRE_QUANTS = ("int8", "none")
+
+
+def is_packed_entry(node) -> bool:
+    """True for one packed per-tensor entry ``{"idx","q","scale"}`` (the
+    is_leaf predicate for tree_map/tree_leaves over packed trees)."""
+    return isinstance(node, dict) and set(node) == {"idx", "q", "scale"}
+
+
+def is_packed_v2(tree) -> bool:
+    """True when ``tree`` is a v2 packed delta (marker + leaves keys and
+    an integer format-2 marker). Defensive like the sparse8 marker check:
+    hostile marker types read as "not v2", never raise."""
+    if not isinstance(tree, dict) or set(tree) != {WIRE_V2_KEY, "leaves"}:
+        return False
+    try:
+        m = np.asarray(tree[WIRE_V2_KEY])
+        return (m.shape == () and np.issubdtype(m.dtype, np.integer)
+                and int(m) == WIRE_V2_FORMAT)
+    except (TypeError, ValueError):
+        return False
+
+
+def pack_delta_v2(delta: Params, *, density: float = 1.0 / 64.0,
+                  quant: str = "int8", residual: Params | None = None
+                  ) -> tuple[Params, Params]:
+    """Float delta -> (v2 packed tree, new error-feedback residual).
+
+    Per leaf: top-k by |value| (``sparse_k`` — the sparse8 selection, so
+    the parity pin vs ``sparsify_delta`` holds exactly), kept values
+    int8-quantized against the tensor's own max (or shipped f32 under
+    ``quant="none"``). ``residual`` is the previous publish's unsent
+    mass, ADDED to the delta before selection; the returned residual is
+    ``(delta + residual) - decode(packed)`` — what this publish still
+    failed to ship. Pass ``residual=None`` for a residual of zeros (the
+    first publish, and the stateless reference spelling the parity test
+    pins). Jittable: k is static per leaf, both outputs are fresh
+    buffers."""
+    if not 0.0 < density <= 1.0:
+        raise ValueError(f"density must be in (0, 1], got {density}")
+    if quant not in WIRE_QUANTS:
+        raise ValueError(f"quant must be one of {WIRE_QUANTS}, got {quant!r}")
+
+    def leaf(x, r):
+        if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            raise ValueError(
+                "pack_delta_v2: non-float leaf of dtype "
+                f"{jnp.asarray(x).dtype} — the v2 wire covers all-float "
+                "delta trees only")
+        shape = jnp.shape(x)
+        flat = jnp.asarray(x).reshape(-1).astype(jnp.float32)
+        if r is not None:
+            flat = flat + jnp.asarray(r).reshape(-1).astype(jnp.float32)
+        n = flat.shape[0]
+        k = sparse_k(n, density)
+        dense_form = k >= n
+        if dense_form:
+            # DENSE-form entry: empty idx, full q (the idx array would be
+            # arange(n) — 4 redundant bytes per coordinate on exactly the
+            # below-cutoff tensors where every coordinate ships)
+            idx = jnp.zeros((0,), jnp.int32)
+            kept = flat
+            top_mag = jnp.max(jnp.abs(flat))
+        else:
+            top_mag_all, idx = jax.lax.top_k(jnp.abs(flat), k)
+            idx = idx.astype(jnp.int32)
+            kept = flat[idx]
+            top_mag = top_mag_all[0]
+        if quant == "int8":
+            scale = jnp.maximum(top_mag, 1e-12) / 127.0
+            q = jnp.clip(jnp.round(kept / scale), -127, 127).astype(jnp.int8)
+            decoded = q.astype(jnp.float32) * scale
+        else:
+            scale = jnp.asarray(1.0, jnp.float32)
+            q = kept
+            decoded = kept
+        if dense_form:
+            res = (flat - decoded).reshape(shape)
+        else:
+            # top-k indices are unique: scatter-add == flat - densify
+            res = flat.at[idx].add(-decoded).reshape(shape)
+        return {"idx": idx, "q": q,
+                "scale": scale.astype(jnp.float32)}, res
+
+    leaves, treedef = jax.tree_util.tree_flatten(delta)
+    rleaves = (jax.tree_util.tree_leaves(residual)
+               if residual is not None else [None] * len(leaves))
+    if len(rleaves) != len(leaves):
+        raise ValueError("pack_delta_v2: residual/delta structure mismatch")
+    entries, res = [], []
+    for x, r in zip(leaves, rleaves):
+        e, rr = leaf(x, r)
+        entries.append(e)
+        res.append(rr)
+    packed = {WIRE_V2_KEY: jnp.asarray(WIRE_V2_FORMAT, jnp.int32),
+              "leaves": jax.tree_util.tree_unflatten(treedef, entries)}
+    return packed, jax.tree_util.tree_unflatten(treedef, res)
+
+
+def packed_matches(packed: Params, base: Params) -> bool:
+    """Admission check for an untrusted packed v2 tree: marker, per-leaf
+    path parity with ``base``, pinned field dtypes, k <= n, finite
+    scales, index bounds — the packed analogue of ``shapes_match``
+    (validated field-by-field because k varies per publisher, so there
+    is no fixed template to restore against)."""
+    if not is_packed_v2(packed):
+        return False
+    try:
+        return _packed_tree_fields(packed["leaves"], base,
+                                   q_dtypes=_PACKED_Q_DTYPES) is not None
+    except (TypeError, ValueError, KeyError):
+        return False
+
+
+def densify_packed_v2(packed: Params, template: Params) -> Params:
+    """v2 packed tree -> dense f32 HOST delta shaped like ``template``,
+    or None on any validation failure (same contract as
+    ``densify_sparse_delta``; accepts int8 AND f32 kept values)."""
+    if not is_packed_v2(packed):
+        return None
+    try:
+        fields = _packed_tree_fields(packed["leaves"], template,
+                                     q_dtypes=_PACKED_Q_DTYPES)
+    except (TypeError, ValueError, KeyError):
+        return None
+    if fields is None:
+        return None
+    return _densify_fields(fields, template)
+
+
+def packed_layer_entries(packed: Params) -> dict[str, dict]:
+    """Host split of a packed v2 tree into its shard units: one
+    ``"a/b/c" -> {"idx","q","scale"}`` (np arrays) per wire tensor, keys
+    "/"-joined state-dict paths — the layer keys the shard manifest is
+    addressed by (serialization.build_wire_manifest). Publisher-side on
+    its OWN tree, so malformed input raises instead of returning None."""
+    import flax.serialization as flax_ser
+
+    if not is_packed_v2(packed):
+        raise ValueError("packed_layer_entries: not a v2 packed tree")
+    by_parent: dict = {}
+    for path, leaf in _walk_state_dict(
+            flax_ser.to_state_dict(packed["leaves"])):
+        if any("/" in str(k) for k in path):
+            raise ValueError(f"packed_layer_entries: path component with "
+                             f"'/' in {path!r} would make layer keys "
+                             "ambiguous")
+        by_parent.setdefault(path[:-1], {})[path[-1]] = np.asarray(
+            jax.device_get(leaf))
+    return {"/".join(str(k) for k in p): e for p, e in by_parent.items()}
+
+
+def packed_from_layer_entries(entries: dict[str, dict]) -> Params:
+    """Inverse of ``packed_layer_entries``: reassemble shard entries
+    (ingest side, keys from an UNTRUSTED manifest) into a v2 packed tree.
+    Purely structural — colliding/hostile keys produce a tree that then
+    fails ``packed_matches`` against the template, never an exception
+    here."""
+    nested: dict = {}
+    for key, entry in entries.items():
+        parts = str(key).split("/")
+        node = nested
+        ok = True
+        for p in parts[:-1]:
+            nxt = node.setdefault(p, {})
+            if not isinstance(nxt, dict):
+                ok = False
+                break
+            node = nxt
+        if ok:
+            node[parts[-1]] = entry
+    return {WIRE_V2_KEY: np.int32(WIRE_V2_FORMAT), "leaves": nested}
+
+
+def _packed_screen_stats(*packed_leaves) -> tuple[jax.Array, jax.Array]:
+    """Per-tree (finite flag, max |decoded value|) for a cohort of packed
+    v2 leaves-trees — the packed twin of ``_cohort_screen_stats``, fused
+    the same way. No densify: int8 kept values are finite by
+    construction, so finiteness is the scales' (plus f32 kept values',
+    under quant="none"); the decoded max is ``max|q| * scale`` per
+    tensor exactly (scale >= 0), so the magnitude verdict matches the
+    dense screen on the densified tree. Returns ([K] bool, [K] f32)."""
+    fins, maxs = [], []
+    for leaves in packed_leaves:
+        entries = jax.tree_util.tree_leaves(leaves, is_leaf=is_packed_entry)
+        flags, mags = [], []
+        for e in entries:
+            flags.append(jnp.any(~jnp.isfinite(e["scale"])))
+            if jnp.issubdtype(jnp.asarray(e["q"]).dtype, jnp.inexact):
+                flags.append(jnp.any(~jnp.isfinite(e["q"])))
+            if e["q"].size:
+                mags.append(jnp.max(jnp.abs(e["q"].astype(jnp.float32)))
+                            * e["scale"])
+        fins.append(jnp.logical_not(jnp.any(jnp.stack(flags)))
+                    if flags else jnp.asarray(True))
+        maxs.append(jnp.max(jnp.stack(mags)) if mags
+                    else jnp.asarray(0.0, jnp.float32))
+    return jnp.stack(fins), jnp.stack(maxs)
+
+
+_packed_screen_stats_jit = jax.jit(_packed_screen_stats)
 
 
 def sparse_delta_from_bytes(data: bytes, template: Params,

@@ -492,6 +492,7 @@ class AveragerLoop:
                  metrics=None,
                  lora_cfg=None,
                  accept_quant: bool = True,
+                 accept_wire_v2: bool = True,
                  stale_deltas: str = "skip",
                  publish_policy: str = "improved",
                  ingest_workers: int = 4,
@@ -526,6 +527,9 @@ class AveragerLoop:
         # False = all-float fleet: reject int8-wire submissions and skip
         # the quant-template alloc on garbage (see Validator.accept_quant)
         self.accept_quant = accept_quant
+        # wire-v2 shard-manifest submissions (engine/ingest.py fetches
+        # only changed shards); False = v1-only receiver posture
+        self.accept_wire_v2 = accept_wire_v2
         # "skip": a delta whose rider names a DIFFERENT base than the
         # current one is not merged — applying it would re-add the part
         # of the last merge the miner had already incorporated (stale
@@ -643,6 +647,7 @@ class AveragerLoop:
                 lora_cfg=self.lora_cfg,
                 quant_template=self._quant_template,
                 accept_quant=self.accept_quant,
+                accept_wire_v2=self.accept_wire_v2,
                 max_delta_abs=self.max_delta_abs,
                 stale_deltas=self.stale_deltas,
                 workers=self.ingest_workers,

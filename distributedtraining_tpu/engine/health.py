@@ -405,6 +405,10 @@ class NodeHealth:
     last_delta_revision: str | None = None
     last_accepted_round: int | None = None
     stale_rounds: int = 0               # rounds since the revision changed
+    wire_bytes: int = 0                 # transport bytes this role fetched
+    #                                     staging this miner (0 on cache
+    #                                     hits; manifest + changed shards
+    #                                     only on the v2 wire)
     score: float = float("nan")
     score_history: deque = dataclasses.field(
         default_factory=lambda: deque(maxlen=32))
@@ -424,7 +428,8 @@ class NodeHealth:
             "registry_digest": self.registry_digest,
             "published": self.published, "accepted": self.accepted,
             "declined": self.declined, "last_reason": self.last_reason,
-            "stale_rounds": self.stale_rounds, "score": self.score,
+            "stale_rounds": self.stale_rounds,
+            "wire_bytes": self.wire_bytes, "score": self.score,
             "breaches": list(self.breaches),
             # numeric so the exporter can serve dt_fleet_quarantined
             "quarantined": int(self.quarantined),
@@ -710,6 +715,10 @@ class FleetMonitor:
             else:
                 node.stale_rounds += 1
             node.last_reason = s.reason
+            # transport cost attribution: what staging this miner's
+            # submissions actually pulled over the wire (the per-miner
+            # half of the wire.* registry counters)
+            node.wire_bytes += int(getattr(s, "wire_bytes", 0) or 0)
             if s.delta is not None:
                 node.accepted += 1
                 node.last_accepted_round = self.round

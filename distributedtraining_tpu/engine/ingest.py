@@ -38,11 +38,24 @@ Everything here operates on WIRE-layout host trees (what the transports
 serve); callers apply ``wire_in`` on the results exactly as the serial
 paths did.
 
+Wire v2 (the shard-addressed publication channel, docs/wire.md): a
+miner whose delta artifact is a shard MANIFEST stages through the
+manifest-first path — parse the manifest, serve every shard whose
+sha256 the cache already holds, fetch + hash-verify + decode only the
+changed ones, screen the reassembled PACKED tree without densifying,
+and densify only after the verdict. An unchanged layer costs zero
+transport bytes on every later round (shard-granular dedupe). v1
+miners take the classic dense path off the same fetch — the two
+formats negotiate per miner via the self-describing manifest magic
+(and the META rider's ``wire`` declaration), so mixed fleets work.
+
 Registry metrics (utils/obs.py; see docs/observability.md):
 ``ingest.cache_hits`` / ``ingest.cache_misses`` / ``ingest.cache_evictions``
 counters, ``ingest.cache_bytes`` histogram (resident bytes after each
 insert), ``ingest.fetch_errors`` counter (per-miner staging failures —
-isolated, never round-fatal).
+isolated, never round-fatal); ``wire.bytes_fetched`` /
+``wire.shards_deduped`` / ``wire.torn_fetches`` counters and the
+``wire.decode_ms`` histogram on the v2 path.
 """
 
 from __future__ import annotations
@@ -51,6 +64,7 @@ import dataclasses
 import logging
 import queue
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional, Sequence
 
@@ -92,6 +106,11 @@ class StagedDelta:
     cid: str | None             # correlation id from the meta rider
     cached: bool = False        # served from the host cache (no download)
     meta_base_revision: str | None = None
+    # transport bytes actually fetched staging THIS submission (0 on a
+    # cache hit; manifest + changed shards only on the v2 wire) — folded
+    # per miner into the fleet ledger (engine/health.py) and the
+    # fleet_report wire-bytes column
+    wire_bytes: int = 0
 
     @property
     def ok(self) -> bool:
@@ -235,6 +254,14 @@ class DeltaCache:
         self.max_bytes = max(0, int(max_bytes))
         self._lock = threading.Lock()
         self._entries: OrderedDict[str, _Entry] = OrderedDict()
+        # wire-v2 shard store: sha256 content hash -> (decoded packed
+        # entry, nbytes). Keyed by CONTENT, not (hotkey, layer): two
+        # miners shipping an identical layer update dedupe to one entry,
+        # and a miner's unchanged layer across manifests is a hit
+        # whatever else changed. Shares the byte budget with the
+        # decoded-tree entries (shards evict first — a shard is
+        # re-fetchable per layer, a tree re-costs the whole artifact).
+        self._shards: OrderedDict[str, tuple] = OrderedDict()
         self._bytes = 0
 
     @property
@@ -243,6 +270,44 @@ class DeltaCache:
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    # -- wire-v2 shard granularity ------------------------------------------
+    def shard_lookup(self, digest: str):
+        """Decoded packed entry for a shard content hash, or None."""
+        if self.max_bytes <= 0 or not isinstance(digest, str):
+            return None
+        with self._lock:
+            hit = self._shards.get(digest)
+            if hit is None:
+                return None
+            self._shards.move_to_end(digest)
+            return hit[0]
+
+    def shard_put(self, digest: str, entry) -> None:
+        if self.max_bytes <= 0 or not isinstance(digest, str):
+            return
+        nb = tree_nbytes(entry)
+        if nb > self.max_bytes:
+            return
+        evicted = 0
+        with self._lock:
+            old = self._shards.pop(digest, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._shards[digest] = (entry, nb)
+            self._bytes += nb
+            while self._bytes > self.max_bytes and self._shards:
+                _, (_, ev_nb) = self._shards.popitem(last=False)
+                self._bytes -= ev_nb
+                evicted += 1
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                evicted += 1
+            total = self._bytes
+        if evicted:
+            obs.count("ingest.cache_evictions", evicted)
+        obs.observe("ingest.cache_bytes", total)
 
     def lookup(self, hotkey: str, revision) -> _Entry | None:
         if self.max_bytes <= 0 or not isinstance(revision, str):
@@ -270,6 +335,12 @@ class DeltaCache:
             self._entries[hotkey] = _Entry(revision, delta, reason, fetched,
                                            cid, meta_base_revision, nb)
             self._bytes += nb
+            # shards evict before whole-tree entries (re-fetchable per
+            # layer vs per artifact — see shard_put)
+            while self._bytes > self.max_bytes and self._shards:
+                _, (_, ev_nb) = self._shards.popitem(last=False)
+                self._bytes -= ev_nb
+                evicted += 1
             while self._bytes > self.max_bytes and len(self._entries) > 1:
                 _, ev = self._entries.popitem(last=False)
                 self._bytes -= ev.nbytes
@@ -282,6 +353,7 @@ class DeltaCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._shards.clear()
             self._bytes = 0
 
 
@@ -304,6 +376,7 @@ class DeltaIngestor:
     def __init__(self, transport, template, *,
                  lora_cfg=None, lora_template=None, quant_template=None,
                  accept_quant: bool = True,
+                 accept_wire_v2: bool = True,
                  max_delta_abs: float | None = None,
                  stale_deltas: str = "accept",
                  workers: int = 4,
@@ -325,6 +398,10 @@ class DeltaIngestor:
         self._lora_template_cache = None
         self.quant_template = quant_template
         self.accept_quant = accept_quant
+        # wire-v2 (shard manifest) submissions: decode via the
+        # manifest-first path below; False = the v1-only receiver
+        # posture (--no-wire-v2), manifests then stage as no_delta
+        self.accept_wire_v2 = accept_wire_v2
         self.max_delta_abs = max_delta_abs
         if stale_deltas not in ("skip", "accept"):
             raise ValueError(f"stale_deltas must be 'skip' or 'accept', "
@@ -480,23 +557,29 @@ class DeltaIngestor:
                                    meta_base_revision=meta_rev)
         with obs.span(self._span("fetch"), cid=cid, miner=hotkey,
                       cache="miss"):
-            delta, attempted = self._fetch_dense(hotkey)
+            delta, attempted, nbytes = self._fetch_dense(hotkey)
         if delta is None:
             if attempted:
                 # decoded-and-invalid is a verdict worth remembering; a
-                # bytes-level miss (publish race) is not
+                # bytes-level miss (publish race, torn shard set) is not
                 self.cache.put(hotkey, rev_key, delta=None,
                                reason="no_delta", cid=cid,
                                meta_base_revision=meta_rev)
             return StagedDelta(hotkey, None, "no_delta", rev_key, cid,
-                               meta_base_revision=meta_rev)
+                               meta_base_revision=meta_rev,
+                               wire_bytes=nbytes)
         return StagedDelta(hotkey, delta, _UNSCREENED, rev_key, cid,
-                           meta_base_revision=meta_rev)
+                           meta_base_revision=meta_rev, wire_bytes=nbytes)
 
-    def _fetch_dense(self, hotkey: str) -> tuple[Params | None, bool]:
-        """(dense wire-layout delta | None, decode_attempted). Bytes-path
-        transports fetch ONCE and validate every wire form on the same
-        payload (engine/lora_train.py densify_delta_bytes)."""
+    def _fetch_dense(self, hotkey: str) -> tuple[Params | None, bool, int]:
+        """(wire-layout delta | None, decode_attempted, bytes fetched).
+        Bytes-path transports fetch ONCE and validate every wire form on
+        the same payload (engine/lora_train.py densify_delta_bytes). A
+        wire-v2 MANIFEST takes the shard-granular path instead — only
+        shards whose content hash the cache doesn't hold are fetched,
+        and the result is the PACKED tree (screened packed, densified
+        after the verdict in _screen_fresh)."""
+        from .. import serialization as ser
         from .lora_train import densify_delta_bytes, fetch_delta_any
 
         fetch_bytes = getattr(self.transport, "fetch_delta_bytes", None)
@@ -505,12 +588,17 @@ class DeltaIngestor:
                                    policy=self.retry,
                                    describe=f"fetch {hotkey}")
             if data is None:
-                return None, False
+                return None, False, 0
+            if ser.is_wire_v2_manifest(data):
+                if not self.accept_wire_v2:
+                    return None, True, len(data)
+                return self._assemble_v2(hotkey, bytes(data))
+            obs.count("wire.bytes_fetched", len(data))
             return densify_delta_bytes(
                 data, self._template(), self.lora_cfg,
                 lora_template=self._lora_template(),
                 quant_template=self.quant_template,
-                accept_quant=self.accept_quant), True
+                accept_quant=self.accept_quant), True, len(data)
         d = call_with_retry(
             lambda: fetch_delta_any(
                 self.transport, hotkey, self._template(), self.lora_cfg,
@@ -518,7 +606,58 @@ class DeltaIngestor:
                 quant_template=self.quant_template,
                 accept_quant=self.accept_quant),
             policy=self.retry, describe=f"fetch {hotkey}")
-        return d, d is not None
+        return d, d is not None, tree_nbytes(d)
+
+    def _assemble_v2(self, hotkey: str,
+                     manifest_bytes: bytes) -> tuple[Params | None, bool,
+                                                     int]:
+        """Manifest-first ingest of one miner's v2 publish: parse the
+        manifest, serve every shard whose content hash the cache already
+        holds (ZERO transport bytes for unchanged layers), fetch + verify
+        + decode only the changed ones, reassemble the packed tree.
+
+        Hash verification against the manifest is both the integrity
+        check (shards travel unsigned — the hash rides the
+        signed/validated manifest) and the torn-publish guard: a
+        mid-publish reader holds the OLD manifest while some shards are
+        already new, every such shard fails its hash check, and the
+        whole staging reads as a transient miss (attempted=False — NOT
+        negative-cached, exactly like a mid-rename publish race; the
+        next round's fresh manifest heals it). A torn set is therefore
+        never decoded."""
+        from .. import serialization as ser
+        from ..transport import base as tbase
+
+        fetched = len(manifest_bytes)
+        obs.count("wire.bytes_fetched", fetched)
+        man = ser.parse_wire_manifest(manifest_bytes)
+        if man is None or not man["layers"]:
+            return None, True, fetched   # hostile/empty manifest: a verdict
+        entries: dict = {}
+        for key, info in man["layers"].items():
+            cached = self.cache.shard_lookup(info["h"])
+            if cached is not None:
+                obs.count("wire.shards_deduped")
+                entries[key] = cached
+                continue
+            data = call_with_retry(
+                lambda key=key: tbase.fetch_shard(self.transport, hotkey,
+                                                  key),
+                policy=self.retry, describe=f"fetch shard {hotkey}/{key}")
+            if data is None or ser.shard_digest(data) != info["h"]:
+                obs.count("wire.torn_fetches")
+                return None, False, fetched
+            fetched += len(data)
+            obs.count("wire.bytes_fetched", len(data))
+            entry = ser.unpack_shard(data)
+            if entry is None:
+                return None, True, fetched   # undecodable shard: a verdict
+            self.cache.shard_put(info["h"], entry)
+            entries[key] = entry
+        packed = delta_lib.packed_from_layer_entries(entries)
+        if not delta_lib.packed_matches(packed, self._template()):
+            return None, True, fetched
+        return packed, True, fetched
 
     # -- fused screening -----------------------------------------------------
     def _screen_fresh(self, staged: list[StagedDelta], *,
@@ -528,6 +667,9 @@ class DeltaIngestor:
             return
         with obs.span(self._span("screen"), k=len(fresh),
                       cids=[s.cid for s in fresh if s.cid]):
+            # v2 submissions sit in the list as PACKED trees and screen
+            # in packed form (screen_deltas' packed branch — no densify
+            # ahead of the verdict; a rejected artifact never pays one)
             verdicts = delta_lib.screen_deltas(
                 [s.delta for s in fresh], self._template(),
                 max_abs=self.max_delta_abs)
@@ -535,6 +677,18 @@ class DeltaIngestor:
             s.reason = "ok" if ok else reason
             if not ok:
                 s.delta = None
+            elif delta_lib.is_packed_v2(s.delta):
+                # verdict passed: NOW densify for the merge/eval paths
+                # downstream (they consume dense wire-layout trees)
+                t0 = time.perf_counter()
+                dense = delta_lib.densify_packed_v2(s.delta,
+                                                    self._template())
+                obs.observe("wire.decode_ms",
+                            (time.perf_counter() - t0) * 1e3)
+                if dense is None:   # cannot happen post-screen; belt+braces
+                    s.reason, s.delta = "no_delta", None
+                else:
+                    s.delta = dense
             if cache:
                 self.cache.put(s.hotkey, s.revision, delta=s.delta,
                                reason=s.reason, cid=s.cid,
@@ -561,9 +715,24 @@ class DeltaIngestor:
             fetch_bytes = getattr(self.transport, "fetch_delta_bytes", None)
             if fetch_bytes is None:
                 return out
-            out["data"] = call_with_retry(lambda: fetch_bytes(hotkey),
-                                          policy=self.retry,
-                                          describe=f"fetch {hotkey}")
+            data = call_with_retry(lambda: fetch_bytes(hotkey),
+                                   policy=self.retry,
+                                   describe=f"fetch {hotkey}")
+            from .. import serialization as ser
+            if data is not None and ser.is_wire_v2_manifest(data):
+                # pod spelling of the manifest path: the coordinator
+                # reassembles the shard set ONCE (hash-verified, shard
+                # cache disabled like the tree cache — pod rule) and
+                # broadcasts one self-contained packed blob; every
+                # process densifies identical bytes. A torn set reads
+                # as absent, same as the single-host path.
+                if not self.accept_wire_v2:
+                    data = None
+                else:
+                    packed, _, _ = self._assemble_v2(hotkey, bytes(data))
+                    data = (ser.pack_wire_blob(packed)
+                            if packed is not None else None)
+            out["data"] = data
         except Exception:
             logger.exception("ingest: coordinator prefetch of %s failed",
                              hotkey)

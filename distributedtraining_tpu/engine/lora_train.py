@@ -146,6 +146,11 @@ class LoRAMinerLoop(MinerLoop):
     adapters."""
 
     def __init__(self, engine: LoRAEngine, transport, miner_id: str, **kw):
+        if kw.get("wire_v2"):
+            # adapter artifacts are already ~MB-scale and low-rank; the
+            # shard-addressed top-k wire is a full-param-delta format
+            raise ValueError("wire_v2 is a full-param wire format; LoRA "
+                             "adapters publish their own compact form")
         super().__init__(engine, transport, miner_id, **kw)
         self._rng = jax.random.PRNGKey(0)
 
@@ -403,6 +408,13 @@ def densify_delta_bytes(data: bytes, base,
         data = signing.strip_envelope(data)
     except ser.PayloadError:
         return None
+    # wire-v2 self-contained blob (the pod-broadcast spelling of a shard
+    # manifest, serialization.pack_wire_blob): built by our own
+    # coordinator AFTER its accept-wire-v2 gate, so it decodes
+    # unconditionally here — magic-prefixed, so it can never be confused
+    # with the msgpack forms below
+    if ser.is_wire_v2_blob(data):
+        return ser.unpack_wire_blob(data, base)
     try:
         return ser.validated_load(data, base)
     except ser.PayloadError:

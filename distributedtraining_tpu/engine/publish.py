@@ -239,7 +239,8 @@ class DeltaPublisher:
     def __init__(self, transport, miner_id: str, *, report,
                  nan_guard: bool = True, queue_depth: int = 1,
                  sleep: Callable[[float], None] | None = None,
-                 publish_retry=None, meta_retry=None):
+                 publish_retry=None, meta_retry=None,
+                 wire_spec: dict | None = None):
         from ..transport.retry import (DEFAULT_META_RETRY,
                                        DEFAULT_PUBLISH_RETRY)
         self.transport = transport
@@ -249,6 +250,16 @@ class DeltaPublisher:
         self.publish_retry = publish_retry or DEFAULT_PUBLISH_RETRY
         self.meta_retry = meta_retry or DEFAULT_META_RETRY
         self._sleep = sleep
+        # wire-v2 declaration for the meta rider (format/density/quant):
+        # how receivers learn this miner's artifact is a shard manifest
+        # BEFORE fetching it (engine/ingest.py negotiates the v1 decode
+        # fallback off its absence). Set by MinerLoop when --wire-v2.
+        self.wire_spec = wire_spec
+        # layer_key -> sha256 of the last shard set the FLEET can see
+        # (updated only after the manifest lands): the publisher-side
+        # half of shard dedupe — an unchanged layer's shard is never
+        # re-uploaded, the exact mirror of ingest never re-fetching it.
+        self._last_shards: dict[str, str] = {}
         self._worker = PublishWorker(name=f"publish-{miner_id}",
                                      depth=queue_depth)
 
@@ -281,44 +292,110 @@ class DeltaPublisher:
             # every process, and its async path materializes first
             with obs.span("push.materialize"):
                 host = host_materialize(payload)
+            from .. import delta as delta_lib
             sleep = self._sleep
+            wire_v2 = delta_lib.is_packed_v2(host)
             try:
                 with obs.span("push.upload", miner=self.miner_id):
-                    call_with_retry(
-                        lambda: self.transport.publish_delta(self.miner_id,
-                                                             host),
-                        policy=self.publish_retry,
-                        describe=f"miner {self.miner_id} delta publish",
-                        **({"sleep": sleep} if sleep is not None else {}))
+                    if wire_v2:
+                        self._publish_v2(host)
+                    else:
+                        call_with_retry(
+                            lambda: self.transport.publish_delta(
+                                self.miner_id, host),
+                            policy=self.publish_retry,
+                            describe=f"miner {self.miner_id} delta publish",
+                            **({"sleep": sleep} if sleep is not None else {}))
             except Exception:
                 self.report.pushes_failed += 1
                 obs.count("publish.failed")
                 logger.exception("miner %s: delta push failed", self.miner_id)
                 return False
-            self._publish_meta(base_revision, cid)
+            self._publish_meta(base_revision, cid,
+                               wire=self.wire_spec if wire_v2 else None)
             self.report.pushes += 1
             obs.count("publish.pushes")
             logger.info("miner %s: pushed delta #%d", self.miner_id,
                         self.report.pushes)
             return True
 
-    def _publish_meta(self, base_revision, cid: str | None = None) -> None:
-        """Base-revision (+ correlation-id) rider next to the delta (see
-        transport/base.publish_delta_meta for the staleness protocol).
-        The delta-THEN-rider order makes the only inconsistent window
-        false-STALE, never false-fresh. Best-effort: a rider that fails
-        its whole retry budget heals at the next push cadence, so it is
-        logged, not counted as a failed push."""
+    # -- wire v2: changed shards, then the manifest --------------------------
+    def _publish_v2(self, packed: Params) -> None:
+        """Shard-addressed publish of one packed v2 tree: serialize +
+        hash every layer, upload ONLY the shards whose content hash
+        changed since the last round this publisher landed, then publish
+        the manifest. MANIFEST-LAST is the torn-set invariant: until the
+        manifest commits, readers hold the previous manifest, and any
+        already-overwritten shard fails its hash check instead of
+        decoding half-new (engine/ingest.py treats that as a transient
+        miss, exactly like a mid-rename publish race). ``_last_shards``
+        advances only after the manifest lands, so a failed publish
+        re-uploads everything unconfirmed next interval."""
+        from .. import delta as delta_lib
+        from .. import serialization as ser
+        from ..transport import base as tbase
+        from ..transport.retry import call_with_retry
+
+        sleep = self._sleep
+        kw = {"sleep": sleep} if sleep is not None else {}
+        t0 = time.perf_counter()
+        entries = delta_lib.packed_layer_entries(packed)
+        shards = {key: ser.pack_shard(e) for key, e in entries.items()}
+        layers = {key: (ser.shard_digest(data), len(data))
+                  for key, data in shards.items()}
+        manifest = ser.build_wire_manifest(
+            layers,
+            density=(self.wire_spec or {}).get("density", 0.0),
+            quant=(self.wire_spec or {}).get("quant", "int8"))
+        obs.observe("wire.encode_ms", (time.perf_counter() - t0) * 1e3)
+        changed = [key for key, (digest, _) in layers.items()
+                   if self._last_shards.get(key) != digest]
+        for key in changed:
+            data = shards[key]
+            call_with_retry(
+                lambda key=key, data=data: tbase.publish_shard(
+                    self.transport, self.miner_id, key, data),
+                policy=self.publish_retry,
+                describe=f"miner {self.miner_id} shard {key}", **kw)
+            obs.count("wire.bytes_published", len(data))
+        obs.count("wire.shards_uploaded", len(changed))
+        obs.count("wire.shards_skipped", len(shards) - len(changed))
+        pdr = getattr(self.transport, "publish_delta_raw", None)
+        publish_manifest = (pdr if pdr is not None
+                            else self.transport.publish_raw)
+        call_with_retry(
+            lambda: publish_manifest(self.miner_id, manifest),
+            policy=self.publish_retry,
+            describe=f"miner {self.miner_id} wire manifest publish", **kw)
+        obs.count("wire.bytes_published", len(manifest))
+        obs.count("wire.manifest_publishes")
+        self._last_shards = {key: digest
+                             for key, (digest, _) in layers.items()}
+
+    def _publish_meta(self, base_revision, cid: str | None = None,
+                      wire: dict | None = None) -> None:
+        """Base-revision (+ correlation-id, + wire-format declaration)
+        rider next to the delta (see transport/base.publish_delta_meta
+        for the staleness protocol). The delta-THEN-rider order makes the
+        only inconsistent window false-STALE, never false-fresh — and for
+        wire v2, never false-v2: a receiver that reads the old rider
+        simply decodes the (self-describing) manifest by its magic
+        instead. Best-effort: a rider that fails its whole retry budget
+        heals at the next push cadence, so it is logged, not counted as
+        a failed push."""
         from ..transport.retry import call_with_retry
 
         pm = getattr(self.transport, "publish_delta_meta", None)
-        if pm is None or (base_revision is None and cid is None):
+        if pm is None or (base_revision is None and cid is None
+                          and wire is None):
             return
         meta: dict = {}
         if base_revision is not None:
             meta["base_revision"] = base_revision
         if cid is not None:
             meta["delta_id"] = cid
+        if wire is not None:
+            meta["wire"] = wire
         sleep = self._sleep
         try:
             with obs.span("push.meta"):

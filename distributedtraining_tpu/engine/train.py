@@ -697,6 +697,9 @@ class MinerLoop:
                  nan_guard: bool = True,
                  delta_dtype: str | None = None,      # bf16/int8/sparse8 wire
                  delta_density: float = 1.0 / 64.0,   # sparse8 top-k density
+                 wire_v2: bool = False,               # shard-addressed wire
+                 wire_density: float = 1.0 / 64.0,    # v2 kept-coordinate ratio
+                 wire_quant: str = "int8",            # v2 kept-value dtype
                  checkpoint_store=None,
                  checkpoint_interval: float = 600.0,
                  val_batches=None,
@@ -739,6 +742,30 @@ class MinerLoop:
             raise ValueError(f"delta_density must be in (0, 1], "
                              f"got {delta_density}")
         self.delta_density = delta_density
+        # Wire v2 (ROADMAP item 1): top-k + int8 packed per-layer form,
+        # published as content-addressed shards + manifest
+        # (engine/publish.py) with a miner-side error-feedback residual
+        # (delta.pack_delta_v2). Orthogonal to --delta-dtype's bf16 cast
+        # but mutually exclusive with the v1 compressed forms — two lossy
+        # wire encodings stacked would compound rounding for no byte win.
+        self.wire_v2 = wire_v2
+        if wire_v2 and delta_dtype in ("int8", "sparse8"):
+            raise ValueError(
+                f"wire_v2 replaces the {delta_dtype!r} v1 wire format; "
+                "use --wire-density/--wire-quant to tune it instead")
+        if not 0.0 < wire_density <= 1.0:
+            raise ValueError(f"wire_density must be in (0, 1], "
+                             f"got {wire_density}")
+        if wire_quant not in delta_lib.WIRE_QUANTS:
+            raise ValueError(f"wire_quant must be one of "
+                             f"{delta_lib.WIRE_QUANTS}, got {wire_quant!r}")
+        self.wire_density = wire_density
+        self.wire_quant = wire_quant
+        # v2 error-feedback residual (WIRE layout, f32): the mass every
+        # previous publish dropped/rounded, re-offered to the next top-k
+        # selection. None until first v2 push; reset on base pulls (the
+        # cumulative delta it tracks resets there).
+        self._wire_residual = None
         # Reference semantics discard optimizer state on every base pull
         # (training_manager.py:371-377). ``keep_optimizer_on_pull=True``
         # carries the Adam moments across pulls instead (the standard
@@ -771,7 +798,9 @@ class MinerLoop:
         self._publisher = DeltaPublisher(
             transport, miner_id, report=self.report, nan_guard=nan_guard,
             queue_depth=push_queue_depth, sleep=self.clock.sleep,
-            publish_retry=publish_retry)
+            publish_retry=publish_retry,
+            wire_spec=({"format": 2, "density": wire_density,
+                        "quant": wire_quant} if wire_v2 else None))
         self._push_program_cache = None
         # device-resident copy of the newest step's loss; fetched to
         # report.last_loss only at log boundaries and loop exit (a per-step
@@ -944,6 +973,11 @@ class MinerLoop:
             # update (training_manager.py:371-377)
             self.state = self.engine.init_state(params=new_params)
         self.base_params = _snapshot(self.state.params)
+        # new base => the cumulative delta (and therefore the v2
+        # error-feedback residual tracking its unsent mass) restarts
+        # from zero; carrying the old residual would re-inject mass the
+        # merge already incorporated
+        self._wire_residual = None
         self._base_revision = rev
         self._last_base_time = self.clock.now()
         self._reset_val_guard()
@@ -1204,6 +1238,27 @@ class MinerLoop:
         wire_dtype = None if mode in ("int8", "sparse8") else mode
         density = self.delta_density
 
+        if self.wire_v2:
+            # v2 program: ``(params, base, residual) -> (packed,
+            # new_residual, finite)``. The error-feedback residual is a
+            # loop-carried state threaded THROUGH the one jitted
+            # dispatch — no extra program, no host round-trip; the
+            # finiteness flag screens the raw delta (a diverging miner
+            # must not launder NaNs through a finite-by-construction
+            # int8 encoding).
+            v2_density, v2_quant = self.wire_density, self.wire_quant
+
+            def snap_v2(params, base, residual):
+                d = delta_lib.compute_delta(params, base,
+                                            wire_dtype=wire_dtype)
+                finite = delta_lib.tree_finite(d)
+                packed, new_res = delta_lib.pack_delta_v2(
+                    wire_out(engine, d), density=v2_density, quant=v2_quant,
+                    residual=residual)
+                return packed, new_res, finite
+
+            return snap_v2
+
         def snap(params, base):
             d = delta_lib.compute_delta(params, base, wire_dtype=wire_dtype)
             finite = delta_lib.tree_finite(d)
@@ -1221,9 +1276,27 @@ class MinerLoop:
             self._push_program_cache = jax.jit(self._build_push_snapshot())
         return self._push_program_cache
 
+    def _wire_residual_zeros(self):
+        """f32 zeros in the WIRE layout — the first push's residual (and
+        the post-base-pull reset). Host numpy: jit lifts it on dispatch,
+        so no eager device alloc happens here."""
+        import numpy as np
+        return jax.tree_util.tree_map(
+            lambda x: np.zeros(np.shape(x), np.float32),
+            self._wire_template())
+
     def _push_snapshot(self):
         """Run the snapshot program on the CURRENT state (hook: the LoRA
         loop's program takes only the adapters)."""
+        if self.wire_v2:
+            if self._wire_residual is None:
+                self._wire_residual = self._wire_residual_zeros()
+            packed, new_res, finite = self._push_program()(
+                self.state.params, self.base_params, self._wire_residual)
+            # non-donated outputs: holding the new residual across later
+            # (donating) train steps is safe, same as the packed payload
+            self._wire_residual = new_res
+            return packed, finite
         return self._push_program()(self.state.params, self.base_params)
 
     def _push_delta(self) -> None:

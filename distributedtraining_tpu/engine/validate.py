@@ -53,6 +53,7 @@ class Validator:
                  metrics=None,
                  lora_cfg=None,
                  accept_quant: bool = True,
+                 accept_wire_v2: bool = True,
                  stale_deltas: str = "accept",
                  cohort_size: int = 8,
                  pipeline_depth: int = 1,
@@ -82,6 +83,9 @@ class Validator:
         # submissions are rejected instead of dequantized, and garbage
         # submissions skip the quarter-model quant-template alloc
         self.accept_quant = accept_quant
+        # wire-v2 shard-manifest submissions (engine/ingest.py decodes
+        # them shard-granularly); False = v1-only receiver posture
+        self.accept_wire_v2 = accept_wire_v2
         # staleness policy for submissions whose rider names a superseded
         # base. Default "accept" (reference parity): scoring a stale
         # delta vs the new base is noisy but informative, EMA smooths
@@ -274,6 +278,7 @@ class Validator:
                 lora_template=self._adapter_template,
                 quant_template=self._quant_template,
                 accept_quant=self.accept_quant,
+                accept_wire_v2=self.accept_wire_v2,
                 max_delta_abs=self.max_delta_abs,
                 stale_deltas=self.stale_deltas,
                 workers=self.ingest_workers,

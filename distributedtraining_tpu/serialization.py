@@ -293,6 +293,165 @@ def _parse_safetensors(data: bytes) -> dict[str, np.ndarray]:
 
 
 # ---------------------------------------------------------------------------
+# Wire v2 shard container (delta.pack_delta_v2's transport form)
+#
+# A v2 publish is N per-layer SHARDS (one packed {"idx","q","scale"}
+# entry each, msgpack) plus one small MANIFEST that addresses them by
+# sha256 content hash. The manifest travels as the miner's delta
+# artifact (so delta_revision/meta-rider/cache semantics are unchanged);
+# shards travel under the reserved __shard__ ids or a transport's own
+# publish_shard (transport/base.py). Content addressing is the
+# manifest's per-shard hash: ingest verifies every fetched shard against
+# it, which is both the dedupe key (unchanged layer -> zero bytes) and
+# the torn-publish guard (manifest-last ordering means a mid-publish
+# reader sees hash mismatches, never a half-new decode).
+# ---------------------------------------------------------------------------
+
+# manifest artifact prefix: deliberately NOT valid msgpack so the v1
+# decode try-chain can never half-accept a manifest, and detection is a
+# prefix compare on the first bytes
+WIRE_V2_MAGIC = b"DTWIRE2\n"
+# self-contained packed blob (manifest + shards folded into one payload)
+# — the pod-broadcast spelling, where every process must densify
+# identical bytes and per-layer fetch granularity has already been paid
+# by the coordinator
+WIRE_V2_BLOB_MAGIC = b"DTWIRE2B\n"
+# a manifest names one ~100-byte entry per wire tensor; 1 MiB covers
+# ~10k layers with headroom — anything bigger is hostile
+WIRE_MANIFEST_MAX_BYTES = 1 << 20
+_WIRE_MAX_LAYERS = 16384
+_WIRE_KEY_MAX = 512
+
+
+def shard_digest(data: bytes) -> str:
+    """Content address of one shard's bytes (sha256 hex — the same hash
+    family every transport already uses for revisions)."""
+    import hashlib
+    return hashlib.sha256(data).hexdigest()
+
+
+def pack_shard(entry: dict) -> bytes:
+    """One packed per-layer entry ``{"idx","q","scale"}`` -> shard bytes
+    (msgpack). The publisher's own data — malformed input raises."""
+    if not isinstance(entry, dict) or set(entry) != {"idx", "q", "scale"}:
+        raise ValueError("pack_shard: expected a {'idx','q','scale'} entry")
+    return flax_ser.msgpack_serialize(
+        {k: np.asarray(jax.device_get(v)) for k, v in entry.items()})
+
+
+def unpack_shard(data: bytes, *, max_bytes: int = DEFAULT_MAX_BYTES
+                 ) -> dict | None:
+    """Shard bytes -> packed entry, or None. Structural validation only
+    (key set, array fields); field-level validation against the base
+    template happens at assembly (delta._packed_tree_fields), where the
+    template's shapes are known."""
+    if len(data) > max_bytes:
+        return None
+    try:
+        raw = flax_ser.msgpack_restore(bytes(data))
+    except Exception:
+        return None
+    if not isinstance(raw, dict) or set(raw) != {"idx", "q", "scale"}:
+        return None
+    if not all(isinstance(v, np.ndarray) for v in raw.values()):
+        return None
+    return raw
+
+
+def build_wire_manifest(layers: dict[str, tuple[str, int]], *,
+                        density: float, quant: str) -> bytes:
+    """``{layer_key: (shard sha256, shard nbytes)}`` -> manifest bytes
+    (magic + canonical JSON). The publisher side of the contract in
+    docs/wire.md."""
+    import json
+    body = {"format": 2, "quant": quant, "density": density,
+            "layers": {str(k): {"h": h, "n": int(n)}
+                       for k, (h, n) in sorted(layers.items())}}
+    data = WIRE_V2_MAGIC + json.dumps(
+        body, sort_keys=True, separators=(",", ":")).encode()
+    if len(data) > WIRE_MANIFEST_MAX_BYTES:
+        raise PayloadError(f"wire manifest {len(data)} bytes exceeds cap "
+                           f"{WIRE_MANIFEST_MAX_BYTES}")
+    return data
+
+
+def is_wire_v2_manifest(data) -> bool:
+    return (isinstance(data, (bytes, bytearray, memoryview))
+            and bytes(data[:len(WIRE_V2_MAGIC)]) == WIRE_V2_MAGIC)
+
+
+def parse_wire_manifest(data: bytes) -> dict | None:
+    """PEER-CONTROLLED manifest bytes -> ``{"quant", "density",
+    "layers": {key: {"h": sha256-hex, "n": int}}}`` or None. Everything
+    is validated: magic, size cap, JSON shape, format number, layer
+    count/key/hash/size bounds — a manifest that parses can at worst
+    make ingest fetch bounded bytes that then fail their hash check."""
+    import json
+    if not is_wire_v2_manifest(data) or len(data) > WIRE_MANIFEST_MAX_BYTES:
+        return None
+    try:
+        body = json.loads(bytes(data[len(WIRE_V2_MAGIC):]).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(body, dict) or body.get("format") != 2:
+        return None
+    layers = body.get("layers")
+    if not isinstance(layers, dict) or len(layers) > _WIRE_MAX_LAYERS:
+        return None
+    out_layers = {}
+    for key, info in layers.items():
+        if not isinstance(key, str) or not 0 < len(key) <= _WIRE_KEY_MAX:
+            return None
+        if not isinstance(info, dict):
+            return None
+        h, n = info.get("h"), info.get("n")
+        if not (isinstance(h, str) and len(h) == 64
+                and all(c in "0123456789abcdef" for c in h)):
+            return None
+        if not (isinstance(n, int) and 0 <= n <= DEFAULT_MAX_BYTES):
+            return None
+        out_layers[key] = {"h": h, "n": n}
+    quant = body.get("quant")
+    density = body.get("density")
+    return {"quant": quant if isinstance(quant, str) else "int8",
+            "density": float(density)
+            if isinstance(density, (int, float)) else None,
+            "layers": out_layers}
+
+
+def pack_wire_blob(packed) -> bytes:
+    """Host packed v2 tree -> one self-contained payload (blob magic +
+    msgpack). Used where shard granularity has already been spent: the
+    pod coordinator reassembles a miner's shards once and broadcasts
+    this, and every process densifies identical bytes."""
+    return WIRE_V2_BLOB_MAGIC + to_msgpack(packed)
+
+
+def is_wire_v2_blob(data) -> bool:
+    return (isinstance(data, (bytes, bytearray, memoryview))
+            and bytes(data[:len(WIRE_V2_BLOB_MAGIC)]) == WIRE_V2_BLOB_MAGIC)
+
+
+def unpack_wire_blob(data: bytes, template: Params, *,
+                     max_bytes: int = DEFAULT_MAX_BYTES) -> Params | None:
+    """Blob bytes -> dense f32 host delta validated against ``template``,
+    or None (the same contract as the other wire-format decoders)."""
+    from . import delta as _delta
+
+    if not is_wire_v2_blob(data):
+        return None
+    try:
+        raw = from_msgpack(bytes(data[len(WIRE_V2_BLOB_MAGIC):]), None,
+                           max_bytes=max_bytes)
+    except PayloadError:
+        return None
+    try:
+        return _delta.densify_packed_v2(raw, template)
+    except (TypeError, ValueError, KeyError, IndexError):
+        return None
+
+
+# ---------------------------------------------------------------------------
 # Validated file IO (the transport layer calls these)
 # ---------------------------------------------------------------------------
 

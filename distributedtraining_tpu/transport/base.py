@@ -46,6 +46,20 @@ HEARTBEAT_PREFIX = "__hb__"
 # today only the averager's base publication is single-writer.
 LEASE_PREFIX = "__lease__"
 
+# Wire-v2 per-layer delta shards (serialization.py shard container,
+# engine/publish.py uploads, engine/ingest.py fetches): each shard is
+# raw bytes under a reserved per-(miner, layer) id, so every byte-capable
+# transport carries them through its existing publish_raw /
+# fetch_delta_bytes surface with zero new backend code. The id is
+# LAYER-stable (a re-publish of a layer overwrites its previous shard —
+# the same storage-bounding overwrite rule as every other artifact);
+# the CONTENT address lives in the signed/validated manifest's per-shard
+# sha256, which ingest verifies on every fetch. Transports with a richer
+# namespace (HF Hub: one repo per miner) may implement
+# publish_shard/fetch_shard methods instead; the module helpers below
+# prefer those.
+SHARD_PREFIX = "__shard__"
+
 
 def heartbeat_id(role: str, node_id: str) -> str:
     """The reserved per-node artifact id heartbeats publish under.
@@ -64,12 +78,59 @@ def lease_id(role: str = "averager") -> str:
     return f"{LEASE_PREFIX}.{role}"
 
 
+def shard_layer_slug(layer_key: str) -> str:
+    """Filename/id-safe spelling of a manifest layer key ("/"-joined
+    state-dict path). Path components never contain "/" themselves
+    (delta.packed_layer_entries enforces it at pack time), so the "."
+    join is unambiguous in practice."""
+    return layer_key.replace("/", ".")
+
+
+def shard_id(hotkey: str, layer_key: str) -> str:
+    """The reserved artifact id one miner's per-layer shard travels
+    under on id-namespace transports (localfs, memory)."""
+    return f"{SHARD_PREFIX}.{hotkey}.{shard_layer_slug(layer_key)}"
+
+
+def is_shard_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(SHARD_PREFIX + ".")
+
+
 def is_reserved_id(artifact_id: str) -> bool:
-    """True for any id in the reserved control-plane namespace (heartbeats,
-    leases) — delta consumers must never stage these as submissions."""
+    """True for any id in the reserved control-plane/shard namespace
+    (heartbeats, leases, wire-v2 shards) — delta consumers must never
+    stage these as submissions."""
     return isinstance(artifact_id, str) and (
         artifact_id.startswith(HEARTBEAT_PREFIX + ".")
-        or artifact_id.startswith(LEASE_PREFIX + "."))
+        or artifact_id.startswith(LEASE_PREFIX + ".")
+        or artifact_id.startswith(SHARD_PREFIX + "."))
+
+
+def publish_shard(transport, hotkey: str, layer_key: str,
+                  data: bytes) -> None:
+    """Publish one shard through whatever surface ``transport`` offers:
+    its own ``publish_shard`` method when present (HF Hub stores a file
+    per layer inside the miner's repo), else ``publish_raw`` under the
+    reserved shard id. Wrappers (signed/chaos) delegate explicitly so
+    the inner transport's preference survives the wrapping."""
+    ps = getattr(transport, "publish_shard", None)
+    if ps is not None:
+        ps(hotkey, layer_key, data)
+        return
+    transport.publish_raw(shard_id(hotkey, layer_key), data)
+
+
+def fetch_shard(transport, hotkey: str, layer_key: str) -> bytes | None:
+    """Fetch one shard's raw bytes (or None). Integrity is NOT this
+    layer's job — callers verify the bytes against the manifest's
+    content hash (engine/ingest.py), which is what makes unsigned shard
+    transport safe under SignedTransport: the hash rides the signed
+    manifest."""
+    fs = getattr(transport, "fetch_shard", None)
+    if fs is not None:
+        return fs(hotkey, layer_key)
+    return transport.fetch_delta_bytes(shard_id(hotkey, layer_key))
 
 
 def encode_delta_meta(meta: dict) -> bytes:
@@ -107,6 +168,15 @@ class Transport(Protocol):
         delta bytes — SignedTransport publishes through this, and the load
         generator uses it to simulate miners that don't run our code."""
         ...
+
+    # OPTIONAL (wrappers only; callers fall back to publish_raw via
+    # getattr): bytes that ARE this node's own delta artifact — the
+    # wire-v2 manifest publish goes through here so SignedTransport can
+    # envelope it under the delta context exactly like a publish_delta,
+    # while plain transports treat it as publish_raw. Distinct from
+    # publish_raw, whose contract is "pass hostile bytes through
+    # untouched".
+    # def publish_delta_raw(self, miner_id: str, data: bytes) -> Revision
 
     # -- validator / averager side -----------------------------------------
     def fetch_delta(self, miner_id: str, template: Params) -> Params | None:

@@ -230,6 +230,32 @@ class ChaosTransport:
         self._gate("publish", miner_id)
         return self.inner.publish_raw(miner_id, data)
 
+    def publish_delta_raw(self, miner_id: str, data: bytes):
+        self._gate("publish", miner_id)
+        pdr = getattr(self.inner, "publish_delta_raw", None)
+        if pdr is not None:
+            return pdr(miner_id, data)
+        return self.inner.publish_raw(miner_id, data)
+
+    # wire-v2 shard ops: each shard publish/fetch is its own faultable
+    # operation (that is exactly how a mid-publish failure tears a shard
+    # set — the torn-set test drives this gate)
+    def publish_shard(self, hotkey: str, layer_key: str, data: bytes):
+        from . import base
+        self._gate("publish", hotkey)
+        ps = getattr(self.inner, "publish_shard", None)
+        if ps is not None:
+            return ps(hotkey, layer_key, data)
+        return self.inner.publish_raw(base.shard_id(hotkey, layer_key), data)
+
+    def fetch_shard(self, hotkey: str, layer_key: str):
+        from . import base
+        self._gate("fetch", hotkey)
+        fs = getattr(self.inner, "fetch_shard", None)
+        if fs is not None:
+            return fs(hotkey, layer_key)
+        return self.inner.fetch_delta_bytes(base.shard_id(hotkey, layer_key))
+
     def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
         self._gate("publish", miner_id)
         pm = getattr(self.inner, "publish_delta_meta", None)

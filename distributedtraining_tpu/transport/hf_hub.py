@@ -176,6 +176,24 @@ class HFHubTransport:
         repo = self.my_repo_id or miner_id
         self._upload_bytes(repo, META_FILE, encode_delta_meta(meta))
 
+    # -- wire-v2 shards ------------------------------------------------------
+    # The Hub's namespace is a repo per miner, so shards are FILES inside
+    # the miner's own repo (shards/<layer>.msgpack) rather than reserved
+    # top-level artifact ids — same per-layer overwrite semantics, and
+    # the repo's history squash (gc) bounds their storage exactly like
+    # the delta file's.
+    def _shard_file(self, layer_key: str) -> str:
+        from .base import shard_layer_slug
+        return f"shards/{shard_layer_slug(layer_key)}.msgpack"
+
+    def publish_shard(self, hotkey: str, layer_key: str,
+                      data: bytes) -> None:
+        repo = self.my_repo_id or hotkey
+        self._upload_bytes(repo, self._shard_file(layer_key), data)
+
+    def fetch_shard(self, hotkey: str, layer_key: str) -> bytes | None:
+        return self._download_bytes(hotkey, self._shard_file(layer_key))
+
     def fetch_delta_meta(self, miner_id: str) -> dict | None:
         from .base import META_MAX_BYTES, parse_delta_meta
         return parse_delta_meta(self._download_bytes(

@@ -33,6 +33,20 @@ _DELTA_FMT = "%s.msgpack"
 _META_FMT = "%s.meta.json"
 _BASE_NAME = "averaged_model.msgpack"
 
+# roots of every transport constructed in this process — the conftest
+# shard-hygiene guard scans them for leaked *.tmp files after each test
+# module (a .tmp that outlives its publish means a write path skipped
+# the atomic tmp+rename discipline or died between the two steps and
+# nobody cleaned up). Paths, not objects: a root outliving its
+# transport is exactly the case the guard wants to see.
+_LIVE_ROOTS: set = set()
+
+
+def live_roots() -> list[str]:
+    """Roots of every LocalFSTransport this process has constructed that
+    still exist on disk (test-hygiene introspection)."""
+    return [r for r in sorted(_LIVE_ROOTS) if os.path.isdir(r)]
+
 
 def _hash_file(path: str) -> Revision:
     if not os.path.exists(path):
@@ -77,6 +91,7 @@ class LocalFSTransport:
         self._rev_cache: dict[str, tuple[tuple, str]] = {}
         os.makedirs(os.path.join(root, "deltas"), exist_ok=True)
         os.makedirs(os.path.join(root, "base"), exist_ok=True)
+        _LIVE_ROOTS.add(os.path.abspath(root))
 
     def _revision_of(self, path: str) -> Revision:
         try:

@@ -91,6 +91,40 @@ class SignedTransport:
         bytes on purpose — utils/loadgen.py)."""
         return self.inner.publish_raw(miner_id, data)
 
+    def publish_delta_raw(self, miner_id: str, data: bytes) -> Revision:
+        """This node's OWN delta artifact as pre-built bytes (the wire-v2
+        manifest): enveloped under the delta context exactly like
+        publish_delta, so receivers verify it against this hotkey's
+        registered key."""
+        if self.identity is not None:
+            data = signing.wrap(data, self.identity,
+                                signing.delta_context(miner_id))
+        return self.inner.publish_raw(miner_id, data)
+
+    # -- wire-v2 shards ------------------------------------------------------
+    # Shards travel UNSIGNED: their integrity is the sha256 the (signed)
+    # manifest carries, which ingest verifies on every fetch — enveloping
+    # each of ~150 per-layer shards would buy nothing the manifest hash
+    # doesn't already pin, and would break strict-mode fleets whose shard
+    # ids have no registered keys. Explicit delegation keeps the inner
+    # transport's own shard surface (HF Hub's file-per-layer) reachable
+    # through the wrapper.
+    def publish_shard(self, hotkey: str, layer_key: str,
+                      data: bytes) -> None:
+        from . import base
+        sp = getattr(self.inner, "publish_shard", None)
+        if sp is not None:
+            sp(hotkey, layer_key, data)
+            return
+        self.inner.publish_raw(base.shard_id(hotkey, layer_key), data)
+
+    def fetch_shard(self, hotkey: str, layer_key: str) -> bytes | None:
+        from . import base
+        fs = getattr(self.inner, "fetch_shard", None)
+        if fs is not None:
+            return fs(hotkey, layer_key)
+        return self.inner.fetch_delta_bytes(base.shard_id(hotkey, layer_key))
+
     # -- validator / averager side -----------------------------------------
     def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
         raw = self.inner.fetch_delta_bytes(miner_id)

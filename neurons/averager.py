@@ -81,6 +81,7 @@ def main(argv=None) -> int:
                         max_delta_abs=cfg.max_delta_abs,
                         metrics=c.metrics, lora_cfg=c.lora_cfg,
                         accept_quant=cfg.accept_quant,
+                        accept_wire_v2=cfg.accept_wire_v2,
                         stale_deltas=cfg.stale_deltas or "skip",
                         publish_policy=cfg.publish_policy,
                         ingest_workers=cfg.ingest_workers,

@@ -114,6 +114,9 @@ def main(argv=None) -> int:
                          delta_dtype=(None if cfg.delta_dtype == "float32"
                                       else cfg.delta_dtype),
                          delta_density=cfg.delta_density,
+                         wire_v2=cfg.wire_v2,
+                         wire_density=cfg.wire_density,
+                         wire_quant=cfg.wire_quant,
                          keep_optimizer_on_pull=cfg.keep_optimizer_on_pull,
                          checkpoint_store=store,
                          checkpoint_interval=cfg.checkpoint_interval,

@@ -39,6 +39,7 @@ def main(argv=None) -> int:
                           max_delta_abs=cfg.max_delta_abs,
                           metrics=c.metrics, lora_cfg=c.lora_cfg,
                           accept_quant=cfg.accept_quant,
+                          accept_wire_v2=cfg.accept_wire_v2,
                           stale_deltas=cfg.stale_deltas or "accept",
                           cohort_size=cfg.val_cohort,
                           pipeline_depth=cfg.val_pipeline_depth,

@@ -38,8 +38,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "hotkey", "beats", "age_s", "step_rate", "loss_ema",
-           "published", "accepted", "declined", "stale_rounds", "score",
-           "quar", "slo")
+           "published", "accepted", "declined", "stale_rounds", "wire_b",
+           "score", "quar", "slo")
 
 
 def build_report(paths: list[str]) -> dict:
@@ -121,6 +121,17 @@ def _cell(node: dict, col: str) -> str:
     if col == "age_s":
         v = node.get("last_seen_age_s")
         return "-" if v is None else f"{v:.1f}"
+    if col == "wire_b":
+        # transport bytes the monitor role fetched staging this miner
+        # (engine/health.py ledger) — human-scaled: the whole point of
+        # the v2 wire is making this column small
+        v = node.get("wire_bytes")
+        if v is None:
+            return "-"
+        for unit, div in (("G", 1 << 30), ("M", 1 << 20), ("k", 1 << 10)):
+            if v >= div:
+                return f"{v / div:.1f}{unit}"
+        return str(int(v))
     if col == "quar":
         if node.get("quarantined"):
             return "Q"

@@ -114,6 +114,37 @@ def _no_leaked_health_plane():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _no_leaked_localfs_tmp():
+    """Shard-publish hygiene (the wire-v2 shard container rides the
+    localfs transport's publish_raw): every localfs artifact write —
+    deltas, bases, SHARDS, manifests — must follow the tmp + fsync +
+    rename discipline, so a ``*.tmp`` file still present after a module
+    means a publish path died between the two steps (torn-publish
+    debris) or bypassed the atomic write altogether. A leaked tmp from
+    a mid-publish kill is exactly the artifact a reader must never
+    decode; fail the module that produced it. Scans every transport
+    root this process constructed (localfs.live_roots)."""
+    yield
+    import glob as _glob
+
+    from distributedtraining_tpu.transport import localfs
+
+    leaked = []
+    for root in localfs.live_roots():
+        for sub in ("deltas", "base"):
+            leaked += _glob.glob(os.path.join(root, sub, "*.tmp"))
+    for path in leaked:   # force-clean so one offender cannot cascade
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    assert not leaked, (
+        f"test module leaked partially-published artifact temp files: "
+        f"{leaked}; localfs writes must go through the atomic "
+        "tmp+fsync+rename path (serialization.save_file / _write_atomic)")
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _no_leaked_obs_state():
     """Observability hygiene (mirrors the thread-leak guard above): the
     span/metric layer (utils/obs.py) is PROCESS-WIDE state — a test that
