@@ -624,7 +624,7 @@ def sparsify_delta(delta: Params, *, density: float = 1.0 / 64.0) -> Params:
         if k >= n:
             idx = jnp.arange(n, dtype=jnp.int32)
             kept = flat
-            top_mag = jnp.max(jnp.abs(flat))
+            top_mag = jnp.max(jnp.abs(flat), initial=0.0)
         else:
             top_mag_all, idx = jax.lax.top_k(jnp.abs(flat), k)
             idx = idx.astype(jnp.int32)
@@ -659,11 +659,11 @@ def _validate_packed_entry(entry, n: int, *,
     """Field-wise validation of one top-k packed leaf entry
     ``{"idx", "q", "scale"}`` against a template leaf of ``n`` elements —
     everything an attacker controls: key set, dtypes (idx int32, q in
-    ``q_dtypes``, scale f32 scalar), k <= n, finite scale, index bounds.
-    Returns host ``(idx, q, scale)`` or None. Shared by the sparse8
-    densifier (int8 q only, its historical contract) and the v2 packed
-    wire (int8 or f32 kept values), so the formats cannot drift apart in
-    what they accept."""
+    ``q_dtypes``, scale f32 scalar), k <= n, finite non-negative scale,
+    index bounds. Returns host ``(idx, q, scale)`` or None. Shared by the
+    sparse8 densifier (int8 q only, its historical contract) and the v2
+    packed wire (int8 or f32 kept values), so the formats cannot drift
+    apart in what they accept."""
     if not isinstance(entry, dict) or set(entry) != {"idx", "q", "scale"}:
         return None
     idx, q, scale = (np.asarray(entry["idx"]), np.asarray(entry["q"]),
@@ -673,7 +673,11 @@ def _validate_packed_entry(entry, n: int, *,
         return None
     if idx.ndim != 1 or q.ndim != 1 or scale.shape != ():
         return None
-    if not np.isfinite(scale):
+    if not np.isfinite(scale) or scale < 0:
+        # every honest encoder emits scale >= 0 (max|kept|/127, or the
+        # pinned 1.0 under quant="none"); a negative scale would flip the
+        # sign of max|q|*scale in the packed magnitude screen and smuggle
+        # arbitrarily large decoded values past the max_delta_abs cap
         return None
     if idx.shape[0] == 0 and q.shape[0] == n and n > 0:
         # DENSE-form entry (k == n): the index array would be arange(n),
@@ -873,10 +877,11 @@ def pack_delta_v2(delta: Params, *, density: float = 1.0 / 64.0,
         if dense_form:
             # DENSE-form entry: empty idx, full q (the idx array would be
             # arange(n) — 4 redundant bytes per coordinate on exactly the
-            # below-cutoff tensors where every coordinate ships)
+            # below-cutoff tensors where every coordinate ships).
+            # initial=0 keeps the max defined on zero-element leaves
             idx = jnp.zeros((0,), jnp.int32)
             kept = flat
-            top_mag = jnp.max(jnp.abs(flat))
+            top_mag = jnp.max(jnp.abs(flat), initial=0.0)
         else:
             top_mag_all, idx = jax.lax.top_k(jnp.abs(flat), k)
             idx = idx.astype(jnp.int32)
@@ -993,9 +998,12 @@ def _packed_screen_stats(*packed_leaves) -> tuple[jax.Array, jax.Array]:
     v2 leaves-trees — the packed twin of ``_cohort_screen_stats``, fused
     the same way. No densify: int8 kept values are finite by
     construction, so finiteness is the scales' (plus f32 kept values',
-    under quant="none"); the decoded max is ``max|q| * scale`` per
-    tensor exactly (scale >= 0), so the magnitude verdict matches the
-    dense screen on the densified tree. Returns ([K] bool, [K] f32)."""
+    under quant="none"); the decoded max is ``max|q| * |scale|`` per
+    tensor exactly — the abs covers the scale too, not just q, so a
+    hostile negative scale (rejected at admission, but this program must
+    not depend on that) cannot drive the verdict negative and under the
+    magnitude cap. Matches the dense screen on the densified tree.
+    Returns ([K] bool, [K] f32)."""
     fins, maxs = [], []
     for leaves in packed_leaves:
         entries = jax.tree_util.tree_leaves(leaves, is_leaf=is_packed_entry)
@@ -1006,7 +1014,7 @@ def _packed_screen_stats(*packed_leaves) -> tuple[jax.Array, jax.Array]:
                 flags.append(jnp.any(~jnp.isfinite(e["q"])))
             if e["q"].size:
                 mags.append(jnp.max(jnp.abs(e["q"].astype(jnp.float32)))
-                            * e["scale"])
+                            * jnp.abs(e["scale"]))
         fins.append(jnp.logical_not(jnp.any(jnp.stack(flags)))
                     if flags else jnp.asarray(True))
         maxs.append(jnp.max(jnp.stack(mags)) if mags

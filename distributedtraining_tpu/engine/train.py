@@ -1255,6 +1255,15 @@ class MinerLoop:
                 packed, new_res = delta_lib.pack_delta_v2(
                     wire_out(engine, d), density=v2_density, quant=v2_quant,
                     residual=residual)
+                # a non-finite delta must not poison the loop-carried
+                # residual: new_res = delta + residual - decoded carries
+                # the NaN, and tree_finite screens only the raw delta, so
+                # one transient divergence would contaminate every later
+                # publish until the next base pull. Keep the old residual
+                # when the guard verdict is bad.
+                new_res = jax.tree_util.tree_map(
+                    lambda nr, r: jnp.where(finite, nr, r),
+                    new_res, residual)
                 return packed, new_res, finite
 
             return snap_v2

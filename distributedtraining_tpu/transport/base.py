@@ -80,10 +80,13 @@ def lease_id(role: str = "averager") -> str:
 
 def shard_layer_slug(layer_key: str) -> str:
     """Filename/id-safe spelling of a manifest layer key ("/"-joined
-    state-dict path). Path components never contain "/" themselves
-    (delta.packed_layer_entries enforces it at pack time), so the "."
-    join is unambiguous in practice."""
-    return layer_key.replace("/", ".")
+    state-dict path). Injective: literal "%" and "." inside components
+    are percent-escaped BEFORE "/" maps to ".", so keys like "a/b.c"
+    and "a/b/c" get distinct shard ids instead of silently overwriting
+    each other's shards (components never contain "/" themselves —
+    delta.packed_layer_entries enforces it at pack time)."""
+    return (layer_key.replace("%", "%25").replace(".", "%2E")
+            .replace("/", "."))
 
 
 def shard_id(hotkey: str, layer_key: str) -> str:

@@ -254,6 +254,54 @@ def test_wire_blob_round_trip():
                                 _template(delta)) is None
 
 
+def test_negative_scale_is_rejected_everywhere():
+    """A hostile NEGATIVE scale must not slip under the magnitude cap:
+    |q| * scale with scale < 0 would give a negative screen verdict
+    while densifying to arbitrarily large |values|. Admission, densify,
+    the cohort screen, and the sparse8 densifier all refuse it, and the
+    fused screen's magnitude is sign-robust even on unvalidated input."""
+    delta = _tree()
+    base = _template(delta)
+    p = jax.device_get(dl.pack_delta_v2(delta, density=1 / 64)[0])
+    p["leaves"]["wte"]["scale"] = np.float32(-1e6)
+    assert not dl.packed_matches(p, base)
+    assert dl.densify_packed_v2(p, base) is None
+    assert dl.screen_deltas([p], base, max_abs=1.0) == [
+        (False, "shape_mismatch")]
+    # defense in depth: even without the admission gate, the screen's
+    # magnitude uses |scale| — the verdict cannot go negative
+    _, mags = dl._packed_screen_stats(p["leaves"])
+    assert float(mags[0]) > 1.0
+    # the shared validator covers the v1 sparse8 wire too
+    sp = jax.device_get(dl.sparsify_delta(delta, density=1 / 64))
+    sp["leaves"]["wte"]["scale"] = np.float32(-1.0)
+    assert dl.densify_sparse_delta(sp, base) is None
+
+
+def test_empty_leaf_packs_and_round_trips():
+    """A zero-element tensor (n == 0 forces the dense-form branch) must
+    encode, screen, and decode — not crash the publish path on an empty
+    jnp.max reduction."""
+    delta = {"w": (np.random.RandomState(0).randn(300, 40)
+                   * 0.01).astype(np.float32),
+             "empty": np.zeros((0,), np.float32)}
+    base = _template(delta)
+    packed, res = dl.pack_delta_v2(delta, density=1 / 64)
+    packed = jax.device_get(packed)
+    assert np.shape(jax.device_get(res)["empty"]) == (0,)
+    assert dl.packed_matches(packed, base)
+    dec = dl.densify_packed_v2(packed, base)
+    assert dec["empty"].shape == (0,)
+    np.testing.assert_array_equal(
+        dec["w"], dl.densify_sparse_delta(
+            jax.device_get(dl.sparsify_delta(delta, density=1 / 64)),
+            base)["w"])
+    assert dl.screen_deltas([packed], base, max_abs=1e3) == [(True, "ok")]
+    # sparse8 (v1) tolerates the empty leaf too
+    sp = jax.device_get(dl.sparsify_delta(delta, density=1 / 64))
+    assert dl.densify_sparse_delta(sp, base)["empty"].shape == (0,)
+
+
 def test_hostile_layer_keys_fail_template_validation():
     delta = _tree()
     packed = jax.device_get(dl.pack_delta_v2(delta, density=1 / 64)[0])
@@ -649,6 +697,42 @@ def test_delta_cache_shard_budget_and_eviction():
     assert cache.nbytes == 0 and cache.shard_lookup("b" * 64) is None
 
 
+def test_shard_slug_injective_for_dotted_layer_keys(tmp_path):
+    """Layer keys containing '.' must not collide with '/'-separated
+    ones after the slug join ('a/b.c' vs 'a/b/c'): a collision makes the
+    publisher silently overwrite one layer's shard with the other and
+    ingest fail that layer's hash check every round."""
+    keys = ["a/b.c", "a/b/c", "a.b/c", "a/b%c", "a/b%2Ec", "a.b.c"]
+    slugs = [tbase.shard_layer_slug(k) for k in keys]
+    assert len(set(slugs)) == len(keys), slugs
+    assert len({tbase.shard_id("m0", k) for k in keys}) == len(keys)
+
+    # end to end: a model with a dotted parameter name publishes both
+    # layers and stages them back intact
+    rs = np.random.RandomState(0)
+    delta = {"a": {"b.c": (rs.randn(64) * 0.01).astype(np.float32),
+                   "b": {"c": (rs.randn(64) * 0.02).astype(np.float32)}}}
+    template = _template(delta)
+    transport = CountingFS(str(tmp_path / "fs"))
+    pub = _v2_publisher(transport, "m0")
+    ing = _ingestor(transport, template)
+    try:
+        packed = jax.device_get(dl.pack_delta_v2(delta, density=1 / 64)[0])
+        assert len(dl.packed_layer_entries(packed)) == 2
+        assert pub.publish_now(packed, None, "r0")
+        # two distinct shard artifacts landed (plus the manifest)
+        assert len([m for m, _ in transport.published
+                    if tbase.is_shard_id(m)]) == 2
+        s = ing.stage(["m0"])[0]
+        assert s.ok, s.reason
+        ref = dl.densify_packed_v2(packed, template)
+        for a, b in zip(_leaves(s.delta), _leaves(ref)):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        ing.close()
+        pub.close()
+
+
 def test_reserved_shard_ids_and_localfs_roots(tmp_path):
     from distributedtraining_tpu.transport import localfs
 
@@ -695,6 +779,57 @@ def test_miner_loop_snapshot_carries_residual(tmp_path):
     transport.publish_base(jax.device_get(loop.state.params))
     loop._check_pull()
     assert loop._wire_residual is None
+    loop.flush()
+
+
+def test_nonfinite_delta_does_not_poison_residual(tmp_path):
+    """A transient non-finite delta is skipped by the nan guard AND the
+    loop-carried error-feedback residual keeps its pre-divergence value
+    (new_res = delta + residual - decoded would smear the NaN into every
+    later publish until the next base pull). After the miner recovers,
+    the next publish is clean and stages."""
+    from distributedtraining_tpu.engine.train import (MinerLoop,
+                                                      TrainEngine,
+                                                      host_wire_template)
+    from distributedtraining_tpu.models import gpt2
+
+    model, cfg = gpt2.make_model(gpt2.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=16, n_layer=1, n_head=2))
+    engine = TrainEngine(model, seq_len=16)
+    transport = CountingFS(str(tmp_path / "fs"))
+    loop = MinerLoop(engine, transport, "m0", send_interval=1e9,
+                     push_async=False, wire_v2=True, wire_density=1 / 64)
+    loop.bootstrap(rng=jax.random.PRNGKey(0))
+    # drift params so the first (healthy) push leaves a real residual
+    loop.state = loop.state.replace(params=jax.tree_util.tree_map(
+        lambda x: x + 0.01, loop.state.params))
+    healthy = loop.state
+    loop._push_delta()
+    res_before = jax.device_get(loop._wire_residual)
+    assert all(np.isfinite(l).all() for l in _leaves(res_before))
+
+    # transient divergence: NaN params -> the guard skips the push and
+    # the residual must NOT commit the contaminated update
+    published = len(transport.published)
+    loop.state = loop.state.replace(params=jax.tree_util.tree_map(
+        lambda x: jax.numpy.full_like(x, np.nan), loop.state.params))
+    loop._push_delta()
+    assert len(transport.published) == published      # push skipped
+    res_after = jax.device_get(loop._wire_residual)
+    for a, b in zip(_leaves(res_before), _leaves(res_after)):
+        np.testing.assert_array_equal(a, b)
+
+    # recovery: the very next healthy publish is finite and stages
+    loop.state = healthy
+    loop._push_delta()
+    assert len(transport.published) > published
+    ing = _ingestor(transport, host_wire_template(engine))
+    try:
+        s = ing.stage(["m0"])[0]
+        assert s.ok, s.reason
+        assert all(np.isfinite(l).all() for l in _leaves(s.delta))
+    finally:
+        ing.close()
     loop.flush()
 
 
