@@ -607,6 +607,190 @@ def _time_wire_v2(*, trials: int = 2) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _time_hier_average(*, n_miners: int = 32, fanout: int = 4,
+                       trials: int = 2) -> dict:
+    """Hierarchical averager A/B (round-13 tentpole): the flat
+    single-node merge (one node stages + merges EVERY miner, the
+    reference topology) vs a fanout-``fanout`` tree
+    (engine/hier_average.py: each sub-averager stages + folds + publishes
+    its slice, the root stages + merges the partial aggregates), over
+    localfs on the IDENTICAL mixed v1/v2 submissions.
+
+      hier_flat_node_ms        one flat round: stage all miners + merge
+      hier_sub_node_ms         slowest sub-averager round (stage slice +
+                               fold + publish the aggregate)
+      hier_root_node_ms        root round: stage aggregates + merge
+      hier_per_node_ms         max(sub, root) — the tree's critical node
+      hier_worknode_reduction  flat / per-node (acceptance: >= 2 at
+                               n_miners/fanout >= 2 subtrees)
+      hier_parity              root merge == flat weighted merge of the
+                               same set (fp tolerance)
+      hier_packed_peak_delta_bytes / hier_packed_stack_free
+                               device peak-bytes growth across an
+                               all-packed scatter-add aggregate of every
+                               miner vs the M x params stack it must NOT
+                               materialize (None when the backend
+                               exposes no memory stats — CPU; the
+                               structural pin lives in
+                               tests/test_hier_average.py)
+
+    CPU-measurable: per-node cost is transport fetch + decode + screen +
+    merge arithmetic over that node's slice — host work that shrinks
+    with the slice on every backend."""
+    import shutil
+    import tempfile
+
+    from distributedtraining_tpu import delta as delta_lib
+    from distributedtraining_tpu.engine.hier_average import (SubAverager,
+                                                             plan_fanout)
+    from distributedtraining_tpu.engine.ingest import DeltaIngestor
+    from distributedtraining_tpu.engine.publish import DeltaPublisher
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import LocalFSTransport
+    from distributedtraining_tpu.transport.base import agg_id
+    from distributedtraining_tpu.utils.metrics import device_memory_watermarks
+
+    model, _ = gpt2.make_model("tiny")
+    template = jax.tree_util.tree_map(
+        lambda x: np.zeros(x.shape, np.float32),
+        jax.eval_shape(lambda: model.init_params(jax.random.PRNGKey(0))))
+
+    class Report:
+        pushes = pushes_failed = pushes_superseded = 0
+
+    tmp = tempfile.mkdtemp(prefix="hier_bench_")
+    try:
+        transport = LocalFSTransport(tmp)
+        transport.publish_base(template)
+        hotkeys = [f"m{i:02d}" for i in range(n_miners)]
+        rs = np.random.RandomState(0)
+        consensus = {h: float(rs.uniform(0.5, 2.0)) for h in hotkeys}
+        deltas = {}
+        packed_all = []
+        for i, h in enumerate(hotkeys):
+            d = jax.tree_util.tree_map(
+                lambda x: (np.random.RandomState(i).randn(*np.shape(x))
+                           * 0.01).astype(np.float32), template)
+            deltas[h] = d
+            p = jax.device_get(delta_lib.pack_delta_v2(d,
+                                                       density=1 / 64)[0])
+            packed_all.append(p)
+            if i % 4 == 0:   # every 4th miner publishes on the v2 wire
+                pub = DeltaPublisher(
+                    transport, h, report=Report(),
+                    wire_spec={"format": 2, "density": 1 / 64,
+                               "quant": "int8"})
+                try:
+                    assert pub.publish_now(p, None, None)
+                finally:
+                    pub.close()
+                deltas[h] = delta_lib.densify_packed_v2(p, template)
+            else:
+                transport.publish_delta(h, d)
+
+        plan = plan_fanout(hotkeys, fanout=fanout)
+        nodes = sorted(plan)
+        subs = {n: SubAverager(transport, n, template, plan[n],
+                               consensus=consensus, ingest_cache_mb=0,
+                               ingest_workers=4) for n in nodes}
+        flat_ing = DeltaIngestor(transport, template, workers=4,
+                                 cache_bytes=0, max_delta_abs=1e3)
+        root_ing = DeltaIngestor(transport, template, workers=4,
+                                 cache_bytes=0, max_delta_abs=1e3)
+        try:
+            def flat_round():
+                staged = {s.hotkey: s for s in flat_ing.stage(hotkeys)
+                          if s.ok}
+                ids = sorted(staged)
+                w = delta_lib.normalized_merge_weights(ids, consensus)
+                agg = delta_lib.aggregate_deltas(
+                    template, [staged[h].delta for h in ids], w)
+                return jax.block_until_ready(agg), len(ids)
+
+            def root_round():
+                staged = [s for s in root_ing.stage(
+                    [agg_id(n) for n in nodes]) if s.ok]
+                ids = [s.hotkey for s in staged]
+                cons = {s.hotkey: (s.agg_weight if s.agg_weight is not None
+                                   else 1.0) for s in staged}
+                w = delta_lib.normalized_merge_weights(ids, cons)
+                agg = delta_lib.aggregate_deltas(
+                    template, [s.delta for s in staged], w)
+                return jax.block_until_ready(agg), len(ids)
+
+            # warm every compile + publish the first aggregates
+            flat_round()
+            for n in nodes:
+                assert subs[n].run_round() is True
+            root_round()
+
+            flat_ms, sub_ms, root_ms = [], [], []
+            flat_agg = root_agg = None
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                flat_agg, n_flat = flat_round()
+                flat_ms.append((time.perf_counter() - t0) * 1e3)
+                worst = 0.0
+                for n in nodes:
+                    t0 = time.perf_counter()
+                    assert subs[n].run_round() is True
+                    worst = max(worst, (time.perf_counter() - t0) * 1e3)
+                sub_ms.append(worst)
+                t0 = time.perf_counter()
+                root_agg, n_root = root_round()
+                root_ms.append((time.perf_counter() - t0) * 1e3)
+            assert n_flat == n_miners and n_root == len(nodes)
+
+            parity_err = max(
+                float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+                for a, b in zip(jax.tree_util.tree_leaves(flat_agg),
+                                jax.tree_util.tree_leaves(root_agg)))
+
+            # packed scatter-add memory: peak-bytes growth across an
+            # all-packed aggregate of every miner must stay far under
+            # the M x params stack it replaces (backend stats only)
+            params_bytes = sum(l.nbytes for l in
+                               jax.tree_util.tree_leaves(template))
+            before = device_memory_watermarks().get("mem_peak_bytes")
+            packed_agg = delta_lib.aggregate_deltas(
+                template, packed_all,
+                np.full((n_miners,), 1.0 / n_miners, np.float32))
+            jax.block_until_ready(packed_agg)
+            after = device_memory_watermarks().get("mem_peak_bytes")
+            if before is not None and after is not None:
+                peak_delta = int(after - before)
+                stack_free = peak_delta < n_miners * params_bytes // 2
+            else:
+                peak_delta = stack_free = None
+
+            flat = float(np.mean(flat_ms))
+            sub = float(np.mean(sub_ms))
+            root = float(np.mean(root_ms))
+            per_node = max(sub, root)
+            return {
+                "hier_miners": n_miners,
+                "hier_fanout": fanout,
+                "hier_subaveragers": len(nodes),
+                "hier_flat_node_ms": round(flat, 2),
+                "hier_sub_node_ms": round(sub, 2),
+                "hier_root_node_ms": round(root, 2),
+                "hier_per_node_ms": round(per_node, 2),
+                "hier_worknode_reduction": round(flat / max(per_node,
+                                                            1e-9), 3),
+                "hier_parity_max_abs_err": float(parity_err),
+                "hier_parity": bool(parity_err < 1e-5),
+                "hier_packed_peak_delta_bytes": peak_delta,
+                "hier_packed_stack_free": stack_free,
+            }
+        finally:
+            flat_ing.close()
+            root_ing.close()
+            for s in subs.values():
+                s.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _time_metrics_overhead(*, steps: int = 100, trials: int = 2,
                            log_every: int = 5) -> dict:
     """Observability-layer A/B (round-8 satellite): the production
@@ -1151,6 +1335,14 @@ def main() -> None:
         extras.update(_time_wire_v2())
     except Exception as e:
         extras["wire_v2_error"] = repr(e)
+
+    try:
+        # flat single-node merge vs fanout tree aggregation over localfs
+        # (round-13 tentpole): per-node round cost O(miners) ->
+        # O(miners / fanout), parity pinned
+        extras.update(_time_hier_average())
+    except Exception as e:
+        extras["hier_average_error"] = repr(e)
 
     try:
         # fleet health plane cost: production loop with the heartbeat

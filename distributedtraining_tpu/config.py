@@ -160,6 +160,19 @@ class RunConfig:
     outer_momentum: float = 0.0              # >0 wraps strategy in OuterOptMerge
     outer_lr: float = 0.7                    # DiLoCo-style outer Nesterov step
 
+    # -- hierarchical aggregation (engine/hier_average.py) ------------------
+    # --hier sub: this averager is a SUB-AVERAGER — it gathers its
+    # plan_fanout slice of the metagraph and publishes the partial
+    # aggregate under the reserved __agg__.<node> id instead of merging
+    # the whole fleet. --hier root: gather the configured sub nodes'
+    # aggregates (never the metagraph) and publish the base. "" = the
+    # flat single-averager reference topology.
+    hier: str = ""                           # "" | sub | root
+    hier_node: str = ""                      # sub node id (default: hotkey)
+    hier_nodes: str = ""                     # comma list of sub node ids
+    hier_fanout: int = 0                     # auto-plan width when no list
+    hier_wire_v2: bool = False               # aggregates ride the v2 wire
+
     # -- remediation / failover (engine/remediate.py) -----------------------
     # --remediate closes the loop from SLO breach to action on the
     # monitor roles: quarantine + probation for breaching miners, score
@@ -602,6 +615,37 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                             "fitness for every candidate)")
         g.add_argument("--genetic-sigma", dest="genetic_sigma", type=float,
                        default=d.genetic_sigma)
+
+        g = p.add_argument_group("hierarchy")
+        g.add_argument("--hier", choices=("", "sub", "root"),
+                       default=d.hier,
+                       help="tree aggregation (engine/hier_average.py): "
+                            "'sub' gathers a plan_fanout slice of the "
+                            "fleet and publishes its partial aggregate "
+                            "under __agg__.<node>; 'root' merges the "
+                            "configured sub nodes' aggregates into the "
+                            "base; '' is the flat reference topology")
+        g.add_argument("--hier-node", dest="hier_node", default=d.hier_node,
+                       help="this sub-averager's stable node id "
+                            "(default: --hotkey); names its __agg__ "
+                            "artifact and its subavg.<node> lease")
+        g.add_argument("--hier-nodes", dest="hier_nodes",
+                       default=d.hier_nodes,
+                       help="comma-separated sub node ids — the root's "
+                            "gather list AND every sub's shared "
+                            "plan_fanout keyspace (the stable production "
+                            "spelling)")
+        g.add_argument("--hier-fanout", dest="hier_fanout", type=int,
+                       default=d.hier_fanout,
+                       help="miners per sub-averager when no --hier-nodes "
+                            "list is given: nodes auto-name "
+                            "sub0..subN-1, N = ceil(miners / fanout)")
+        g.add_argument("--hier-wire-v2", dest="hier_wire_v2",
+                       action="store_true", default=d.hier_wire_v2,
+                       help="publish partial aggregates on the v2 shard "
+                            "wire (density 1.0 + quant none — lossless; "
+                            "unchanged aggregate layers dedupe at shard "
+                            "granularity)")
 
     g = p.add_argument_group("resilience")
     if role in ("validator", "averager"):  # the monitor roles act on SLOs

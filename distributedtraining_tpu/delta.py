@@ -567,6 +567,31 @@ def init_merge_weights(base: Params, num_miners: int, *, per_tensor: bool = True
     )
 
 
+def normalized_merge_weights(miner_ids: Sequence[str],
+                             consensus: dict[str, float] | None
+                             ) -> jax.Array:
+    """Consensus scores -> normalized (M,) mixing vector — THE home of
+    the consensus→weights rule so every merge path normalizes the same
+    way: negative scores clamp to zero, an all-zero (or absent) score
+    set falls back to uniform, and normalization ALWAYS runs over the
+    REAL, unpadded miner count. Padding to a mesh axis or a compile
+    bucket happens AFTER, via :func:`pad_merge_weights`, whose padded
+    slots weigh nothing — normalizing by a padded m would shrink every
+    real miner's weight by the padding ratio (a 1-miner cohort padded to
+    an 8-wide mesh axis would publish 1/8th of the update)."""
+    m = len(miner_ids)
+    if m == 0:
+        raise ValueError("normalized_merge_weights: empty cohort")
+    if not consensus:
+        return jnp.full((m,), 1.0 / m, jnp.float32)
+    raw = np.asarray([max(float(consensus.get(h, 0.0)), 0.0)
+                      for h in miner_ids], np.float32)
+    total = float(raw.sum())
+    if not np.isfinite(total) or total <= 0:
+        return jnp.full((m,), 1.0 / m, jnp.float32)
+    return jnp.asarray(raw / total)
+
+
 # ---------------------------------------------------------------------------
 # top-k sparse wire compression (the >=8x-beyond-int8 format for the 7B/8B
 # configs: 1.42 GB f32 at 355M, ~8 GB/push/miner at 8B — sparse8 at the
@@ -991,6 +1016,97 @@ def packed_from_layer_entries(entries: dict[str, dict]) -> Params:
         if ok:
             node[parts[-1]] = entry
     return {WIRE_V2_KEY: np.int32(WIRE_V2_FORMAT), "leaves": nested}
+
+
+# ---------------------------------------------------------------------------
+# Packed-form merge: scatter-add of idx/q*scale directly into a running
+# aggregate. The averager-side half of the v2 wire — a sub-averager (or a
+# packed-fleet flat averager) folds M submissions into ONE accumulator
+# tree, one miner at a time, so device memory stays O(params + k) and the
+# dense M x params stack of stack_deltas never exists. Compile cost is
+# bounded by the distinct (leaf-shape, k) signatures in the fleet: every
+# miner at the same density shares one compiled accumulate program
+# (sparse_k is deterministic in (n, density)).
+# ---------------------------------------------------------------------------
+
+def _accum_packed(acc_leaves, entries, w):
+    """acc leaves + w * decode(entries), leafwise. The decode is the
+    densifier's arithmetic — ``w * (q_f32 * scale)`` — scattered at idx
+    (or added wholesale for dense-form entries), so the result matches
+    ``acc + w * densify_packed_v2(...)`` to multiply-add fusion
+    tolerance (XLA may emit FMA for ``a + w*x``; ~1 ulp) for honest
+    (unique-index) encodings; hostile duplicate indices sum here where
+    the densifier resolves last-wins (both deterministic, both screened
+    upstream). Jittable: dense-form vs indexed is a static shape test."""
+    out = []
+    for a, e in zip(acc_leaves, entries):
+        flat = a.reshape(-1)
+        idx, q, scale = e["idx"], e["q"], e["scale"]
+        contrib = w * (q.astype(flat.dtype) * scale)
+        n = flat.shape[0]
+        if idx.shape[0] == 0 and q.shape[0] == n and n > 0:
+            flat = flat + contrib        # dense-form entry (k == n)
+        else:
+            flat = flat.at[idx].add(contrib)
+        out.append(flat.reshape(a.shape))
+    return out
+
+
+_accum_packed_jit = jax.jit(_accum_packed)
+
+
+def _accum_dense(acc, d, w):
+    return jax.tree_util.tree_map(
+        lambda a, x: a + w * x.astype(a.dtype), acc, d)
+
+
+_accum_dense_jit = jax.jit(_accum_dense)
+
+
+def accumulate_delta(acc: Params, delta: Params, weight) -> Params:
+    """``acc + weight * delta`` where ``delta`` is a dense tree OR a v2
+    packed tree (already admitted via ``packed_matches`` — entry order
+    and element counts are trusted to line up with ``acc``). Packed
+    submissions accumulate by per-tensor scatter-add of ``idx/q*scale``
+    without ever densifying; dense ones by one fused add. Both run as
+    ONE jitted program per call with the weight traced, so repeated
+    rounds and varying weights reuse the compiled programs."""
+    w = jnp.asarray(weight, jnp.float32)
+    if is_packed_v2(delta):
+        leaves, treedef = jax.tree_util.tree_flatten(acc)
+        entries = jax.tree_util.tree_leaves(delta["leaves"],
+                                            is_leaf=is_packed_entry)
+        if len(entries) != len(leaves):
+            raise ValueError(
+                f"accumulate_delta: {len(entries)} packed entries for a "
+                f"{len(leaves)}-leaf accumulator (run packed_matches "
+                "before accumulating)")
+        return jax.tree_util.tree_unflatten(
+            treedef, _accum_packed_jit(leaves, entries, w))
+    return _accum_dense_jit(acc, delta, w)
+
+
+def aggregate_deltas(template: Params, deltas: Sequence[Params],
+                     weights) -> Params:
+    """``sum_i weights[i] * delta_i`` over a HOST list of mixed
+    dense/packed submissions with O(params) device memory: one f32
+    accumulator, one contribution folded at a time
+    (:func:`accumulate_delta`) — the sub-averager's partial-aggregate
+    body (engine/hier_average.py) and the packed twin of
+    ``chunked_weighted_merge`` (which needs dense trees to stack).
+    ``weights`` are used AS GIVEN (no normalization here — callers
+    normalize over the real cohort via normalized_merge_weights)."""
+    if not deltas:
+        raise ValueError("aggregate_deltas: empty delta list")
+    weights = np.asarray(jax.device_get(weights), np.float32).reshape(-1)
+    if weights.shape[0] != len(deltas):
+        raise ValueError(f"{weights.shape[0]} weights for "
+                         f"{len(deltas)} deltas")
+    acc = jax.tree_util.tree_map(
+        lambda x: jnp.zeros(np.shape(x), jnp.float32), template)
+    for d, w in zip(deltas, weights):
+        acc = accumulate_delta(acc, d, w)
+    return acc
 
 
 def _packed_screen_stats(*packed_leaves) -> tuple[jax.Array, jax.Array]:

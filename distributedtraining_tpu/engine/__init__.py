@@ -13,6 +13,7 @@ from .lora_train import LoRAEngine, LoRAMinerLoop, fetch_delta_any
 from .batched_eval import BatchedCohortEvaluator, stage_cohorts
 from .health import (FleetMonitor, HeartbeatPublisher, NodeHealth, SLORule,
                      Vitals, default_slo_rules, report_vitals)
+from .hier_average import SubAverager, plan_fanout, subtree_weights
 from .ingest import DeltaCache, DeltaIngestor, IngestPool, StagedDelta
 from .publish import DeltaPublisher, PublishWorker, SupersedeQueue
 from .remediate import (LeaseManager, RemediationEngine, RemediationPolicy,
@@ -37,6 +38,7 @@ __all__ = [
     "Vitals", "default_slo_rules", "report_vitals",
     "LeaseManager", "RemediationEngine", "RemediationPolicy",
     "StandbyAverager", "elastic_cohort",
+    "SubAverager", "plan_fanout", "subtree_weights",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",
     "OuterOptMerge",

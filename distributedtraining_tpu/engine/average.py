@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-from typing import Any, Callable, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +57,13 @@ class WeightedAverage:
     O(chunk x params) however many miners submit — the reference's
     whole-subnet case (up to 100 uids) would otherwise need an M x params
     stack past one chip's HBM. A mesh averager keeps the sharded-stack
-    psum path instead."""
+    path instead (parallel/collectives.sharded_cohort_merge: one cached,
+    bucket-padded fused program per cohort).
+
+    A PACKED host list (wire-v2 submissions staged with densify=False,
+    or a mix of packed and dense trees) merges through the scatter-add
+    accumulate path (delta.aggregate_deltas) — per-miner idx/q*scale
+    folds into one accumulator, never an M x params stack."""
 
     # tells AveragerLoop to hand over the raw host list on single-chip
     # runs instead of materializing a full device stack
@@ -66,29 +72,58 @@ class WeightedAverage:
     def __init__(self, *, uniform: bool = False, chunk_size: int = 8):
         self.uniform = uniform
         self.chunk_size = chunk_size
+        # the consensus→weights normalization is pure host work, but it
+        # re-ran every round even when (cohort, scores) had not changed;
+        # memoized on exactly that key (satellite of ROADMAP item 2)
+        self._weights_cache: tuple | None = None
+
+    def _weights(self, miner_ids: list[str],
+                 consensus: dict[str, float] | None) -> jax.Array:
+        if self.uniform or not consensus:
+            key = (tuple(miner_ids), None)
+        else:
+            key = (tuple(miner_ids),
+                   tuple(float(consensus.get(h, 0.0)) for h in miner_ids))
+        if self._weights_cache is not None and self._weights_cache[0] == key:
+            obs.count("merge.weights_reused")
+            return self._weights_cache[1]
+        w = delta_lib.normalized_merge_weights(
+            miner_ids, None if self.uniform else consensus)
+        self._weights_cache = (key, w)
+        return w
 
     def merge(self, engine, base: Params, stacked: Params, miner_ids: list[str],
               *, val_batches=None, consensus: dict[str, float] | None = None
               ) -> tuple[Params, jax.Array]:
-        m = len(miner_ids)
-        if self.uniform or not consensus:
-            w = jnp.full((m,), 1.0 / m)
-        else:
-            raw = jnp.asarray([max(consensus.get(h, 0.0), 0.0)
-                               for h in miner_ids])
-            total = raw.sum()
-            w = jnp.full((m,), 1.0 / m) if total <= 0 else raw / total
+        w = self._weights(miner_ids, consensus)
         if getattr(engine, "mesh", None) is not None:
             # BASELINE config 3: local partial sums over the sharded miner
-            # axis + one ICI all-reduce (parallel/collectives.py)
-            from ..parallel.collectives import merge_axis, psum_weighted_merge
-            merged = psum_weighted_merge(base, stacked, w, engine.mesh,
-                                         axis=merge_axis(engine.mesh))
+            # axis + one ICI all-reduce, via the per-bucket CACHED fused
+            # program (parallel/collectives.py)
+            from ..parallel.collectives import (merge_axis,
+                                                sharded_cohort_merge)
+            merged = sharded_cohort_merge(base, stacked, w, engine.mesh,
+                                          axis=merge_axis(engine.mesh))
         elif isinstance(stacked, list):
-            merged = delta_lib.chunked_weighted_merge(
-                base, stacked, w, chunk=self.chunk_size)
+            if any(delta_lib.is_packed_v2(d) for d in stacked):
+                # wire-v2 packed submissions: scatter-add accumulate —
+                # the M x params stack (and the per-miner densify) never
+                # happens. The f32 aggregate folds into the base in the
+                # BASE's dtype, mirroring weighted_merge's rule.
+                agg = delta_lib.aggregate_deltas(base, stacked, w)
+                merged = jax.tree_util.tree_map(
+                    lambda b, a: b + a.astype(b.dtype), base, agg)
+            else:
+                merged = delta_lib.chunked_weighted_merge(
+                    base, stacked, w, chunk=self.chunk_size)
         else:
-            merged = delta_lib.weighted_merge_jit(base, stacked, w)
+            # the stack may be bucket-padded (AveragerLoop's compile
+            # ladder); weights normalize over the REAL m above and
+            # zero-pad here — the padded slots weigh nothing
+            merged = delta_lib.weighted_merge_jit(
+                base, stacked,
+                delta_lib.pad_merge_weights(
+                    w, delta_lib.miner_axis_size(stacked)))
         return merged, w
 
 
@@ -482,7 +517,16 @@ class AveragerReport:
 
 class AveragerLoop:
     """run_periodic_averaging parity (averaging_logic.py:544-583): pull base,
-    gather+screen every miner delta, merge via strategy, publish new base."""
+    gather+screen every miner delta, merge via strategy, publish new base.
+
+    With ``hierarchy`` set (a list of sub-averager node ids), this loop
+    is the ROOT of a tree aggregation (engine/hier_average.py): it stages
+    the reserved ``__agg__.<node>`` partial-aggregate artifacts instead
+    of chain hotkeys, and its consensus weights are the per-subtree
+    weight sums the sub-averagers declared on their meta riders — so
+    each strategy's mixing weights become per-subtree, and a missing or
+    stale aggregate simply drops that subtree from the round (the root
+    degrades to the surviving subtrees)."""
 
     def __init__(self, engine, transport, chain, strategy, *,
                  val_batches: Callable[[], Iterable[dict]],
@@ -499,7 +543,8 @@ class AveragerLoop:
                  ingest_cache_mb: int = 2048,
                  fleet=None,
                  remediation=None,
-                 lease=None):
+                 lease=None,
+                 hierarchy: Sequence[str] | None = None):
         self.engine = engine
         # fleet health plane (engine/health.py FleetMonitor): polled at
         # the round cadence, fed the EXACT staging outcomes each gather
@@ -516,6 +561,11 @@ class AveragerLoop:
         # that keeps base publication single-writer across a standby
         # takeover. None = no failover configured (single-averager fleet).
         self.lease = lease
+        # tree aggregation (engine/hier_average.py): the configured sub
+        # node ids this root gathers aggregates from; None = flat mode
+        self.hierarchy = list(hierarchy) if hierarchy else None
+        # agg artifact id -> declared weight sum (meta rider), per round
+        self._round_agg_weights: dict[str, float] = {}
         self.transport = transport
         self.chain = chain
         self.strategy = strategy
@@ -666,15 +716,23 @@ class AveragerLoop:
 
     def gather_deltas(self) -> tuple[list[str], list[Params]]:
         from .train import wire_in
-        if self._multi():
-            from .train import broadcast_metagraph
-            meta = broadcast_metagraph(self.chain)
-        else:
-            meta = self.chain.sync()
         self._round_cids.clear()
         self._round_revisions.clear()
-        hotkeys = [h for h in meta.hotkeys
-                   if h != getattr(self.chain, "my_hotkey", None)]
+        self._round_agg_weights.clear()
+        if self.hierarchy is not None:
+            # root of a tree aggregation: the cohort is the CONFIGURED
+            # sub-averager node list (never the metagraph — __agg__.* is
+            # a reserved namespace chain hotkeys can't collide with)
+            from ..transport.base import agg_id
+            hotkeys = [agg_id(n) for n in self.hierarchy]
+        else:
+            if self._multi():
+                from .train import broadcast_metagraph
+                meta = broadcast_metagraph(self.chain)
+            else:
+                meta = self.chain.sync()
+            hotkeys = [h for h in meta.hotkeys
+                       if h != getattr(self.chain, "my_hotkey", None)]
         if self.fleet is not None and not self._multi():
             # one observation round BEFORE staging: the staging observer
             # then folds outcomes into the freshly-advanced round. Pods
@@ -696,6 +754,8 @@ class AveragerLoop:
             self._round_revisions[s.hotkey] = s.revision
             if s.cid is not None:
                 self._round_cids[s.hotkey] = s.cid
+            if s.agg_weight is not None:
+                self._round_agg_weights[s.hotkey] = s.agg_weight
             if s.delta is None:
                 if s.reason == "stale_base":
                     logger.info("averager: skipping %s (delta vs a "
@@ -784,17 +844,39 @@ class AveragerLoop:
         if getattr(self.engine, "mesh", None) is not None:
             # ingest-shard the miner axis: the full M x params stack never
             # materializes on one device, and every merge strategy's sum
-            # over that axis runs as partial sums + ICI all-reduce
-            from ..parallel.collectives import merge_axis, stack_deltas_sharded
-            stacked = stack_deltas_sharded(deltas, self.engine.mesh,
-                                           axis=merge_axis(self.engine.mesh))
+            # over that axis runs as partial sums + ICI all-reduce. The
+            # stack pads to the merge-bucket ladder, so an elastic fleet
+            # reuses compiled merge programs instead of compiling per M
+            from ..parallel.collectives import (merge_axis, merge_bucket,
+                                                stack_deltas_sharded)
+            axis = merge_axis(self.engine.mesh)
+            stacked = stack_deltas_sharded(
+                deltas, self.engine.mesh, axis=axis,
+                target=merge_bucket(len(deltas), self.engine.mesh, axis))
         elif getattr(self.strategy, "host_list_ingest", False):
-            # the strategy bounds its own device memory (chunked merge) —
-            # handing it a full device stack would defeat that
+            # the strategy bounds its own device memory (chunked merge /
+            # packed scatter-add) — handing it a full device stack would
+            # defeat that
             stacked = deltas
         else:
-            stacked = delta_lib.stack_deltas(deltas)
-        if self._multi():
+            # bucket-pad the single-device stack too: the stacked
+            # strategies key their jitted programs (the full model
+            # fwd+bwd for ParameterizedMerge) on the padded M, so a
+            # wobbling accepted count must land on a ladder rung, not a
+            # fresh multi-second compile per distinct M
+            from ..parallel.collectives import mark_merge_bucket, merge_bucket
+            m_pad = merge_bucket(len(deltas))
+            mark_merge_bucket(m_pad)
+            stacked = delta_lib.pad_stack(
+                delta_lib.stack_deltas(deltas), m_pad)
+        if self.hierarchy is not None:
+            # per-subtree mixing: each aggregate's weight is the weight
+            # sum its sub-averager declared (missing rider = 1.0 — one
+            # anonymous subtree must not zero out, matching the
+            # riderless-delta accept rule)
+            consensus = {h: self._round_agg_weights.get(h, 1.0)
+                         for h in ids}
+        elif self._multi():
             # small chain read, same lockstep rule as everything else
             from .train import broadcast_json
             from ..parallel import multihost

@@ -380,6 +380,11 @@ class NodeHealth:
     """One node's folded heartbeat state + contribution ledger entry."""
     role: str
     hotkey: str
+    # ledger tier: "miner" for ordinary submissions, "agg" when the
+    # staged artifact is a sub-averager's partial aggregate
+    # (transport/base.__agg__.* — engine/hier_average.py), so the
+    # fleet_report table tells aggregates from miner deltas at a glance
+    tier: str = "miner"
     # -- heartbeat-derived ---------------------------------------------------
     beats: int = 0                      # distinct sequences observed
     seq: int = -1
@@ -419,7 +424,8 @@ class NodeHealth:
 
     def as_record(self, now: float | None = None) -> dict:
         rec = {
-            "role": self.role, "hotkey": self.hotkey, "beats": self.beats,
+            "role": self.role, "hotkey": self.hotkey, "tier": self.tier,
+            "beats": self.beats,
             "seq": self.seq, "steps": self.steps,
             "step_rate": round(self.step_rate, 4),
             "loss_ema": self.loss_ema, "pushes": self.pushes,
@@ -580,7 +586,10 @@ class FleetMonitor:
         key = (role, hotkey)
         n = self.nodes.get(key)
         if n is None:
-            n = self.nodes[key] = NodeHealth(role=role, hotkey=hotkey)
+            from ..transport.base import is_agg_id
+            n = self.nodes[key] = NodeHealth(
+                role=role, hotkey=hotkey,
+                tier="agg" if is_agg_id(hotkey) else "miner")
         return n
 
     def _fetch(self, key: tuple[str, str]) -> dict | None:

@@ -89,6 +89,25 @@ _PROBE_FAILED = object()
 DEFAULT_CACHE_BYTES = 2 << 30   # holds a few full f32 124M deltas
 
 
+def _rider_agg_weight(meta) -> float | None:
+    """Defensive read of a partial-aggregate rider's weight-sum
+    declaration: ``meta["agg"]["weight"]`` must be a finite number >= 0
+    (bools excluded — json true would read as 1.0); anything else is
+    absent, never an exception."""
+    if not isinstance(meta, dict):
+        return None
+    agg = meta.get("agg")
+    if not isinstance(agg, dict):
+        return None
+    w = agg.get("weight")
+    if isinstance(w, bool) or not isinstance(w, (int, float)):
+        return None
+    w = float(w)
+    if not np.isfinite(w) or w < 0:
+        return None
+    return w
+
+
 def tree_nbytes(tree: Params | None) -> int:
     """Host bytes of a pytree (the cache's accounting unit)."""
     if tree is None:
@@ -111,6 +130,10 @@ class StagedDelta:
     # per miner into the fleet ledger (engine/health.py) and the
     # fleet_report wire-bytes column
     wire_bytes: int = 0
+    # declared weight sum from a partial-aggregate's "agg" meta rider
+    # (engine/hier_average.py) — peer-controlled, validated at parse;
+    # None for ordinary miner submissions
+    agg_weight: float | None = None
 
     @property
     def ok(self) -> bool:
@@ -234,6 +257,7 @@ class _Entry:
     cid: str | None
     meta_base_revision: str | None
     nbytes: int
+    agg_weight: float | None = None
 
 
 class DeltaCache:
@@ -321,7 +345,8 @@ class DeltaCache:
 
     def put(self, hotkey: str, revision, *, delta: Params | None = None,
             reason: str = "ok", fetched: bool = True, cid: str | None = None,
-            meta_base_revision: str | None = None) -> None:
+            meta_base_revision: str | None = None,
+            agg_weight: float | None = None) -> None:
         if self.max_bytes <= 0 or not isinstance(revision, str):
             return
         nb = tree_nbytes(delta)
@@ -333,7 +358,8 @@ class DeltaCache:
             if old is not None:
                 self._bytes -= old.nbytes
             self._entries[hotkey] = _Entry(revision, delta, reason, fetched,
-                                           cid, meta_base_revision, nb)
+                                           cid, meta_base_revision, nb,
+                                           agg_weight)
             self._bytes += nb
             # shards evict before whole-tree entries (re-fetchable per
             # layer vs per artifact — see shard_put)
@@ -383,7 +409,8 @@ class DeltaIngestor:
                  cache_bytes: int = DEFAULT_CACHE_BYTES,
                  span_prefix: str = "ingest",
                  retry_policy: RetryPolicy | None = None,
-                 observer: Callable[[list], None] | None = None):
+                 observer: Callable[[list], None] | None = None,
+                 densify: bool = True):
         self.transport = transport
         # staging observer: called with the full StagedDelta list after
         # every stage() — how the fleet health plane's contribution
@@ -409,6 +436,13 @@ class DeltaIngestor:
         self.stale_deltas = stale_deltas
         self.span_prefix = span_prefix
         self.retry = retry_policy or DEFAULT_FETCH_RETRY
+        # densify=False leaves screened-ok wire-v2 submissions in their
+        # PACKED form (StagedDelta.delta is the packed tree): consumers
+        # that merge by scatter-add (delta.accumulate_delta — the
+        # sub-averager, engine/hier_average.py) never pay the densify or
+        # hold a dense copy per miner. v1 dense submissions are
+        # unaffected; callers must handle both forms.
+        self.densify = densify
         self.cache = DeltaCache(cache_bytes)
         self.pool = IngestPool(workers)
 
@@ -486,21 +520,25 @@ class DeltaIngestor:
                            "uncached", hotkey, exc_info=True)
             return _PROBE_FAILED
 
-    def _rider(self, hotkey: str) -> tuple[str | None, str | None]:
-        """(cid, base_revision) from the miner's meta rider — both
-        peer-controlled, both validated; any failure reads as riderless."""
+    def _rider(self, hotkey: str) -> tuple[str | None, str | None,
+                                           float | None]:
+        """(cid, base_revision, agg_weight) from the miner's meta rider —
+        all peer-controlled, all validated; any failure reads as
+        riderless. ``agg_weight`` is the partial-aggregate weight-sum
+        declaration (engine/hier_average.py): a finite float >= 0 under
+        the ``"agg"`` key, anything else reads as absent."""
         fm = getattr(self.transport, "fetch_delta_meta", None)
         if fm is None:
-            return None, None
+            return None, None, None
         try:
             meta = fm(hotkey)
         except Exception:
-            return None, None
+            return None, None, None
         cid = obs.rider_delta_id(meta)
         rev = meta.get("base_revision") if isinstance(meta, dict) else None
         if not (isinstance(rev, str) and rev):
             rev = None
-        return cid, rev
+        return cid, rev, _rider_agg_weight(meta)
 
     @staticmethod
     def _is_stale(meta_base_revision, base_revision) -> bool:
@@ -527,10 +565,28 @@ class DeltaIngestor:
         if entry is not None:
             obs.count("ingest.cache_hits")
             cid, meta_rev = entry.cid, entry.meta_base_revision
+            agg_w = entry.agg_weight
             if self.stale_deltas == "skip" and self._is_stale(meta_rev,
                                                              base_revision):
-                return StagedDelta(hotkey, None, "stale_base", rev_key, cid,
-                                   cached=True, meta_base_revision=meta_rev)
+                # the ARTIFACT is content-addressed but the RIDER is not:
+                # a publisher whose payload didn't change between rounds
+                # (a sub-averager re-stamping an identical aggregate
+                # against the new base, engine/hier_average.py) updates
+                # only the rider, so the cached verdict may be stale
+                # while the store's rider is fresh — re-read the (small,
+                # cheap) rider before withholding the submission
+                cid2, meta_rev2, agg_w2 = self._rider(hotkey)
+                if not self._is_stale(meta_rev2, base_revision):
+                    obs.count("ingest.rider_refreshes")
+                    entry.meta_base_revision = meta_rev = meta_rev2
+                    entry.cid = cid = cid2 if cid2 is not None else cid
+                    entry.agg_weight = agg_w = (agg_w2 if agg_w2 is not None
+                                                else agg_w)
+                else:
+                    return StagedDelta(hotkey, None, "stale_base", rev_key,
+                                       cid, cached=True,
+                                       meta_base_revision=meta_rev,
+                                       agg_weight=agg_w)
             if entry.fetched:
                 # the cache hit that skips download+decode+dequant+screen;
                 # the span keeps the round trip traceable (obs_report's
@@ -540,21 +596,24 @@ class DeltaIngestor:
                     pass
                 return StagedDelta(hotkey, entry.delta, entry.reason,
                                    rev_key, cid, cached=True,
-                                   meta_base_revision=meta_rev)
+                                   meta_base_revision=meta_rev,
+                                   agg_weight=agg_w)
             # rider-only entry (earlier stale skip) whose verdict no
             # longer withholds: fall through to the artifact fetch
         else:
             obs.count("ingest.cache_misses")
-            cid, meta_rev = self._rider(hotkey)
+            cid, meta_rev, agg_w = self._rider(hotkey)
             if self.stale_deltas == "skip" and self._is_stale(meta_rev,
                                                              base_revision):
                 # rider verdict BEFORE the full-model-bytes fetch; cache
                 # the rider so a later round re-verdicts from memory
                 self.cache.put(hotkey, rev_key, delta=None,
                                reason="stale_base", fetched=False, cid=cid,
-                               meta_base_revision=meta_rev)
+                               meta_base_revision=meta_rev,
+                               agg_weight=agg_w)
                 return StagedDelta(hotkey, None, "stale_base", rev_key, cid,
-                                   meta_base_revision=meta_rev)
+                                   meta_base_revision=meta_rev,
+                                   agg_weight=agg_w)
         with obs.span(self._span("fetch"), cid=cid, miner=hotkey,
                       cache="miss"):
             delta, attempted, nbytes = self._fetch_dense(hotkey)
@@ -564,12 +623,14 @@ class DeltaIngestor:
                 # bytes-level miss (publish race, torn shard set) is not
                 self.cache.put(hotkey, rev_key, delta=None,
                                reason="no_delta", cid=cid,
-                               meta_base_revision=meta_rev)
+                               meta_base_revision=meta_rev,
+                               agg_weight=agg_w)
             return StagedDelta(hotkey, None, "no_delta", rev_key, cid,
                                meta_base_revision=meta_rev,
-                               wire_bytes=nbytes)
+                               wire_bytes=nbytes, agg_weight=agg_w)
         return StagedDelta(hotkey, delta, _UNSCREENED, rev_key, cid,
-                           meta_base_revision=meta_rev, wire_bytes=nbytes)
+                           meta_base_revision=meta_rev, wire_bytes=nbytes,
+                           agg_weight=agg_w)
 
     def _fetch_dense(self, hotkey: str) -> tuple[Params | None, bool, int]:
         """(wire-layout delta | None, decode_attempted, bytes fetched).
@@ -677,9 +738,11 @@ class DeltaIngestor:
             s.reason = "ok" if ok else reason
             if not ok:
                 s.delta = None
-            elif delta_lib.is_packed_v2(s.delta):
+            elif self.densify and delta_lib.is_packed_v2(s.delta):
                 # verdict passed: NOW densify for the merge/eval paths
-                # downstream (they consume dense wire-layout trees)
+                # downstream (they consume dense wire-layout trees).
+                # densify=False consumers (the packed scatter-add merge)
+                # keep the packed form instead.
                 t0 = time.perf_counter()
                 dense = delta_lib.densify_packed_v2(s.delta,
                                                     self._template())
@@ -692,7 +755,8 @@ class DeltaIngestor:
             if cache:
                 self.cache.put(s.hotkey, s.revision, delta=s.delta,
                                reason=s.reason, cid=s.cid,
-                               meta_base_revision=s.meta_base_revision)
+                               meta_base_revision=s.meta_base_revision,
+                               agg_weight=s.agg_weight)
 
     # -- multi-host (pod) path ----------------------------------------------
     def _prefetch_raw(self, hotkey: str, base_revision) -> dict:
@@ -700,14 +764,15 @@ class DeltaIngestor:
         (densification happens identically on every process after the
         broadcast). Runs on the pool; never issues collectives."""
         out: dict = {"rev": None, "cid": None, "reason": "no_delta",
-                     "data": None}
+                     "data": None, "agg_w": None}
         try:
             rev = self._probe(hotkey)
             out["rev"] = None if rev is _PROBE_FAILED else rev
             if rev is None:
                 return out
-            cid, meta_rev = self._rider(hotkey)
+            cid, meta_rev, agg_w = self._rider(hotkey)
             out["cid"] = cid
+            out["agg_w"] = agg_w
             if self.stale_deltas == "skip" and self._is_stale(meta_rev,
                                                              base_revision):
                 out["reason"] = "stale_base"
@@ -768,13 +833,16 @@ class DeltaIngestor:
             v = broadcast_json({"rev": rec.get("rev"),
                                 "cid": rec.get("cid"),
                                 "reason": rec.get("reason"),
+                                "agg_w": rec.get("agg_w"),
                                 "has": rec.get("data") is not None}
                                if coord else None)
             data = broadcast_optional_bytes(rec.get("data") if coord
                                             else None)
+            agg_w = v.get("agg_w")
             if data is None:
                 staged.append(StagedDelta(h, None, v["reason"] or "no_delta",
-                                          v["rev"], v["cid"]))
+                                          v["rev"], v["cid"],
+                                          agg_weight=agg_w))
                 continue
             with obs.span(self._span("fetch"), cid=v["cid"], miner=h,
                           cache="broadcast"):
@@ -785,7 +853,7 @@ class DeltaIngestor:
                     accept_quant=self.accept_quant)
             staged.append(StagedDelta(
                 h, d, _UNSCREENED if d is not None else "no_delta",
-                v["rev"], v["cid"]))
+                v["rev"], v["cid"], agg_weight=agg_w))
         return staged
 
 

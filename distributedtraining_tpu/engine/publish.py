@@ -265,7 +265,8 @@ class DeltaPublisher:
 
     # -- the one publish procedure ------------------------------------------
     def publish_now(self, payload: Params, finite, base_revision,
-                    cid: str | None = None) -> bool:
+                    cid: str | None = None, *,
+                    extra_meta: dict | None = None) -> bool:
         """Screen + transfer + publish + rider ON the calling thread.
         ``finite`` is the snapshot program's device flag (None skips the
         screen); ``payload`` may be device arrays or an already-host tree
@@ -273,7 +274,10 @@ class DeltaPublisher:
         push's correlation id (utils/obs.py): it tags every span below,
         rides the meta rider as ``delta_id``, and is what lets
         scripts/obs_report.py join this push to the validator's fetch and
-        the averager's merge across processes."""
+        the averager's merge across processes. ``extra_meta`` merges
+        additional rider keys (the sub-averager's ``"agg"`` weight-sum
+        declaration, engine/hier_average.py) — protocol keys win on
+        collision."""
         import jax
 
         from ..transport.retry import call_with_retry
@@ -312,7 +316,8 @@ class DeltaPublisher:
                 logger.exception("miner %s: delta push failed", self.miner_id)
                 return False
             self._publish_meta(base_revision, cid,
-                               wire=self.wire_spec if wire_v2 else None)
+                               wire=self.wire_spec if wire_v2 else None,
+                               extra=extra_meta)
             self.report.pushes += 1
             obs.count("publish.pushes")
             logger.info("miner %s: pushed delta #%d", self.miner_id,
@@ -373,7 +378,8 @@ class DeltaPublisher:
                              for key, (digest, _) in layers.items()}
 
     def _publish_meta(self, base_revision, cid: str | None = None,
-                      wire: dict | None = None) -> None:
+                      wire: dict | None = None,
+                      extra: dict | None = None) -> None:
         """Base-revision (+ correlation-id, + wire-format declaration)
         rider next to the delta (see transport/base.publish_delta_meta
         for the staleness protocol). The delta-THEN-rider order makes the
@@ -387,9 +393,9 @@ class DeltaPublisher:
 
         pm = getattr(self.transport, "publish_delta_meta", None)
         if pm is None or (base_revision is None and cid is None
-                          and wire is None):
+                          and wire is None and not extra):
             return
-        meta: dict = {}
+        meta: dict = dict(extra) if extra else {}
         if base_revision is not None:
             meta["base_revision"] = base_revision
         if cid is not None:
@@ -412,7 +418,8 @@ class DeltaPublisher:
 
     # -- async lane ---------------------------------------------------------
     def submit(self, payload: Params, finite, base_revision,
-               cid: str | None = None) -> int:
+               cid: str | None = None, *,
+               extra_meta: dict | None = None) -> int:
         """Hand a snapshot to the background worker; returns how many
         pending pushes it superseded. The caller must pass NON-DONATED
         buffers (the jitted snapshot program's outputs) — the worker reads
@@ -424,7 +431,8 @@ class DeltaPublisher:
         end)."""
         t0 = time.perf_counter()
         dropped = self._worker.submit(
-            lambda: self.publish_now(payload, finite, base_revision, cid))
+            lambda: self.publish_now(payload, finite, base_revision, cid,
+                                     extra_meta=extra_meta))
         obs.observe("publish.submit_ms", (time.perf_counter() - t0) * 1e3)
         if dropped:
             self.report.pushes_superseded += dropped

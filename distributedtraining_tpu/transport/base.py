@@ -46,6 +46,21 @@ HEARTBEAT_PREFIX = "__hb__"
 # today only the averager's base publication is single-writer.
 LEASE_PREFIX = "__lease__"
 
+# Hierarchical aggregation (engine/hier_average.py): a sub-averager
+# publishes its cohort's PARTIAL AGGREGATE — an ordinary delta artifact
+# (dense v1 or a wire-v2 shard manifest) holding the weighted average of
+# its assigned miners' deltas — under a reserved per-node id, and the
+# root averager stages those ids exactly like miner submissions (same
+# ingest pool, same cache, same screens). The reserved prefix keeps
+# aggregates out of the metagraph hotkey namespace: a FLAT consumer
+# syncing hotkeys from the chain can never stage one by accident; the
+# ROOT stages them deliberately from its configured node list. The
+# aggregate's weight-sum rides the delta-META channel (an ``"agg"``
+# rider key, validated defensively at ingest), so the root's mixing
+# weights are per-subtree without any new transport surface.
+
+AGG_PREFIX = "__agg__"
+
 # Wire-v2 per-layer delta shards (serialization.py shard container,
 # engine/publish.py uploads, engine/ingest.py fetches): each shard is
 # raw bytes under a reserved per-(miner, layer) id, so every byte-capable
@@ -78,6 +93,19 @@ def lease_id(role: str = "averager") -> str:
     return f"{LEASE_PREFIX}.{role}"
 
 
+def agg_id(node_id: str) -> str:
+    """The reserved artifact id one sub-averager's partial aggregate
+    travels under. ``node_id`` is the sub-averager's stable node name
+    (its hotkey by default) — the id every round's re-publish overwrites,
+    exactly like a miner's delta id."""
+    return f"{AGG_PREFIX}.{node_id}"
+
+
+def is_agg_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(AGG_PREFIX + ".")
+
+
 def shard_layer_slug(layer_key: str) -> str:
     """Filename/id-safe spelling of a manifest layer key ("/"-joined
     state-dict path). Injective: literal "%" and "." inside components
@@ -101,13 +129,16 @@ def is_shard_id(artifact_id: str) -> bool:
 
 
 def is_reserved_id(artifact_id: str) -> bool:
-    """True for any id in the reserved control-plane/shard namespace
-    (heartbeats, leases, wire-v2 shards) — delta consumers must never
-    stage these as submissions."""
+    """True for any id in the reserved control-plane/shard/aggregate
+    namespace (heartbeats, leases, wire-v2 shards, partial aggregates) —
+    FLAT delta consumers must never stage these as miner submissions
+    (the hierarchy root stages ``__agg__.*`` ids deliberately, from its
+    configured node list, never from the metagraph)."""
     return isinstance(artifact_id, str) and (
         artifact_id.startswith(HEARTBEAT_PREFIX + ".")
         or artifact_id.startswith(LEASE_PREFIX + ".")
-        or artifact_id.startswith(SHARD_PREFIX + "."))
+        or artifact_id.startswith(SHARD_PREFIX + ".")
+        or artifact_id.startswith(AGG_PREFIX + "."))
 
 
 def publish_shard(transport, hotkey: str, layer_key: str,

@@ -52,6 +52,71 @@ def make_strategy(cfg: RunConfig, model):
     return strategy
 
 
+def _hier_nodes(cfg: RunConfig) -> list[str]:
+    return [n.strip() for n in (cfg.hier_nodes or "").split(",")
+            if n.strip()]
+
+
+def _run_sub_averager(cfg: RunConfig, c, plane) -> int:
+    """--hier sub: this process is one node of the aggregation tree
+    (engine/hier_average.py) — gather the plan_fanout slice, publish the
+    partial aggregate under __agg__.<node>. No eval set, no strategy, no
+    base publication; failover rides a per-node subavg.<node> lease."""
+    from distributedtraining_tpu.engine.hier_average import (SubAverager,
+                                                             plan_fanout)
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    nodes = _hier_nodes(cfg)
+    node = cfg.hier_node or cfg.hotkey
+    if not nodes and cfg.hier_fanout <= 0:
+        raise SystemExit("--hier sub needs --hier-nodes or --hier-fanout "
+                         "to derive this node's miner slice")
+    if nodes and node not in nodes:
+        raise SystemExit(f"--hier-node {node!r} is not in --hier-nodes "
+                         f"{nodes} — the slice plan would never assign "
+                         "it a miner")
+
+    def assigned():
+        meta = c.chain.sync()
+        hotkeys = [h for h in meta.hotkeys if h != cfg.hotkey]
+        plan = plan_fanout(hotkeys, nodes=nodes or None,
+                           fanout=cfg.hier_fanout or None)
+        return plan.get(node, [])
+
+    lease = None
+    if cfg.remediate or cfg.standby:
+        from distributedtraining_tpu.engine.remediate import LeaseManager
+        lease = LeaseManager(c.transport, cfg.hotkey,
+                             role=f"subavg.{node}")
+    sub = SubAverager(
+        c.transport, node, lambda: host_wire_template(c.engine), assigned,
+        consensus=lambda: getattr(c.chain, "consensus_scores",
+                                  lambda: {})(),
+        max_delta_abs=cfg.max_delta_abs,
+        stale_deltas=cfg.stale_deltas or "skip",
+        accept_quant=cfg.accept_quant,
+        accept_wire_v2=cfg.accept_wire_v2,
+        lora_cfg=c.lora_cfg,
+        ingest_workers=cfg.ingest_workers,
+        ingest_cache_mb=cfg.ingest_cache_mb,
+        wire_spec=True if cfg.hier_wire_v2 else None,
+        lease=lease, metrics=c.metrics, fleet=plane.fleet)
+    try:
+        merged = sub.run_periodic(interval=cfg.averaging_interval,
+                                  rounds=cfg.rounds)
+    except KeyboardInterrupt:
+        merged = sub.report.rounds
+    finally:
+        plane.close()
+        sub.close()
+        from distributedtraining_tpu.utils import obs
+        obs.reset()
+    logging.info("sub-averager %s done: rounds=%d accepted=%d pushes=%d",
+                 node, sub.report.rounds, sub.report.last_accepted,
+                 sub.report.pushes)
+    return 0 if merged else 1
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -67,6 +132,29 @@ def main(argv=None) -> int:
     plane = build_health_plane(cfg, c, monitor=True,
                                anomaly=AnomalyMonitor(),
                                start_heartbeat=False)
+    if cfg.hier == "sub":
+        # the sub-averager role shares the build + health plane but runs
+        # a different loop entirely (no base publication)
+        if plane.heartbeat is not None:
+            plane.heartbeat.start()
+        return _run_sub_averager(cfg, c, plane)
+    hierarchy = None
+    if cfg.hier == "root":
+        hierarchy = _hier_nodes(cfg)
+        if not hierarchy and cfg.hier_fanout > 0:
+            # fanout-only fleets: derive the auto-named node list from
+            # the boot-time metagraph (the subs derive the same names);
+            # --hier-nodes is the stable spelling when the fleet wobbles
+            from distributedtraining_tpu.engine.hier_average import \
+                plan_fanout
+            meta = c.chain.sync()
+            hierarchy = list(plan_fanout(
+                [h for h in meta.hotkeys if h != cfg.hotkey],
+                fanout=cfg.hier_fanout))
+        if not hierarchy:
+            raise SystemExit("--hier root needs --hier-nodes (or "
+                             "--hier-fanout) to know which __agg__ "
+                             "artifacts to gather")
     # publication lease (engine/remediate.py): held and renewed whenever
     # a remediating or standby-backed fleet runs, so base publication
     # stays single-writer across an averager failover
@@ -88,7 +176,8 @@ def main(argv=None) -> int:
                         ingest_cache_mb=cfg.ingest_cache_mb,
                         fleet=plane.fleet,
                         remediation=plane.remediation,
-                        lease=lease)
+                        lease=lease,
+                        hierarchy=hierarchy)
     if plane.heartbeat is not None:
         plane.heartbeat.vitals = report_vitals(
             loop.report, base_revision=lambda: loop._base_revision)

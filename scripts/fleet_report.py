@@ -37,9 +37,9 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402 — same directory; shares record loading
 
-COLUMNS = ("role", "hotkey", "beats", "age_s", "step_rate", "loss_ema",
-           "published", "accepted", "declined", "stale_rounds", "wire_b",
-           "score", "quar", "slo")
+COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
+           "loss_ema", "published", "accepted", "declined", "stale_rounds",
+           "wire_b", "score", "quar", "slo")
 
 
 def build_report(paths: list[str]) -> dict:
@@ -118,6 +118,12 @@ def build_report(paths: list[str]) -> dict:
 
 
 def _cell(node: dict, col: str) -> str:
+    if col == "tier":
+        # "agg" rows are sub-averager partial aggregates (__agg__.*,
+        # engine/hier_average.py) — their wire_b/accepted counts describe
+        # subtree aggregates, not individual miner submissions; older
+        # ledgers without the field read as plain miners
+        return node.get("tier") or "miner"
     if col == "age_s":
         v = node.get("last_seen_age_s")
         return "-" if v is None else f"{v:.1f}"

@@ -117,13 +117,15 @@ def _no_leaked_health_plane():
 def _no_leaked_localfs_tmp():
     """Shard-publish hygiene (the wire-v2 shard container rides the
     localfs transport's publish_raw): every localfs artifact write —
-    deltas, bases, SHARDS, manifests — must follow the tmp + fsync +
-    rename discipline, so a ``*.tmp`` file still present after a module
-    means a publish path died between the two steps (torn-publish
-    debris) or bypassed the atomic write altogether. A leaked tmp from
-    a mid-publish kill is exactly the artifact a reader must never
-    decode; fail the module that produced it. Scans every transport
-    root this process constructed (localfs.live_roots)."""
+    deltas, bases, SHARDS, manifests, ``__agg__.*`` partial aggregates —
+    must follow the tmp + fsync + rename discipline, so a ``*.tmp`` file
+    still present after a module means a publish path died between the
+    two steps (torn-publish debris) or bypassed the atomic write
+    altogether. A leaked tmp from a mid-publish kill is exactly the
+    artifact a reader must never decode; fail the module that produced
+    it — and name aggregate debris separately, because a torn aggregate
+    poisons a whole SUBTREE's contribution, not one miner's. Scans
+    every transport root this process constructed (localfs.live_roots)."""
     yield
     import glob as _glob
 
@@ -133,15 +135,47 @@ def _no_leaked_localfs_tmp():
     for root in localfs.live_roots():
         for sub in ("deltas", "base"):
             leaked += _glob.glob(os.path.join(root, sub, "*.tmp"))
+    agg_leaked = [p for p in leaked
+                  if os.path.basename(p).startswith("__agg__")]
     for path in leaked:   # force-clean so one offender cannot cascade
         try:
             os.unlink(path)
         except OSError:
             pass
+    assert not agg_leaked, (
+        f"test module leaked partially-published AGGREGATE artifacts: "
+        f"{agg_leaked}; a sub-averager publish (engine/hier_average.py) "
+        "died between tmp write and rename")
     assert not leaked, (
         f"test module leaked partially-published artifact temp files: "
         f"{leaked}; localfs writes must go through the atomic "
         "tmp+fsync+rename path (serialization.save_file / _write_atomic)")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _no_leaked_subaverager_threads():
+    """Hierarchy hygiene (engine/hier_average.py): a SubAverager owns an
+    ingest pool (covered by the ingest guard above) AND a DeltaPublisher
+    worker named ``publish-__agg__.*`` that blocks on its queue until
+    close() — a leaked one keeps publishing aggregates into whatever
+    transport the next module builds. Fail the module that left one
+    alive; the owning test must call SubAverager.close() in teardown."""
+    import threading
+    import time as _time
+
+    yield
+    deadline = _time.monotonic() + 6.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.is_alive() and (t.name.startswith("publish-__agg__")
+                                       or t.name.startswith("subavg-"))]
+        if not leaked:
+            return
+        if _time.monotonic() > deadline:
+            raise AssertionError(
+                f"test module left sub-averager threads alive: {leaked}; "
+                "close() the SubAverager in teardown")
+        _time.sleep(0.05)
 
 
 @pytest.fixture(autouse=True, scope="module")
