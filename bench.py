@@ -791,6 +791,109 @@ def _time_hier_average(*, n_miners: int = 32, fanout: int = 4,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _time_serve(*, n_requests: int = 8, prompt_len: int = 16,
+                gen_tokens: int = 24, trials: int = 2) -> dict:
+    """Serving-plane A/B (round-14 tentpole): naive sequential
+    per-request generation — one jitted FULL forward of the padded
+    sequence per token, requests one after another, the only spelling
+    available before engine/serve.py — vs the continuous-batching paged-
+    KV engine decoding all ``n_requests`` in one rolling batch. Both
+    sides are greedy and parity-checked token-for-token. Also measured:
+    the hot-swap stall (must sit below one decode-step p95 — the swap is
+    a pointer rebind, the fetch/stage happened off-thread) and fresh
+    compiles over a steady-state decode window (must be ZERO: the bucket
+    ladders are warm after the first batch)."""
+    from distributedtraining_tpu.engine.serve import GenerationEngine
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.utils import obs
+
+    cfg = gpt2.GPT2Config(vocab_size=256, n_positions=128, n_embd=64,
+                          n_layer=2, n_head=4, dtype="float32",
+                          vocab_multiple=128)
+    model, cfg = gpt2.make_model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=8)
+    params2 = model.init_params(jax.random.PRNGKey(7), seq_len=8)
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=prompt_len))
+               for _ in range(n_requests)]
+    T = prompt_len + gen_tokens
+
+    naive_prog = jax.jit(
+        lambda p, toks, cur: jnp.argmax(
+            model.apply({"params": p}, toks,
+                        attention_mask=(jnp.arange(T)[None, :]
+                                        < cur).astype(jnp.int32)
+                        )[0, cur - 1, :cfg.vocab_size]).astype(jnp.int32))
+
+    def naive_all() -> list[list[int]]:
+        outs = []
+        for p in prompts:
+            buf = np.zeros((1, T), np.int32)
+            buf[0, :len(p)] = p
+            cur, toks = len(p), []
+            for _ in range(gen_tokens):
+                nxt = int(naive_prog(params, buf, np.int32(cur)))
+                buf[0, cur] = nxt
+                toks.append(nxt)
+                cur += 1
+            outs.append(toks)
+        return outs
+
+    class _Sink:           # live registry for serve.* / compile.ms reads
+        def log(self, *a, **k):
+            pass
+
+    obs.configure(_Sink(), role="bench")
+    try:
+        engine = GenerationEngine(model, params, revision="r1",
+                                  max_slots=n_requests, page_size=16,
+                                  max_seq_len=((T + 15) // 16) * 16)
+        ref = naive_all()                       # compile + oracle
+        assert engine.generate(prompts, gen_tokens) == ref, \
+            "serve engine diverged from the naive loop"   # warm + parity
+        reg = obs.registry()
+        naive_s = engine_s = 0.0
+        fresh_compiles = 0
+        for _ in range(trials):                 # interleaved, like _ab_pairs
+            t0 = time.perf_counter()
+            naive_all()
+            naive_s += time.perf_counter() - t0
+            before = reg.histogram("compile.ms").count
+            t0 = time.perf_counter()
+            engine.generate(prompts, gen_tokens)
+            engine_s += time.perf_counter() - t0
+            fresh_compiles += reg.histogram("compile.ms").count - before
+        total = trials * n_requests * gen_tokens
+        naive_tps = total / naive_s
+        engine_tps = total / engine_s
+        step_p = reg.histogram("serve.step_ms").percentiles((50.0, 95.0))
+        tok_p = reg.histogram("serve.token_ms").percentiles((50.0, 95.0))
+        # hot swap: stage off-line (as the watcher thread would), then one
+        # idle-engine step installs it; the stall is what the decode loop
+        # actually paused for
+        engine._pending_swap = ("r2", jax.device_put(params2))
+        engine.step()
+        assert engine.revision == "r2"
+        swap_ms = reg.histogram("serve.swap_stall_ms").percentiles(
+            (95.0,))["p95"]
+        engine.close()
+        return {
+            "serve_naive_tokens_per_sec": round(naive_tps, 1),
+            "serve_batched_tokens_per_sec": round(engine_tps, 1),
+            "serve_speedup": round(engine_tps / naive_tps, 3),
+            "serve_batch": n_requests,
+            "serve_token_ms_p50": round(tok_p["p50"], 3),
+            "serve_token_ms_p95": round(tok_p["p95"], 3),
+            "serve_step_ms_p95": round(step_p["p95"], 3),
+            "serve_swap_stall_ms": round(swap_ms, 3),
+            "serve_swap_under_step_p95": bool(swap_ms < step_p["p95"]),
+            "serve_steady_fresh_compiles": int(fresh_compiles),
+            "serve_parity": True,
+        }
+    finally:
+        obs.reset()
+
+
 def _time_metrics_overhead(*, steps: int = 100, trials: int = 2,
                            log_every: int = 5) -> dict:
     """Observability-layer A/B (round-8 satellite): the production
@@ -1343,6 +1446,14 @@ def main() -> None:
         extras.update(_time_hier_average())
     except Exception as e:
         extras["hier_average_error"] = repr(e)
+
+    try:
+        # continuous-batching serving vs naive sequential generation
+        # (round-14 tentpole): tokens/sec at batch 8, per-token latency,
+        # hot-swap stall, steady-state fresh compiles (must be zero)
+        extras.update(_time_serve())
+    except Exception as e:
+        extras["serve_error"] = repr(e)
 
     try:
         # fleet health plane cost: production loop with the heartbeat

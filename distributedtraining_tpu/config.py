@@ -103,6 +103,22 @@ class RunConfig:
     remat: Optional[bool] = None             # per-block rematerialization
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
     accum_steps: int = 1                     # microbatches per optimizer step
+    # JAX persistent compilation cache (ROADMAP item 5): a directory all
+    # roles point jax_compilation_cache_dir at, so a role RESTART (and
+    # the PR-4 warm rounds) deserializes yesterday's XLA executables
+    # instead of recompiling them — compile.ms then measures cache-load
+    # time, not compile time. None disables (in-memory jit cache only).
+    compile_cache_dir: Optional[str] = None
+
+    # -- serving plane (engine/serve.py; neurons/server.py) -----------------
+    serve_port: int = 0                      # HTTP /generate port (0 = off)
+    serve_slots: int = 8                     # concurrent decode slots
+    serve_page_size: int = 16                # KV-cache page, in tokens
+    serve_kv_pages: int = 0                  # page-pool size (0 = auto)
+    serve_max_new: int = 64                  # default max_new_tokens
+    serve_max_seq: int = 0                   # cache len cap (0 = model max)
+    swap_policy: str = "drain"               # drain | restart
+    swap_poll: float = 15.0                  # base-revision poll (seconds)
 
     # -- mesh ---------------------------------------------------------------
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
@@ -468,6 +484,59 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "Wire artifacts (bases, deltas, adapters) stay in "
                         "the universal unrolled layout, so roles can flip "
                         "this independently")
+    g.add_argument("--compile-cache-dir", dest="compile_cache_dir",
+                   default=d.compile_cache_dir,
+                   help="JAX persistent compilation cache directory "
+                        "(created if missing): role restarts deserialize "
+                        "previously-compiled XLA executables instead of "
+                        "recompiling — point every role of a deployment "
+                        "at the same path. Unset = in-memory jit cache "
+                        "only (every restart recompiles)")
+
+    if role == "server":
+        g = p.add_argument_group("serving")
+        g.add_argument("--serve-port", dest="serve_port", type=int,
+                       default=d.serve_port,
+                       help="HTTP generation frontend on "
+                            "127.0.0.1:<port>/generate (0 = no HTTP; the "
+                            "engine still serves in-process submits)")
+        g.add_argument("--serve-slots", dest="serve_slots", type=int,
+                       default=d.serve_slots,
+                       help="concurrent decode slots (the continuous "
+                            "batch width; slot-count buckets ride a "
+                            "power-of-two compile ladder)")
+        g.add_argument("--page-size", dest="serve_page_size", type=int,
+                       default=d.serve_page_size,
+                       help="KV-cache page size in tokens (the paging "
+                            "granule: sequences own pages, not a "
+                            "max-length stripe)")
+        g.add_argument("--kv-pages", dest="serve_kv_pages", type=int,
+                       default=d.serve_kv_pages,
+                       help="total pages in the KV pool (0 = auto: "
+                            "slots x pages-per-max-sequence + trash "
+                            "page). Undersize deliberately to exercise "
+                            "preemption")
+        g.add_argument("--max-new-tokens", dest="serve_max_new", type=int,
+                       default=d.serve_max_new,
+                       help="default generation budget when a request "
+                            "does not specify one")
+        g.add_argument("--max-seq-len", dest="serve_max_seq", type=int,
+                       default=d.serve_max_seq,
+                       help="cache capacity per sequence in tokens "
+                            "(0 = the model's position cap; rounded "
+                            "down to a page multiple)")
+        g.add_argument("--swap-policy", dest="swap_policy",
+                       choices=("drain", "restart"),
+                       default=d.swap_policy,
+                       help="base hot-swap policy: 'drain' finishes "
+                            "in-flight sequences on the revision they "
+                            "started on (admission pauses), 'restart' "
+                            "swaps immediately and requeues in-flight "
+                            "prompts on the new revision")
+        g.add_argument("--swap-poll", dest="swap_poll",
+                       type=_nonneg_float, default=d.swap_poll,
+                       help="seconds between base-revision probes on "
+                            "the watcher thread")
 
     g = p.add_argument_group("mesh")
     g.add_argument("--dp", type=int, default=d.mesh.dp,

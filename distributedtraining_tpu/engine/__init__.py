@@ -18,6 +18,8 @@ from .ingest import DeltaCache, DeltaIngestor, IngestPool, StagedDelta
 from .publish import DeltaPublisher, PublishWorker, SupersedeQueue
 from .remediate import (LeaseManager, RemediationEngine, RemediationPolicy,
                         StandbyAverager, elastic_cohort)
+from .serve import (BaseRevisionWatcher, GenerationEngine, ServeHTTPFrontend,
+                    ServeLoop, ServeRequest, reference_generate)
 from .validate import Validator
 from .average import (
     AveragerLoop,
@@ -38,6 +40,8 @@ __all__ = [
     "Vitals", "default_slo_rules", "report_vitals",
     "LeaseManager", "RemediationEngine", "RemediationPolicy",
     "StandbyAverager", "elastic_cohort",
+    "BaseRevisionWatcher", "GenerationEngine", "ServeHTTPFrontend",
+    "ServeLoop", "ServeRequest", "reference_generate",
     "SubAverager", "plan_fanout", "subtree_weights",
     "Validator",
     "AveragerLoop", "WeightedAverage", "ParameterizedMerge", "GeneticMerge",

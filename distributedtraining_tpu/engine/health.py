@@ -445,6 +445,12 @@ class NodeHealth:
             rec["mem_peak_bytes"] = self.mem_peak_bytes
         if now is not None and self.last_seen_wall is not None:
             rec["last_seen_age_s"] = round(now - self.last_seen_wall, 3)
+        # producer extras (already name-linted + type-screened by
+        # parse_heartbeat) ride into the ledger record without clobbering
+        # schema fields — the server's tokens_per_sec/queue_depth reach
+        # fleet_report's tok_s column through here
+        for k, v in self.extra.items():
+            rec.setdefault(k, v)
         return rec
 
 
@@ -557,7 +563,12 @@ class FleetMonitor:
     role entry points gate on multihost.is_coordinator()).
     """
 
-    def __init__(self, transport, *, roles: Sequence[str] = ("miner",),
+    # servers (neurons/server.py) heartbeat like every other role; the
+    # monitor polls them alongside miners so the fleet table shows the
+    # served revision next to the trained/merged ones (a hotkey running
+    # no server simply yields no rider under that reserved id)
+    def __init__(self, transport, *,
+                 roles: Sequence[str] = ("miner", "server"),
                  rules: Sequence[SLORule] | None = None,
                  anomaly=None, metrics=None, clock=None, workers: int = 4):
         from .ingest import IngestPool

@@ -1,0 +1,977 @@
+"""Serving plane: continuous-batching generation over the live base model.
+
+The north star says *serve heavy traffic from millions of users*; until
+this module nothing in the repo served. The federated loop's payoff —
+the averager's continuously-improving base — is deployed continuously
+here: a :class:`GenerationEngine` decodes a rolling batch of requests
+and **hot-swaps** base-model revisions between decode steps, turning the
+fleet into "train in public, deploy continuously" (ROADMAP item 3; the
+TPU serving recipe — batched decode, static-shaped cache, compiled-once
+step — follows the Gemma-on-TPU paper in PAPERS.md, 2605.25645).
+
+Design, in the order it matters on TPU:
+
+- **Compiled-once decode.** One jitted prefill program per prompt-length
+  bucket and one jitted decode-step program per (batch-slot bucket,
+  KV-page bucket) — the PR-8 bucket-ladder discipline
+  (engine/batched_eval.py): shapes ride a power-of-two ladder,
+  ``prefer_compiled`` pads a miss up to an already-compiled bucket, and
+  steady-state decode runs ZERO fresh compiles (pinned via the shared
+  ``compile.ms`` histogram; ``serve.decode_bucket_compiles`` counts
+  occurrences).
+- **Paged KV cache.** One fixed page pool per process —
+  ``[layers, pages, page_size, kv_heads, head_dim]`` — with per-slot
+  page tables. A sequence owns exactly the pages its length needs, so
+  admitting a short prompt next to a long generation never pads the
+  whole batch to the longest sequence: decode recomputes ONE token per
+  sequence per step and attention gathers each slot's own pages (dead
+  page slots are masked by real lengths — ops/attention.cached_attention).
+  Long prompts prefill through the standard model forward, i.e. through
+  ops/flash_attention.py wherever the model's ``attention_impl`` does.
+  Page exhaustion preempts the youngest sequence back to the queue
+  (deterministic under greedy decode) instead of OOMing the pool.
+- **Continuous batching.** The scheduler admits queued requests into
+  free slots every step, evicts finished sequences immediately, and
+  keeps the decode program full; per-token latency is one decode step,
+  not one full-batch generation.
+- **Hot swap.** A :class:`BaseRevisionWatcher` subscribes to the
+  averager's base revisions through the existing Transport on a
+  background thread, stages the fetched tree on device, and the engine
+  installs it BETWEEN decode steps (double-buffered: params are plain
+  jit arguments and are never donated, so an in-flight program keeps its
+  buffer while the next step picks up the new one — the swap itself is a
+  pointer rebind, measured as ``serve.swap_stall_ms``). Policy "drain":
+  in-flight sequences finish on the revision they started on (admission
+  pauses until they do); policy "restart": swap immediately and requeue
+  in-flight prompts on the new revision. A torn or failed revision fetch
+  degrades to the current base — the batch never stalls on the Hub.
+
+Everything is exposed through the PR-3 obs registry as ``serve.*`` and
+scraped by the PR-5 exporter as ``dt_serve_*`` gauges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import logging
+import re
+import threading
+import time
+import weakref
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import obs
+from .batched_eval import _timed_compile
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+DEFAULT_PAGE_SIZE = 16
+
+_LIVE_FRONTENDS: "weakref.WeakSet[ServeHTTPFrontend]" = weakref.WeakSet()
+
+
+def live_frontends() -> list["ServeHTTPFrontend"]:
+    """Frontends with a listening socket — the tests/conftest.py hygiene
+    guard fails any module that leaves one serving."""
+    return list(_LIVE_FRONTENDS)
+
+
+# ---------------------------------------------------------------------------
+# Requests and slots
+# ---------------------------------------------------------------------------
+
+_RID = itertools.count()
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One generation request's lifecycle. ``tokens`` accumulates the
+    GENERATED ids (the prompt is not echoed); ``revision`` is the base
+    revision the finished output was decoded on (the whole output, under
+    the drain policy; the post-restart revision under restart)."""
+    prompt: list
+    max_new_tokens: int
+    rid: int = dataclasses.field(default_factory=lambda: next(_RID))
+    tokens: list = dataclasses.field(default_factory=list)
+    status: str = "queued"      # queued | active | done | truncated
+    revision: str | None = None
+    submitted_t: float = dataclasses.field(default_factory=time.time)
+    done_evt: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.done_evt.wait(timeout)
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: ServeRequest
+    pages: list          # page-pool indices this sequence owns
+    seq_len: int         # tokens currently in the KV cache
+    last_tok: int        # next input token (already emitted to req.tokens)
+    order: int           # admission order (preemption picks the youngest)
+
+
+# ---------------------------------------------------------------------------
+# Bucket ladder (the PR-8 compiled-bucket discipline, per dimension)
+# ---------------------------------------------------------------------------
+
+class BucketLadder:
+    """Power-of-two ladder up to ``top`` (then multiples of ``top``),
+    with the ``prefer_compiled`` pad-up rule from
+    BatchedCohortEvaluator.bucket_for: when the exact-fit bucket is not
+    yet compiled but a larger one is, reuse the compiled one (padding
+    waste) instead of walking the ladder through fresh compiles."""
+
+    def __init__(self, top: int, *, prefer_compiled: bool = True):
+        if top < 1:
+            raise ValueError(f"ladder top must be >= 1, got {top}")
+        buckets = []
+        b = 1
+        while b < top:
+            buckets.append(b)
+            b *= 2
+        buckets.append(top)
+        self.buckets = tuple(buckets)
+        self.prefer_compiled = prefer_compiled
+        self.seen: set[int] = set()
+
+    def bucket_for(self, n: int) -> int:
+        if n < 1:
+            raise ValueError(f"need >= 1, got {n}")
+        for b in self.buckets:
+            if n <= b:
+                target = b
+                break
+        else:
+            top = self.buckets[-1]
+            target = ((n + top - 1) // top) * top
+        if self.prefer_compiled and target not in self.seen:
+            bigger = sorted(b for b in self.seen if b >= target)
+            if bigger:
+                target = bigger[0]
+        return target
+
+    def mark(self, b: int) -> bool:
+        """Record a dispatch at bucket ``b``; True when it is fresh
+        (= a compile happened)."""
+        fresh = b not in self.seen
+        self.seen.add(b)
+        return fresh
+
+
+# ---------------------------------------------------------------------------
+# Reference oracle
+# ---------------------------------------------------------------------------
+
+# one jitted full-forward per (model, padded length) for the reference
+# loop below — the ORACLE math is unchanged (full recompute of the whole
+# sequence per token, no KV reuse, no paging; right-padding is masked to
+# exact zeros), jit just stops every call from re-tracing eagerly
+_REF_PROGS: dict[tuple, Callable] = {}
+
+
+def reference_generate(model, params, prompt: Sequence[int],
+                       max_new_tokens: int, *, eos_id: int | None = None
+                       ) -> list[int]:
+    """The O(T^2) correctness oracle: greedy argmax over a FULL model
+    forward of the growing sequence per token — no cache, nothing shared
+    with the engine's decode path. The engine's output is pinned
+    token-identical to this loop (tests/test_serve.py); it is also the
+    "naive sequential" spelling bench._time_serve A/Bs against."""
+    cfg = model.cfg
+    toks = [int(t) for t in prompt]
+    total = len(toks) + max_new_tokens
+    t_pad = ((total + 15) // 16) * 16
+    key = (id(model), t_pad)
+    prog = _REF_PROGS.get(key)
+    if prog is None:
+        def fwd(p, ids, cur):
+            amask = (jnp.arange(t_pad)[None, :] < cur).astype(jnp.int32)
+            logits = model.apply({"params": p}, ids, attention_mask=amask)
+            return jnp.argmax(
+                logits[0, cur - 1, :cfg.vocab_size]).astype(jnp.int32)
+
+        prog = _REF_PROGS[key] = jax.jit(fwd)
+    buf = np.zeros((1, t_pad), np.int32)
+    buf[0, :len(toks)] = toks
+    cur = len(toks)
+    out: list[int] = []
+    for _ in range(max_new_tokens):
+        nxt = int(prog(params, buf, np.int32(cur)))
+        buf[0, cur] = nxt
+        out.append(nxt)
+        cur += 1
+        if eos_id is not None and nxt == eos_id:
+            break
+    return out
+
+
+def host_param_template(model) -> Params:
+    """Host zeros tree in the model's param structure — what
+    ``Transport.fetch_base`` wants as its template."""
+    abstract = jax.eval_shape(
+        lambda: model.init_params(jax.random.PRNGKey(0)))
+    return jax.tree_util.tree_map(
+        lambda a: np.zeros(a.shape, a.dtype), abstract)
+
+
+def _layer_keys(params) -> list[str]:
+    """Transformer block keys of an UNROLLED param tree, in layer order
+    (``h_0..`` for GPT-2, ``layer_0..`` for Llama) — the same keys the
+    ``intermediates`` collection uses for sown (k, v)."""
+    found = []
+    for k in params:
+        m = re.fullmatch(r"(h_|layer_)(\d+)", k)
+        if m:
+            found.append((int(m.group(2)), k))
+    if not found:
+        raise ValueError(
+            "no transformer block keys (h_*/layer_*) in the param tree; "
+            "is this an unrolled GPT-2/Llama base?")
+    return [k for _, k in sorted(found)]
+
+
+# ---------------------------------------------------------------------------
+# Base-revision watcher (the transport subscription)
+# ---------------------------------------------------------------------------
+
+class BaseRevisionWatcher:
+    """Polls ``transport.base_revision()`` on a daemon thread (named
+    ``serve-watch``); on change, fetches the base and STAGES it on device
+    off the decode thread, so the engine's swap is a pointer rebind. Any
+    failure — revision probe, torn fetch, decode error — counts
+    ``serve.swap_fetch_failures`` and leaves the current base serving
+    (the ChaosTransport round in tests/test_serve.py pins this)."""
+
+    def __init__(self, transport, template_fn: Callable[[], Params], *,
+                 poll_s: float = 10.0, start_revision: str | None = None):
+        self._transport = transport
+        self._template_fn = template_fn
+        self.poll_s = poll_s
+        self._last_seen = start_revision
+        self._pending: tuple[str | None, Params] | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "BaseRevisionWatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="serve-watch", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # a watcher crash must never kill serving
+                logger.exception("base watcher poll failed")
+
+    def poll_once(self) -> bool:
+        """One synchronous probe+stage attempt (tests drive this
+        directly). True when a new revision was staged."""
+        try:
+            rev = self._transport.base_revision()
+        except Exception:
+            obs.count("serve.swap_fetch_failures")
+            return False
+        if rev is None or rev == self._last_seen:
+            return False
+        try:
+            got = self._transport.fetch_base(self._template_fn())
+        except Exception:
+            obs.count("serve.swap_fetch_failures")
+            logger.warning("base fetch for revision %s failed; serving "
+                           "stays on the current base", rev, exc_info=True)
+            return False
+        if got is None:
+            obs.count("serve.swap_fetch_failures")
+            return False
+        base, fetched_rev = got
+        placed = jax.device_put(base)
+        jax.block_until_ready(placed)   # stage fully OFF the decode thread
+        with self._lock:
+            self._pending = (fetched_rev, placed)
+            self._last_seen = fetched_rev
+        obs.count("serve.swaps_staged")
+        logger.info("staged base revision %s for hot swap", fetched_rev)
+        return True
+
+    def take_pending(self) -> tuple[str | None, Params] | None:
+        with self._lock:
+            p, self._pending = self._pending, None
+            return p
+
+    def close(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# The engine
+# ---------------------------------------------------------------------------
+
+class GenerationEngine:
+    """Continuous-batching greedy decoder over a paged KV cache.
+
+    ``model`` is a GPT-2/Llama flax module; the engine rebuilds it with
+    ``remat=False, scan_blocks=False`` (generation never differentiates,
+    and wire bases are unrolled already) — pass TRAINING params freely,
+    the trees are identical. Thread contract: ``submit`` is thread-safe
+    (HTTP handler threads call it); ``step`` must be driven from ONE
+    thread (``ServeLoop`` or the role main)."""
+
+    def __init__(self, model, params: Params | None = None, *,
+                 revision: str | None = None,
+                 max_slots: int = 8,
+                 page_size: int = DEFAULT_PAGE_SIZE,
+                 pool_pages: int = 0,
+                 max_seq_len: int = 0,
+                 max_new_tokens: int = 64,
+                 eos_id: int | None = None,
+                 prefer_compiled: bool = True,
+                 swap_policy: str = "drain",
+                 watcher: BaseRevisionWatcher | None = None):
+        if swap_policy not in ("drain", "restart"):
+            raise ValueError(f"swap_policy must be drain|restart, "
+                             f"got {swap_policy!r}")
+        if max_slots < 1 or page_size < 1:
+            raise ValueError("max_slots and page_size must be >= 1")
+        cfg = model.cfg
+        cfg = dataclasses.replace(cfg, remat=False, scan_blocks=False)
+        self.model = type(model)(cfg)
+        self.cfg = cfg
+        self.page_size = page_size
+        self.max_slots = max_slots
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.swap_policy = swap_policy
+        self.watcher = watcher
+        cap = getattr(cfg, "n_positions", None) or getattr(
+            cfg, "max_seq_len", 0)
+        # page-align DOWN so no prefill bucket can exceed the model's
+        # position capacity (a padded prefill never indexes wpe/rope
+        # beyond it)
+        self.max_seq_len = (min(max_seq_len or cap, cap)
+                            // page_size) * page_size
+        if self.max_seq_len < page_size:
+            raise ValueError(f"max_seq_len {self.max_seq_len} < page_size "
+                             f"{page_size}")
+        self.pages_per_slot = self.max_seq_len // page_size
+        # page 0 is the TRASH page: padded batch slots and padded
+        # page-table entries all point at it, so scatter writes from
+        # dead lanes land somewhere harmless
+        self.pool_pages = pool_pages or (
+            1 + self.max_slots * self.pages_per_slot)
+        if self.pool_pages < 1 + self.pages_per_slot:
+            raise ValueError(
+                f"pool_pages {self.pool_pages} cannot hold even one "
+                f"max-length sequence ({self.pages_per_slot} pages) + "
+                "the trash page")
+
+        self._slot_ladder = BucketLadder(max_slots,
+                                         prefer_compiled=prefer_compiled)
+        self._page_ladder = BucketLadder(self.pages_per_slot,
+                                         prefer_compiled=prefer_compiled)
+        self._prefill_ladder = BucketLadder(self.pages_per_slot,
+                                            prefer_compiled=prefer_compiled)
+        self.prefer_compiled = prefer_compiled
+
+        self._decode_progs: dict[tuple[int, int], Callable] = {}
+        self._prefill_progs: dict[int, Callable] = {}
+        # donation lets XLA update the page pool in place (it is the
+        # dominant buffer); CPU ignores donation with a warning, so skip
+        self._donate = jax.default_backend() not in ("cpu",)
+
+        self._params: Params | None = None
+        self.revision: str | None = None
+        self._layers: list[str] | None = None
+        self._kv: tuple[jax.Array, jax.Array] | None = None
+        self._free_pages: list[int] = []
+        self._active: list[_Slot] = []
+        self._queue: deque[ServeRequest] = deque()
+        self._qlock = threading.Lock()
+        self._work_evt = threading.Event()
+        self._pending_swap: tuple[str | None, Params] | None = None
+        self._decode_seen: set[tuple[int, int]] = set()
+        # set on preemption, cleared when a slot finishes: admission
+        # would otherwise immediately re-take the pages growth just
+        # freed and the pool would livelock at 100% churn
+        self._admit_hold = False
+        self._order = itertools.count()
+        self._tok_rate_ema: float | None = None
+        self.steps = 0
+        self.tokens_emitted = 0
+        if params is not None:
+            self.install_params(params, revision=revision)
+
+    # -- weights ------------------------------------------------------------
+    def install_params(self, params: Params, *,
+                       revision: str | None = None) -> None:
+        """Bind a base revision as the serving weights (boot path and the
+        swap path). Params are jit ARGUMENTS (never donated), so a swap
+        cannot invalidate an in-flight program's buffers — the old tree
+        simply drops its last reference."""
+        placed = jax.device_put(params)
+        if self._layers is None:
+            self._layers = _layer_keys(placed)
+            self._init_kv()
+        self._params = placed
+        self.revision = revision
+
+    def _init_kv(self) -> None:
+        cfg = self.cfg
+        hkv = getattr(cfg, "n_kv_head", None) or cfg.n_head
+        shape = (len(self._layers), self.pool_pages, self.page_size,
+                 hkv, cfg.head_dim)
+        dt = cfg.compute_dtype()
+        self._kv = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+        self._free_pages = list(range(1, self.pool_pages))
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               max_new_tokens: int | None = None) -> ServeRequest:
+        """Queue one generation request (thread-safe). Prompts longer
+        than the cache capacity are rejected up front."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        n_new = max_new_tokens if max_new_tokens is not None \
+            else self.max_new_tokens
+        if len(prompt) + n_new > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({n_new}) "
+                f"exceeds max_seq_len {self.max_seq_len}")
+        req = ServeRequest(prompt=prompt, max_new_tokens=n_new)
+        with self._qlock:
+            self._queue.append(req)
+        obs.count("serve.requests")
+        self._work_evt.set()
+        return req
+
+    def _pop_queued(self) -> ServeRequest | None:
+        with self._qlock:
+            return self._queue.popleft() if self._queue else None
+
+    def _requeue_front(self, req: ServeRequest) -> None:
+        req.tokens.clear()
+        req.status = "queued"
+        with self._qlock:
+            self._queue.appendleft(req)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._qlock:
+            return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def idle(self) -> bool:
+        return not self._active and self.queue_depth == 0
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self._tok_rate_ema or 0.0
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Block until a request arrives (ServeLoop's idle parking)."""
+        got = self._work_evt.wait(timeout)
+        if got:
+            self._work_evt.clear()
+        return got
+
+    # -- programs -----------------------------------------------------------
+    def _stack_kv(self, inter) -> tuple[jax.Array, jax.Array]:
+        ks, vs = [], []
+        for name in self._layers:
+            k, v = inter[name]["kv_cache"][0]
+            ks.append(k)
+            vs.append(v)
+        return jnp.stack(ks), jnp.stack(vs)   # [L, B, T, Hkv, D]
+
+    def _prefill_prog(self, t_bucket: int) -> Callable:
+        prog = self._prefill_progs.get(t_bucket)
+        if prog is not None:
+            return prog
+        model, P, vocab = self.model, self.page_size, self.cfg.vocab_size
+        mp = t_bucket // P
+        stack_kv = self._stack_kv
+
+        def prefill(params, tokens, prompt_len, k_pages, v_pages, page_row):
+            amask = (jnp.arange(t_bucket)[None, :]
+                     < prompt_len).astype(jnp.int32)
+            logits, muts = model.apply(
+                {"params": params}, tokens, attention_mask=amask,
+                sow_kv=True, mutable=["intermediates"])
+            k, v = stack_kv(muts["intermediates"])      # [L, 1, T, Hkv, D]
+            k = k[:, 0].reshape(k.shape[0], mp, P, *k.shape[-2:])
+            v = v[:, 0].reshape(v.shape[0], mp, P, *v.shape[-2:])
+            k_pages = k_pages.at[:, page_row].set(k)
+            v_pages = v_pages.at[:, page_row].set(v)
+            nxt = jnp.argmax(logits[0, prompt_len - 1, :vocab])
+            return nxt.astype(jnp.int32), k_pages, v_pages
+
+        prog = jax.jit(prefill,
+                       donate_argnums=(3, 4) if self._donate else ())
+        self._prefill_progs[t_bucket] = prog
+        return prog
+
+    def _decode_prog(self, n_slots: int, n_pages: int) -> Callable:
+        prog = self._decode_progs.get((n_slots, n_pages))
+        if prog is not None:
+            return prog
+        model, P, vocab = self.model, self.page_size, self.cfg.vocab_size
+        L = len(self._layers)
+        stack_kv = self._stack_kv
+
+        def step(params, k_pages, v_pages, page_tables, seq_lens, tokens):
+            # per-slot context gather from the page pool: the classic
+            # paged-attention spelling — [L, B, MP, P, H, D] and flatten
+            # the page axis into a padded context of MP*P positions
+            k_ctx = k_pages[:, page_tables]
+            v_ctx = v_pages[:, page_tables]
+            B = tokens.shape[0]
+            S = n_pages * P
+            k_ctx = k_ctx.reshape(L, B, S, *k_ctx.shape[-2:])
+            v_ctx = v_ctx.reshape(L, B, S, *v_ctx.shape[-2:])
+            kv_ctx = tuple((k_ctx[i], v_ctx[i]) for i in range(L))
+            logits, muts = model.apply(
+                {"params": params}, tokens[:, None],
+                position_ids=seq_lens[:, None],
+                kv_ctx=kv_ctx, kv_lens=seq_lens,
+                sow_kv=True, mutable=["intermediates"])
+            new_k, new_v = stack_kv(muts["intermediates"])  # [L, B, 1, H, D]
+            page_idx = jnp.take_along_axis(
+                page_tables, (seq_lens // P)[:, None], axis=1)[:, 0]
+            off = seq_lens % P
+            k_pages = k_pages.at[:, page_idx, off].set(new_k[:, :, 0])
+            v_pages = v_pages.at[:, page_idx, off].set(new_v[:, :, 0])
+            nxt = jnp.argmax(logits[:, -1, :vocab], axis=-1)
+            return nxt.astype(jnp.int32), k_pages, v_pages
+
+        prog = jax.jit(step, donate_argnums=(1, 2) if self._donate else ())
+        self._decode_progs[(n_slots, n_pages)] = prog
+        return prog
+
+    def _decode_bucket(self, need_slots: int,
+                       need_pages: int) -> tuple[int, int]:
+        sb = self._slot_ladder.bucket_for(need_slots)
+        pb = self._page_ladder.bucket_for(need_pages)
+        if self.prefer_compiled and (sb, pb) not in self._decode_progs:
+            # joint pad-up: a compiled (bigger, bigger) program beats a
+            # fresh exact-fit compile on BOTH axes (the per-dimension
+            # ladders only see their own axis)
+            cands = [k for k in self._decode_progs
+                     if k[0] >= need_slots and k[1] >= need_pages]
+            if cands:
+                return min(cands, key=lambda k: k[0] * k[1])
+        return sb, pb
+
+    # -- paging -------------------------------------------------------------
+    def _alloc_pages(self, n: int) -> list | None:
+        if len(self._free_pages) < n:
+            return None
+        out = self._free_pages[:n]
+        del self._free_pages[:n]
+        return out
+
+    def _release(self, slot: _Slot) -> None:
+        self._free_pages.extend(slot.pages)
+        slot.pages = []
+
+    def _finish(self, slot: _Slot, status: str) -> None:
+        self._admit_hold = False
+        self._release(slot)
+        slot.req.status = status
+        slot.req.revision = self.revision
+        slot.req.done_evt.set()
+        self._active.remove(slot)
+        if status == "truncated":
+            obs.count("serve.truncated")
+
+    def _preempt_one(self, protect: _Slot | None = None) -> bool:
+        """Free the youngest active slot's pages and requeue its request
+        (greedy decode regenerates identically). The page-exhaustion
+        escape hatch."""
+        victims = [s for s in self._active if s is not protect]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.order)
+        self._release(victim)
+        self._active.remove(victim)
+        self._requeue_front(victim.req)
+        self._admit_hold = True
+        obs.count("serve.preempted")
+        logger.info("preempted request %d (page pool exhausted)",
+                    victim.req.rid)
+        return True
+
+    # -- hot swap -----------------------------------------------------------
+    def _maybe_swap(self) -> None:
+        if self.watcher is not None:
+            staged = self.watcher.take_pending()
+            if staged is not None:
+                self._pending_swap = staged   # latest staged revision wins
+        if self._pending_swap is None:
+            return
+        if self.swap_policy == "restart" and self._active:
+            # in-flight sequences restart from their prompts on the new
+            # revision; their pages go back to the pool first
+            for slot in list(self._active):
+                self._release(slot)
+                self._active.remove(slot)
+                self._requeue_front(slot.req)
+                obs.count("serve.swap_restarts")
+        if self._active:
+            return   # drain: finish in-flight on their revision first
+        rev, placed = self._pending_swap
+        t0 = time.perf_counter()
+        self._params = placed
+        self.revision = rev
+        self._pending_swap = None
+        obs.observe("serve.swap_stall_ms",
+                    (time.perf_counter() - t0) * 1e3)
+        obs.count("serve.swaps")
+        logger.info("hot-swapped base to revision %s", rev)
+
+    # -- scheduling ---------------------------------------------------------
+    def _admit(self) -> None:
+        while (self._pending_swap is None or self.swap_policy == "restart") \
+                and not (self._admit_hold and self._active) \
+                and len(self._active) < self.max_slots:
+            req = self._pop_queued()
+            if req is None:
+                return
+            n0 = len(req.prompt) // self.page_size + 1
+            pages = self._alloc_pages(n0)
+            if pages is None:
+                self._requeue_front(req)
+                return
+            self._prefill(req, pages)
+
+    def _prefill(self, req: ServeRequest, pages: list) -> None:
+        P = self.page_size
+        plen = len(req.prompt)
+        t_bucket = self._prefill_ladder.bucket_for(
+            (plen + P - 1) // P) * P
+        mp = t_bucket // P
+        toks = np.zeros((1, t_bucket), np.int32)
+        toks[0, :plen] = req.prompt
+        page_row = np.zeros((mp,), np.int32)
+        row = pages[:mp]
+        page_row[:len(row)] = row
+        prog = self._prefill_prog(t_bucket)
+        k_pages, v_pages = self._kv
+        t0 = time.perf_counter()
+        if self._prefill_ladder.mark(t_bucket // P):
+            obs.count("serve.prefill_bucket_compiles")
+            nxt, k_pages, v_pages = _timed_compile(
+                prog, self._params, toks, np.int32(plen),
+                k_pages, v_pages, page_row)
+        else:
+            nxt, k_pages, v_pages = prog(
+                self._params, toks, np.int32(plen), k_pages, v_pages,
+                page_row)
+        self._kv = (k_pages, v_pages)
+        nxt = int(nxt)
+        obs.observe("serve.prefill_ms", (time.perf_counter() - t0) * 1e3)
+        obs.count("serve.prefills")
+        req.status = "active"
+        slot = _Slot(req=req, pages=pages, seq_len=plen, last_tok=nxt,
+                     order=next(self._order))
+        self._active.append(slot)
+        self._emit(slot, nxt)
+
+    def _emit(self, slot: _Slot, tok: int) -> None:
+        slot.req.tokens.append(tok)
+        self.tokens_emitted += 1
+        obs.count("serve.tokens")
+        if (self.eos_id is not None and tok == self.eos_id) or \
+                len(slot.req.tokens) >= slot.req.max_new_tokens:
+            self._finish(slot, "done")
+        elif slot.seq_len >= self.max_seq_len:
+            # the next decode would write past the cache; submit()'s
+            # length check makes this unreachable, kept as a hard stop
+            self._finish(slot, "truncated")
+
+    def _grow(self) -> None:
+        """Ensure every active slot owns the page its next write lands
+        in; preempt the youngest sequence when the pool runs dry."""
+        for slot in list(self._active):
+            if slot not in self._active:
+                continue   # preempted by an earlier slot's growth
+            need = slot.seq_len // self.page_size + 1
+            while len(slot.pages) < need:
+                got = self._alloc_pages(1)
+                if got is not None:
+                    slot.pages.extend(got)
+                    continue
+                if not self._preempt_one(protect=slot):
+                    # nothing left to steal from: cut this one short
+                    self._finish(slot, "truncated")
+                    break
+
+    def _decode(self) -> int:
+        active = self._active
+        if not active:
+            return 0
+        need_pages = max(s.seq_len // self.page_size + 1 for s in active)
+        sb, pb = self._decode_bucket(len(active), need_pages)
+        tables = np.zeros((sb, pb), np.int32)
+        seq_lens = np.zeros((sb,), np.int32)
+        tokens = np.zeros((sb,), np.int32)
+        for i, slot in enumerate(active):
+            row = slot.pages[:pb]
+            tables[i, :len(row)] = row
+            seq_lens[i] = slot.seq_len
+            tokens[i] = slot.last_tok
+        prog = self._decode_prog(sb, pb)
+        k_pages, v_pages = self._kv
+        self._slot_ladder.mark(sb)
+        self._page_ladder.mark(pb)
+        if (sb, pb) not in self._decode_seen:
+            self._decode_seen.add((sb, pb))
+            obs.count("serve.decode_bucket_compiles")
+            nxt, k_pages, v_pages = _timed_compile(
+                prog, self._params, k_pages, v_pages, tables, seq_lens,
+                tokens)
+        else:
+            nxt, k_pages, v_pages = prog(self._params, k_pages, v_pages,
+                                         tables, seq_lens, tokens)
+        self._kv = (k_pages, v_pages)
+        nxt = np.asarray(jax.device_get(nxt))
+        emitted = 0
+        for i, slot in enumerate(list(active)):
+            slot.seq_len += 1
+            slot.last_tok = int(nxt[i])
+            self._emit(slot, int(nxt[i]))
+            emitted += 1
+        return emitted
+
+    def step(self) -> dict:
+        """One scheduler iteration: swap check, admission, one decode
+        step over the active batch. Returns step stats."""
+        if self._params is None:
+            raise RuntimeError("no base installed; call install_params "
+                               "(or attach a watcher and publish a base)")
+        t0 = time.perf_counter()
+        self._maybe_swap()
+        self._admit()
+        self._grow()
+        emitted = self._decode()
+        dur = time.perf_counter() - t0
+        self.steps += 1
+        obs.observe("serve.step_ms", dur * 1e3)
+        if emitted:
+            # one decode step IS each emitted token's latency
+            obs.observe("serve.token_ms", dur * 1e3)
+            rate = emitted / max(dur, 1e-9)
+            self._tok_rate_ema = rate if self._tok_rate_ema is None else (
+                self._tok_rate_ema + 0.2 * (rate - self._tok_rate_ema))
+            obs.gauge("serve.tokens_per_sec", self._tok_rate_ema)
+        obs.gauge("serve.queue_depth", self.queue_depth)
+        obs.gauge("serve.active_slots", len(self._active))
+        obs.gauge("serve.free_pages", len(self._free_pages))
+        return {"emitted": emitted, "active": len(self._active),
+                "queued": self.queue_depth, "step_ms": dur * 1e3,
+                "revision": self.revision}
+
+    # -- conveniences -------------------------------------------------------
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int | None = None,
+                 *, max_steps: int = 100_000) -> list[list[int]]:
+        """Submit a batch and drive the scheduler to completion (tests,
+        bench, one-shot CLI use)."""
+        reqs = [self.submit(p, max_new_tokens) for p in prompts]
+        for _ in range(max_steps):
+            if all(r.done_evt.is_set() for r in reqs):
+                break
+            self.step()
+        else:
+            raise RuntimeError("generation did not converge in "
+                               f"{max_steps} steps")
+        return [list(r.tokens) for r in reqs]
+
+    def close(self) -> None:
+        if self.watcher is not None:
+            self.watcher.close()
+        for slot in list(self._active):
+            self._finish(slot, "truncated")
+        with self._qlock:
+            drained = list(self._queue)
+            self._queue.clear()
+        for req in drained:
+            req.status = "truncated"
+            req.done_evt.set()
+
+
+# ---------------------------------------------------------------------------
+# Serve loop + HTTP frontend (neurons/server.py wires these)
+# ---------------------------------------------------------------------------
+
+class ServeLoop:
+    """Drives ``engine.step()`` on a daemon thread (named ``serve-loop``)
+    so HTTP handler threads only ever touch the thread-safe ``submit``
+    path. Parks on the engine's work event when idle — no busy spin."""
+
+    def __init__(self, engine: GenerationEngine, *,
+                 idle_poll_s: float = 0.2):
+        self.engine = engine
+        self.idle_poll_s = idle_poll_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ServeLoop":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run,
+                                            name="serve-loop", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.engine.idle:
+                    self.engine.wait_for_work(self.idle_poll_s)
+                    continue
+                self.engine.step()
+            except Exception:
+                logger.exception("serve loop step failed")
+                self._stop.wait(0.5)
+
+    def close(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+
+class ServeHTTPFrontend:
+    """Minimal stdlib JSON frontend (same shape as ObsHTTPExporter —
+    no new dependencies, 127.0.0.1 by default, daemon threads, tracked
+    for the conftest socket guard).
+
+    - ``POST /generate`` ``{"tokens": [...]} | {"text": "..."}`` plus
+      optional ``max_new_tokens`` — blocks until the request finishes
+      (or ``timeout_s``) and returns generated tokens (+ text when a
+      tokenizer is attached), status, and the base revision served.
+    - ``GET /healthz`` — queue depth, active slots, revision,
+      tokens/sec.
+    """
+
+    def __init__(self, engine: GenerationEngine, port: int = 0, *,
+                 host: str = "127.0.0.1", tokenizer=None,
+                 timeout_s: float = 120.0):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.tokenizer = tokenizer
+        self.timeout_s = timeout_s
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> int:
+        if self._server is not None:
+            return self.port
+        fe = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("serve_http: " + fmt, *args)
+
+            def _send(self, code: int, obj) -> None:
+                body = (json.dumps(obj) + "\n").encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                if self.path.split("?", 1)[0] == "/healthz":
+                    e = fe.engine
+                    self._send(200, {
+                        "ok": True, "queue_depth": e.queue_depth,
+                        "active": e.active_count,
+                        "revision": e.revision,
+                        "tokens_per_sec": e.tokens_per_sec})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                if self.path.split("?", 1)[0] != "/generate":
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    toks = payload.get("tokens")
+                    if toks is None and "text" in payload:
+                        if fe.tokenizer is None:
+                            raise ValueError(
+                                "text prompts need a tokenizer; send "
+                                "token ids")
+                        toks = fe.tokenizer.encode(payload["text"])
+                    if not isinstance(toks, list) or not toks:
+                        raise ValueError("need a non-empty 'tokens' list "
+                                         "or 'text'")
+                    req = fe.engine.submit(
+                        toks, payload.get("max_new_tokens"))
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                if not req.wait(fe.timeout_s):
+                    self._send(504, {"error": "generation timed out",
+                                     "rid": req.rid})
+                    return
+                out = {"rid": req.rid, "tokens": req.tokens,
+                       "status": req.status, "revision": req.revision}
+                if fe.tokenizer is not None:
+                    try:
+                        out["text"] = fe.tokenizer.decode(req.tokens)
+                    except Exception:
+                        pass
+                self._send(200, out)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"serve-http-{self.port}",
+                                        daemon=True)
+        self._thread.start()
+        _LIVE_FRONTENDS.add(self)
+        logger.info("serving generation on http://%s:%d/generate",
+                    self.host, self.port)
+        return self.port
+
+    @property
+    def running(self) -> bool:
+        return self._server is not None
+
+    def close(self) -> None:
+        server, self._server = self._server, None
+        thread, self._thread = self._thread, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)
+        _LIVE_FRONTENDS.discard(self)

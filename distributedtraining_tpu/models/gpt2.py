@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.attention import causal_attention
+from ..ops.attention import cached_attention, causal_attention
 from ..ops.embed import embed_lookup
 
 
@@ -117,7 +117,16 @@ class Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x, attention_mask, segment_ids, deterministic):
+    def __call__(self, x, attention_mask, segment_ids, deterministic,
+                 kv_ctx=None, kv_lens=None, sow_kv=False):
+        """``kv_ctx``/``kv_lens``/``sow_kv`` are the serving plane's
+        KV-cache hooks (engine/serve.py). ``sow_kv=True`` sows this
+        block's (k, v) into the ``intermediates`` collection so a prefill
+        pass can populate a cache; ``kv_ctx=(k_ctx, v_ctx)`` switches
+        attention to decode mode — the current tokens attend over the
+        padded cached context (valid through ``kv_lens``) plus
+        themselves. Both default off, leaving the training forward
+        byte-identical to before."""
         cfg = self.cfg
         B, T, E = x.shape
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.compute_dtype(),
@@ -132,8 +141,18 @@ class Block(nn.Module):
         q = q.reshape(B, T, cfg.n_head, cfg.head_dim)
         k = k.reshape(B, T, cfg.n_head, cfg.head_dim)
         v = v.reshape(B, T, cfg.n_head, cfg.head_dim)
-        attn = causal_attention(q, k, v, attention_mask=attention_mask,
-                                segment_ids=segment_ids, impl=cfg.attention_impl)
+        if sow_kv:
+            self.sow("intermediates", "kv_cache", (k, v))
+        if kv_ctx is not None:
+            k_ctx, v_ctx = kv_ctx
+            attn = cached_attention(q,
+                                    jnp.concatenate([k_ctx, k], axis=1),
+                                    jnp.concatenate([v_ctx, v], axis=1),
+                                    kv_lens)
+        else:
+            attn = causal_attention(q, k, v, attention_mask=attention_mask,
+                                    segment_ids=segment_ids,
+                                    impl=cfg.attention_impl)
         attn = attn.reshape(B, T, E)
         attn = _dense(E, "c_proj", ("qkv", "embed"), cfg)(attn)
         if cfg.dropout > 0:
@@ -174,13 +193,27 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, input_ids, *, attention_mask=None, segment_ids=None,
                  position_ids=None, deterministic: bool = True,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 kv_ctx=None, kv_lens=None, sow_kv: bool = False):
         """``return_hidden=True`` skips the LM head and returns the final
         normed hidden states [B, T, E] — the fused cross-entropy path
         (ops.losses.fused_linear_cross_entropy) computes the head matmul
-        tile-by-tile inside the loss instead of materializing logits."""
+        tile-by-tile inside the loss instead of materializing logits.
+
+        KV-cache generation hooks (engine/serve.py): ``sow_kv=True`` sows
+        each block's (k, v) into ``intermediates`` (apply with
+        ``mutable=["intermediates"]`` to read them back — the prefill
+        path); ``kv_ctx`` is a per-layer tuple of (k_ctx, v_ctx) padded
+        context arrays with real lengths ``kv_lens`` [B] — the
+        decode-step path. Both require the unrolled block layout
+        (``scan_blocks=False``); the serving engine always runs one."""
         cfg = self.cfg
         B, T = input_ids.shape
+        if (kv_ctx is not None or sow_kv) and cfg.scan_blocks:
+            raise ValueError(
+                "KV-cache generation needs the unrolled block layout; "
+                "rebuild the serving model with scan_blocks=False "
+                "(wire artifacts are unrolled already)")
 
         wte = self.param(
             "wte",
@@ -232,6 +265,16 @@ class GPT2(nn.Module):
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
             x, _ = scan(cfg, name="h")(x, attention_mask, segment_ids,
                                        deterministic)
+        elif kv_ctx is not None or sow_kv:
+            # serving forward: remat is for backward-pass memory and a
+            # generation step never differentiates, so the cache paths
+            # skip it (sowing through jax.checkpoint is also undefined);
+            # param names are identical with or without the wrapper
+            for i in range(cfg.n_layer):
+                x = Block(cfg, name=f"h_{i}")(
+                    x, attention_mask, segment_ids, deterministic,
+                    kv_ctx[i] if kv_ctx is not None else None,
+                    kv_lens, sow_kv)
         else:
             block = Block
             if cfg.remat:

@@ -19,7 +19,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import causal_attention
+from ..ops.attention import cached_attention, causal_attention
 from ..ops.embed import embed_lookup
 from .gpt2 import pad_vocab
 
@@ -119,7 +119,13 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, attention_mask, segment_ids, position_ids):
+    def __call__(self, x, attention_mask, segment_ids, position_ids,
+                 kv_ctx=None, kv_lens=None, sow_kv=False):
+        """KV-cache hooks mirror gpt2.Block: ``sow_kv`` sows post-RoPE,
+        PRE-GQA-broadcast (k, v) — the cache stores Hkv heads and the
+        decode path broadcasts to query heads at attention time, so a
+        GQA cache is n_head/n_kv_head times smaller than the activations
+        it replaces."""
         cfg = self.cfg
         B, T, E = x.shape
         Hq, Hkv, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
@@ -130,12 +136,25 @@ class LlamaBlock(nn.Module):
         v = _dense(Hkv * D, "wv", ("embed", "qkv"), cfg)(h).reshape(B, T, Hkv, D)
         q = rotary_embedding(q, position_ids, cfg.rope_theta)
         k = rotary_embedding(k, position_ids, cfg.rope_theta)
-        if Hkv != Hq:  # GQA: broadcast kv heads to query heads
-            rep = Hq // Hkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn = causal_attention(q, k, v, attention_mask=attention_mask,
-                                segment_ids=segment_ids, impl=cfg.attention_impl)
+        if sow_kv:
+            self.sow("intermediates", "kv_cache", (k, v))
+        if kv_ctx is not None:
+            k_ctx, v_ctx = kv_ctx
+            k_full = jnp.concatenate([k_ctx, k], axis=1)
+            v_full = jnp.concatenate([v_ctx, v], axis=1)
+            if Hkv != Hq:
+                rep = Hq // Hkv
+                k_full = jnp.repeat(k_full, rep, axis=2)
+                v_full = jnp.repeat(v_full, rep, axis=2)
+            attn = cached_attention(q, k_full, v_full, kv_lens)
+        else:
+            if Hkv != Hq:  # GQA: broadcast kv heads to query heads
+                rep = Hq // Hkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            attn = causal_attention(q, k, v, attention_mask=attention_mask,
+                                    segment_ids=segment_ids,
+                                    impl=cfg.attention_impl)
         attn = _dense(E, "wo", ("qkv", "embed"), cfg)(attn.reshape(B, T, Hq * D))
         x = x + attn
 
@@ -170,13 +189,23 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, input_ids, *, attention_mask=None, segment_ids=None,
                  position_ids=None, deterministic: bool = True,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 kv_ctx=None, kv_lens=None, sow_kv: bool = False):
         """``return_hidden=True`` skips the LM head and returns the final
         normed hidden states (fused-CE path, ops.losses) — at Llama vocab
         sizes (32k/128k padded) the [B, T, V] logits this avoids are the
-        single largest activation tensor in the step."""
+        single largest activation tensor in the step.
+
+        ``kv_ctx``/``kv_lens``/``sow_kv`` are the serving plane's KV-cache
+        hooks — see gpt2.GPT2.__call__; the cache stores n_kv_head heads
+        (GQA) and requires the unrolled block layout."""
         cfg = self.cfg
         B, T = input_ids.shape
+        if (kv_ctx is not None or sow_kv) and cfg.scan_blocks:
+            raise ValueError(
+                "KV-cache generation needs the unrolled block layout; "
+                "rebuild the serving model with scan_blocks=False "
+                "(wire artifacts are unrolled already)")
         wte = self.param(
             "wte",
             nn.with_logical_partitioning(nn.initializers.normal(0.02),
@@ -199,6 +228,15 @@ class Llama(nn.Module):
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
             x, _ = scan(cfg, name="layers")(x, attention_mask, segment_ids,
                                             position_ids)
+        elif kv_ctx is not None or sow_kv:
+            # serving forward: no backward pass, so remat (and sowing
+            # through jax.checkpoint, which is undefined) is skipped;
+            # param names are identical with or without the wrapper
+            for i in range(cfg.n_layer):
+                x = LlamaBlock(cfg, name=f"layer_{i}")(
+                    x, attention_mask, segment_ids, position_ids,
+                    kv_ctx[i] if kv_ctx is not None else None,
+                    kv_lens, sow_kv)
         else:
             block = LlamaBlock
             if cfg.remat:

@@ -204,6 +204,32 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out[:, :T].astype(q.dtype)
 
 
+def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     ctx_lens: jax.Array) -> jax.Array:
+    """Decode-step attention for KV-cache generation (engine/serve.py).
+
+    ``q`` is the current step's queries [B, Tq, H, D]; ``k``/``v`` are the
+    PADDED cached context concatenated with the current step's keys/values
+    [B, S + Tq, H, D], where S is the (bucket-padded) context capacity.
+    ``ctx_lens`` [B] gives each row's REAL context length: context
+    positions >= ctx_lens[b] are padding (dead pages of the paged KV
+    pool) and masked out; the trailing Tq positions are the new tokens,
+    causally masked among themselves and always visible to themselves.
+
+    Same fp32-softmax math as ``dot_product_attention`` — padded keys hit
+    the NEG_INF branch, whose exp underflows to exact 0, so garbage in
+    dead cache slots cannot leak into the output.
+    """
+    B, Tq, _, _ = q.shape
+    S = k.shape[1] - Tq
+    ctx_valid = jnp.arange(S)[None, :] < ctx_lens[:, None]          # [B, S]
+    new_mask = jnp.tril(jnp.ones((Tq, Tq), bool))                   # [Tq, Tq]
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(ctx_valid[:, None, :], (B, Tq, S)),
+         jnp.broadcast_to(new_mask[None], (B, Tq, Tq))], axis=-1)
+    return dot_product_attention(q, k, v, mask[:, None, :, :])
+
+
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      *,
                      attention_mask: Optional[jax.Array] = None,

@@ -218,6 +218,39 @@ def build_health_plane(cfg: RunConfig, c: Components, *,
     return plane
 
 
+def enable_compile_cache(path: str) -> None:
+    """Point JAX's persistent compilation cache at ``path`` (ROADMAP
+    item 5, first half): every role applies this at build, so a role
+    RESTART — and a supervised respawn, and the averager failover
+    standby — deserializes the previous process's XLA executables
+    instead of recompiling the bucket ladders from scratch. The
+    ``compile.ms`` histogram then measures cache-load time (tens of ms)
+    instead of compile time (seconds); tests/test_serve.py pins the
+    restart behavior. The threshold knobs are best-effort: names drift
+    across JAX versions, and a missing knob only means the default
+    threshold applies."""
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                      ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, val)
+        except (AttributeError, ValueError):  # pragma: no cover — jax drift
+            logger.debug("compile cache knob %s unavailable", knob)
+    # the cache module memoizes "disabled" the first time ANY compile
+    # runs without a dir configured (platform probes compile tiny
+    # programs well before build()); reset so the new dir takes effect
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover — private-API drift
+        logger.debug("compilation_cache.reset_cache unavailable",
+                     exc_info=True)
+    logger.info("persistent compilation cache at %s", path)
+
+
 def build(cfg: RunConfig) -> Components:
     import jax
 
@@ -229,6 +262,10 @@ def build(cfg: RunConfig) -> Components:
     multihost.initialize(coordinator_address=cfg.multihost_coordinator,
                          num_processes=cfg.multihost_processes,
                          process_id=cfg.multihost_id)
+
+    if cfg.compile_cache_dir:
+        # before ANY jit dispatch so the whole build benefits
+        enable_compile_cache(cfg.compile_cache_dir)
 
     import dataclasses as _dc
 

@@ -1,0 +1,158 @@
+"""Server entry point: serve generation over the live base model.
+
+The fourth role of the fleet (ROADMAP item 3): a continuous-batching
+generation engine (engine/serve.py) that subscribes to the averager's
+base-model revisions through the transport and hot-swaps weights between
+decode steps — the federated loop's output, deployed continuously. Run
+offline against a local round:
+
+    python neurons/server.py --backend local --work-dir /tmp/run \
+        --model tiny --dataset synthetic --serve-port 8900
+
+POST token ids at it:
+
+    curl -d '{"tokens": [1, 2, 3], "max_new_tokens": 16}' \
+        http://127.0.0.1:8900/generate
+
+Heartbeats carry the served base revision and tokens/sec, so
+scripts/fleet_report.py shows train -> merge -> serve lag end to end;
+``--obs-port`` exports the ``serve.*`` registry as ``dt_serve_*``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# platform override BEFORE any backend touch (see utils/platform.py)
+from distributedtraining_tpu.utils.platform import (  # noqa: E402
+    force_platform_from_env)
+
+force_platform_from_env()
+
+from distributedtraining_tpu.config import RunConfig           # noqa: E402
+from distributedtraining_tpu.engine.serve import (             # noqa: E402
+    BaseRevisionWatcher, GenerationEngine, ServeHTTPFrontend, ServeLoop,
+    host_param_template)
+from neurons.common import build, build_health_plane           # noqa: E402
+
+logger = logging.getLogger(__name__)
+
+
+def _await_base(cfg: RunConfig, c, watcher: BaseRevisionWatcher):
+    """Boot weights: the published base when one exists (polling until
+    it does), else ``--init-from`` pretrained weights (serving can come
+    up before the averager's first publish)."""
+    deadline = (time.monotonic() + cfg.rounds * cfg.swap_poll
+                if cfg.rounds else None)
+    while True:
+        if watcher.poll_once():
+            staged = watcher.take_pending()
+            if staged is not None:
+                return staged[1], staged[0]
+        params = c.initial_params()
+        if params is not None:
+            logger.info("no published base yet; serving --init-from "
+                        "weights until one lands")
+            return params, None
+        if deadline is not None and time.monotonic() > deadline:
+            raise SystemExit(
+                "no base model appeared within the bounded wait "
+                "(--rounds x --swap-poll); is the averager running?")
+        logger.info("waiting for a published base model "
+                    "(poll every %.1fs)...", cfg.swap_poll)
+        time.sleep(cfg.swap_poll)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    cfg = RunConfig.from_args("server", argv)
+    c = build(cfg)
+
+    watcher = BaseRevisionWatcher(
+        c.transport, lambda: host_param_template(c.model),
+        poll_s=max(cfg.swap_poll, 0.1))
+    params, revision = _await_base(cfg, c, watcher)
+    engine = GenerationEngine(
+        c.model, params, revision=revision,
+        max_slots=cfg.serve_slots, page_size=cfg.serve_page_size,
+        pool_pages=cfg.serve_kv_pages, max_seq_len=cfg.serve_max_seq,
+        max_new_tokens=cfg.serve_max_new,
+        eos_id=getattr(c.tokenizer, "eos_id", None),
+        swap_policy=cfg.swap_policy, watcher=watcher)
+    watcher.start()
+
+    # health plane: the server heartbeats its SERVED revision (the
+    # "base_revision" field every fleet consumer already reads) plus
+    # tokens/sec and queue depth as numeric extras — fleet_report's
+    # served_rev/tok_s columns come from here
+    from distributedtraining_tpu.engine.health import Vitals
+    vitals = Vitals(
+        steps=lambda: engine.steps,
+        counters=lambda: {"tokens_per_sec": engine.tokens_per_sec,
+                          "queue_depth": float(engine.queue_depth),
+                          "tokens": float(engine.tokens_emitted)},
+        base_revision=lambda: engine.revision)
+    plane = build_health_plane(cfg, c, vitals=vitals)
+
+    frontend = None
+    if cfg.serve_port:
+        frontend = ServeHTTPFrontend(engine, cfg.serve_port,
+                                     tokenizer=c.tokenizer)
+        frontend.start()
+    loop = ServeLoop(engine).start()
+    from distributedtraining_tpu.utils import obs
+    try:
+        idle_since = None
+        last_flush = time.monotonic()
+        while True:
+            time.sleep(0.25)
+            if c.metrics is not None and \
+                    time.monotonic() - last_flush >= 15.0:
+                # registry snapshots (serve.* timings) at a steady
+                # cadence, so fleet_report's registry[server] line and
+                # offline joins see the serving numbers
+                obs.flush(step=engine.steps)
+                last_flush = time.monotonic()
+            if cfg.max_steps is None:
+                continue   # unbounded: serve until interrupted
+            if engine.steps >= cfg.max_steps:
+                logger.info("reached --max-steps %d decode steps",
+                            cfg.max_steps)
+                break
+            # bounded runs (tests, smoke) must terminate without traffic
+            # too: a drained queue that stays idle ends the run
+            if engine.idle:
+                idle_since = idle_since or time.monotonic()
+                if time.monotonic() - idle_since > 2 * max(cfg.swap_poll,
+                                                           1.0):
+                    logger.info("bounded run idle; exiting at %d steps",
+                                engine.steps)
+                    break
+            else:
+                idle_since = None
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if frontend is not None:
+            frontend.close()
+        loop.close()
+        plane.close()
+        engine.close()
+        if c.metrics is not None:
+            obs.flush(step=engine.steps)
+        obs.reset()
+    logger.info("server done: steps=%d tokens=%d revision=%s",
+                engine.steps, engine.tokens_emitted, engine.revision)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,8 +38,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
-           "loss_ema", "published", "accepted", "declined", "stale_rounds",
-           "wire_b", "score", "quar", "slo")
+           "loss_ema", "rev", "tok_s", "published", "accepted", "declined",
+           "stale_rounds", "wire_b", "score", "quar", "slo")
 
 
 def build_report(paths: list[str]) -> dict:
@@ -127,6 +127,17 @@ def _cell(node: dict, col: str) -> str:
     if col == "age_s":
         v = node.get("last_seen_age_s")
         return "-" if v is None else f"{v:.1f}"
+    if col == "rev":
+        # the base revision the node is tracking — miners' train base,
+        # the averager's published base, the SERVER's served revision
+        # (engine/serve.py heartbeats): one column reads the
+        # train -> merge -> serve lag across the fleet
+        v = node.get("base_revision")
+        return "-" if not isinstance(v, str) or not v else v[:10]
+    if col == "tok_s":
+        # serving throughput (server-role heartbeats only)
+        v = node.get("tokens_per_sec")
+        return "-" if v is None else f"{v:.1f}"
     if col == "wire_b":
         # transport bytes the monitor role fetched staging this miner
         # (engine/health.py ledger) — human-scaled: the whole point of
@@ -184,7 +195,10 @@ def format_table(rep: dict) -> str:
     interesting = ("miner.step_ms.p50", "compile.ms.count", "compile.ms.p95",
                    "ingest.cache_hits", "ingest.cache_misses",
                    "health.beats", "fleet.heartbeats",
-                   "device.mem_peak_bytes")
+                   "device.mem_peak_bytes",
+                   "serve.tokens", "serve.tokens_per_sec",
+                   "serve.token_ms.p95", "serve.swap_stall_ms.p95",
+                   "serve.swaps")
     for role, snap in sorted(reg.items()):
         picks = {k: snap[k] for k in interesting if k in snap}
         if picks:

@@ -179,6 +179,44 @@ def _no_leaked_subaverager_threads():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _no_leaked_serving_plane():
+    """Serving-plane hygiene (engine/serve.py): a GenerationEngine may
+    own a base-revision watcher thread (``serve-watch``), a ServeLoop
+    scheduler thread (``serve-loop``), and a ServeHTTPFrontend listening
+    socket (``serve-http-*`` thread) — same long-lived background
+    machinery as the heartbeat/exporter pair, same rule: the owning test
+    must close() them. A leaked watcher keeps fetching bases from
+    whatever transport the next module builds; a leaked frontend holds
+    the port AND a reference to a dead engine. Force-clean the sockets
+    so one offender cannot cascade, then fail the module."""
+    import threading
+    import time as _time
+
+    yield
+    from distributedtraining_tpu.engine import serve as serve_mod
+
+    live = serve_mod.live_frontends()
+    for fe in live:
+        fe.close()
+    deadline = _time.monotonic() + 6.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t.is_alive() and (t.name.startswith("serve-watch")
+                                       or t.name.startswith("serve-loop"))]
+        if not leaked:
+            break
+        if _time.monotonic() > deadline:
+            raise AssertionError(
+                f"test module left serving threads alive: {leaked}; "
+                "close() the GenerationEngine/ServeLoop (the engine "
+                "closes its watcher) in teardown")
+        _time.sleep(0.05)
+    assert not live, (
+        f"test module left generation frontends serving: {live}; call "
+        "ServeHTTPFrontend.close() in teardown")
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _no_leaked_obs_state():
     """Observability hygiene (mirrors the thread-leak guard above): the
     span/metric layer (utils/obs.py) is PROCESS-WIDE state — a test that

@@ -1,0 +1,550 @@
+"""Serving plane (engine/serve.py): continuous-batching generation with a
+paged KV cache and hot-swapped base weights.
+
+The correctness spine is the greedy-parity pin: every engine output must
+be token-identical to ``reference_generate`` — a full model forward of
+the growing sequence per token, no cache, no padding — for the pinned
+prompts, before and across a hot-swap boundary. Everything else (paging,
+bucket padding, preemption, swap policies, chaos degradation) is then
+tested as "still token-identical under X".
+"""
+
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.engine.serve import (BaseRevisionWatcher,
+                                                  BucketLadder,
+                                                  GenerationEngine,
+                                                  ServeHTTPFrontend,
+                                                  ServeLoop,
+                                                  host_param_template,
+                                                  reference_generate)
+from distributedtraining_tpu.models import gpt2, llama
+from distributedtraining_tpu.transport import InMemoryTransport
+from distributedtraining_tpu.utils import obs
+
+# f32 keeps the argmax parity pin numerically honest (bf16 near-ties can
+# flip between the cached and full-recompute spellings); serving real
+# bf16 models is a throughput choice, not a correctness contract
+TINY = gpt2.GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                       n_layer=2, n_head=2, dtype="float32",
+                       vocab_multiple=64)
+
+GEN = 8  # tokens generated per request in most tests
+
+# the eager reference loop is the slow half of every parity pin; the
+# pinned (params, prompt, n) oracles are deterministic, so share them
+# across tests instead of re-deriving per test
+_REF_CACHE: dict = {}
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model, cfg = gpt2.make_model(TINY)
+    params1 = model.init_params(jax.random.PRNGKey(0), seq_len=8)
+    params2 = model.init_params(jax.random.PRNGKey(7), seq_len=8)
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size, size=n)]
+               for n in (5, 11, 3, 17)]
+    return model, cfg, params1, params2, prompts
+
+
+@pytest.fixture()
+def sink():
+    class _Sink:
+        def __init__(self):
+            self.records = []
+
+        def log(self, rec, **kw):
+            self.records.append(rec)
+
+    s = _Sink()
+    obs.configure(s, role="server")
+    try:
+        yield s
+    finally:
+        obs.reset()
+
+
+def refs_for(model, params, prompts, n=GEN):
+    out = []
+    for p in prompts:
+        key = (id(model), id(params), tuple(p), n)
+        if key not in _REF_CACHE:
+            _REF_CACHE[key] = reference_generate(model, params, p, n)
+        out.append(_REF_CACHE[key])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Greedy parity
+# ---------------------------------------------------------------------------
+
+def test_greedy_parity_continuous_batch(setup):
+    """Mixed-length prompts decoded as one rolling batch are
+    token-identical to the reference loop, per request."""
+    model, cfg, params, _, prompts = setup
+    # fewer slots than requests: the scheduler admits as slots free up
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+    try:
+        assert eng.generate(prompts, GEN) == refs_for(model, params, prompts)
+        assert eng.tokens_emitted == GEN * len(prompts)
+    finally:
+        eng.close()
+
+
+def test_paged_equals_contiguous(setup):
+    """Paged KV (small pages, gathered per step) vs a contiguous cache
+    (one page holds the whole sequence): identical outputs — paging is a
+    memory layout, not a math change."""
+    model, cfg, params, _, prompts = setup
+    paged = GenerationEngine(model, params, max_slots=2, page_size=8)
+    contiguous = GenerationEngine(model, params, max_slots=2, page_size=64)
+    try:
+        assert contiguous.pages_per_slot == 1
+        out_p = paged.generate(prompts, GEN)
+        out_c = contiguous.generate(prompts, GEN)
+        assert out_p == out_c == refs_for(model, params, prompts)
+    finally:
+        paged.close()
+        contiguous.close()
+
+
+def test_llama_gqa_parity():
+    """The Llama path: GQA cache stores n_kv_head heads and broadcasts
+    at decode; rotary positions come from the slot's sequence length."""
+    cfg = llama.LlamaConfig(vocab_size=128, max_seq_len=64, n_embd=32,
+                            n_layer=2, n_head=4, n_kv_head=2,
+                            intermediate_size=64, remat=False,
+                            dtype="float32", vocab_multiple=64)
+    model, cfg = llama.make_model(cfg)
+    params = model.init_params(jax.random.PRNGKey(3), seq_len=8)
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=n)) for n in (4, 9)]
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+    try:
+        assert eng.generate(prompts, 6) == refs_for(model, params, prompts, 6)
+        # the cache really is GQA-narrow
+        assert eng._kv[0].shape[-2] == cfg.n_kv_head
+    finally:
+        eng.close()
+
+
+def test_eos_stops_generation(setup):
+    model, cfg, params, _, prompts = setup
+    ref = reference_generate(model, params, prompts[0], GEN)
+    eos = ref[0]
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8,
+                           eos_id=eos)
+    try:
+        [out] = eng.generate([prompts[0]], GEN)
+        assert out == reference_generate(model, params, prompts[0], GEN,
+                                         eos_id=eos)
+        assert out[-1] == eos and len(out) < GEN
+    finally:
+        eng.close()
+
+
+def test_submit_validation(setup):
+    model, cfg, params, _, _ = setup
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+    try:
+        with pytest.raises(ValueError):
+            eng.submit([])
+        with pytest.raises(ValueError):
+            eng.submit(list(range(60)), max_new_tokens=20)  # > max_seq_len
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Bucket ladder / no-retrace
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_shape():
+    lad = BucketLadder(8, prefer_compiled=False)
+    assert lad.buckets == (1, 2, 4, 8)
+    assert [lad.bucket_for(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+    assert lad.bucket_for(9) == 16  # beyond top: multiples of top
+    lad2 = BucketLadder(8, prefer_compiled=True)
+    lad2.mark(8)
+    assert lad2.bucket_for(3) == 8  # pads up to the compiled bucket
+
+
+def test_steady_state_zero_fresh_compiles(setup, sink):
+    """The acceptance pin: after one warm batch, a second identical load
+    adds ZERO fresh compiles — compile.ms count and the serve bucket
+    counters stay flat (the PR-8 no-retrace discipline on the decode
+    ladder)."""
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=4, page_size=8)
+    try:
+        refs = refs_for(model, params, prompts)
+        assert eng.generate(prompts, GEN) == refs     # warm the ladders
+        reg = obs.registry()
+        before = (reg.histogram("compile.ms").count,
+                  reg.counter("serve.decode_bucket_compiles").value,
+                  reg.counter("serve.prefill_bucket_compiles").value)
+        assert eng.generate(prompts, GEN) == refs     # steady state
+        after = (reg.histogram("compile.ms").count,
+                 reg.counter("serve.decode_bucket_compiles").value,
+                 reg.counter("serve.prefill_bucket_compiles").value)
+        assert after == before, f"steady-state decode compiled: " \
+                                f"{before} -> {after}"
+    finally:
+        eng.close()
+
+
+def test_prefer_compiled_pads_partial_batch(setup):
+    """A partial batch after a full one reuses the compiled full-batch
+    program (padding waste) instead of compiling the exact fit."""
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=4, page_size=8)
+    try:
+        eng.generate(prompts[:4], GEN)
+        keys = set(eng._decode_progs)
+        eng.generate(prompts[:2], GEN)       # 2 active: pads up to 4
+        assert set(eng._decode_progs) == keys
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Hot swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_drain_parity_across_boundary(setup, sink):
+    """Under the drain policy a request admitted before the swap finishes
+    on the revision it started on; one admitted after decodes on the new
+    revision — both token-identical to their revision's reference loop,
+    and each response is stamped with the revision that produced it."""
+    model, cfg, params1, params2, prompts = setup
+    tr = InMemoryTransport()
+    rev1 = tr.publish_base(params1)
+    watcher = BaseRevisionWatcher(tr, lambda: host_param_template(model),
+                                  poll_s=999.0)
+    assert watcher.poll_once()
+    staged = watcher.take_pending()
+    eng = GenerationEngine(model, watcher=watcher, max_slots=2, page_size=8,
+                           swap_policy="drain")
+    eng.install_params(staged[1], revision=staged[0])
+    try:
+        ra = eng.submit(prompts[0], GEN)
+        for _ in range(3):
+            eng.step()
+        rev2 = tr.publish_base(params2)
+        assert watcher.poll_once()           # stages the new revision
+        rb = eng.submit(prompts[1], GEN)
+        while not (ra.done_evt.is_set() and rb.done_evt.is_set()):
+            eng.step()
+        assert [ra.tokens] == refs_for(model, params1, prompts[:1])
+        assert ra.revision == rev1
+        assert [rb.tokens] == refs_for(model, params2, prompts[1:2])
+        assert rb.revision == rev2
+        reg = obs.registry()
+        assert reg.counter("serve.swaps").value == 1
+        # the stall the decode loop actually paused for is a pointer
+        # rebind — well under one decode step
+        stall = reg.histogram("serve.swap_stall_ms").percentiles((95.0,))
+        step = reg.histogram("serve.step_ms").percentiles((95.0,))
+        assert stall["p95"] < step["p95"]
+    finally:
+        eng.close()
+
+
+def test_hot_swap_restart_regenerates_on_new_revision(setup):
+    model, cfg, params1, params2, prompts = setup
+    eng = GenerationEngine(model, params1, revision="r1", max_slots=2,
+                           page_size=8, swap_policy="restart")
+    try:
+        req = eng.submit(prompts[0], GEN)
+        for _ in range(3):
+            eng.step()
+        assert req.tokens  # mid-stream
+        eng._pending_swap = ("r2", jax.device_put(params2))
+        while not req.done_evt.is_set():
+            eng.step()
+        assert [req.tokens] == refs_for(model, params2, prompts[:1])
+        assert req.revision == "r2"
+    finally:
+        eng.close()
+
+
+def test_chaos_fetch_degrades_to_current_base(setup, sink):
+    """A failed/torn revision fetch must degrade to the current base,
+    never stall the batch: with every transport fetch failing, the
+    watcher counts failures and generation proceeds bit-identically on
+    the old revision."""
+    from distributedtraining_tpu.transport.chaos import (ChaosSpec,
+                                                         ChaosTransport)
+    model, cfg, params1, params2, prompts = setup
+    inner = InMemoryTransport()
+    rev1 = inner.publish_base(params1)
+    chaotic = ChaosTransport(inner, ChaosSpec(fetch_error_rate=1.0, seed=3),
+                             role="server")
+    watcher = BaseRevisionWatcher(chaotic,
+                                  lambda: host_param_template(model),
+                                  poll_s=999.0)
+    eng = GenerationEngine(model, params1, revision=rev1, max_slots=2,
+                           page_size=8, watcher=watcher)
+    try:
+        inner.publish_base(params2)          # a new revision exists...
+        assert not watcher.poll_once()       # ...but every fetch fails
+        out = eng.generate(prompts[:2], GEN)
+        assert out == refs_for(model, params1, prompts[:2])
+        assert eng.revision == rev1
+        assert obs.registry().counter(
+            "serve.swap_fetch_failures").value >= 1
+        assert obs.registry().counter("serve.swaps").value == 0
+    finally:
+        eng.close()
+
+
+def test_watcher_thread_lifecycle(setup):
+    model, cfg, params1, _, _ = setup
+    tr = InMemoryTransport()
+    tr.publish_base(params1)
+    watcher = BaseRevisionWatcher(tr, lambda: host_param_template(model),
+                                  poll_s=0.01)
+    watcher.start()
+    try:
+        import time
+        deadline = time.monotonic() + 5.0
+        while watcher.take_pending() is None:
+            assert time.monotonic() < deadline, "watcher never staged"
+            time.sleep(0.01)
+    finally:
+        watcher.close()
+
+
+# ---------------------------------------------------------------------------
+# Paging pressure
+# ---------------------------------------------------------------------------
+
+def test_preemption_under_page_pressure(setup, sink):
+    """An undersized pool forces preemption; preempted requests requeue
+    and regenerate identically (greedy decode is deterministic), and the
+    engine records that it happened."""
+    model, cfg, params, _, _ = setup
+    rng = np.random.RandomState(5)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=10))
+               for _ in range(3)]
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8,
+                           max_seq_len=32, pool_pages=6)
+    try:
+        assert eng.generate(prompts, 16) == refs_for(model, params,
+                                                     prompts, 16)
+        assert obs.registry().counter("serve.preempted").value >= 1
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Metrics / exporter / fleet report
+# ---------------------------------------------------------------------------
+
+def test_serve_metrics_reach_prometheus_exporter(setup, sink):
+    from distributedtraining_tpu.utils import obs_http
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+    try:
+        eng.generate(prompts[:2], GEN)
+        text = obs_http.render()
+        for needle in ("dt_serve_tokens ", "dt_serve_step_ms_p95",
+                       "dt_serve_tokens_per_sec", "dt_serve_queue_depth",
+                       "dt_compile_ms_count"):
+            assert needle in text, f"{needle} missing from exposition"
+    finally:
+        eng.close()
+
+
+def test_server_heartbeat_carries_served_revision(setup):
+    """The server's vitals ride the standard heartbeat schema: the
+    served revision via the protocol's base_revision field, tokens/sec
+    as a numeric extra — parse_heartbeat keeps both for the fleet
+    ledger."""
+    from distributedtraining_tpu.engine.health import (Vitals,
+                                                       build_heartbeat,
+                                                       parse_heartbeat)
+    vit = Vitals(steps=lambda: 42.0,
+                 counters=lambda: {"tokens_per_sec": 123.4,
+                                   "queue_depth": 2.0},
+                 base_revision=lambda: "rev-abc")
+    body = build_heartbeat("server", "hk-s", 1, now=1000.0, **vit.collect())
+    parsed = parse_heartbeat(body)
+    assert parsed is not None
+    assert parsed["base_revision"] == "rev-abc"
+    assert parsed["tokens_per_sec"] == pytest.approx(123.4)
+    assert parsed["role"] == "server"
+
+
+def test_fleet_monitor_polls_server_heartbeats():
+    """Monitor roles poll the server role alongside miners, and the
+    ledger record carries the served revision + tokens/sec extras —
+    the fleet table's rev/tok_s columns work from a monitor's JSONL,
+    not only the server's own."""
+    from distributedtraining_tpu.engine.health import (FleetMonitor,
+                                                       HeartbeatPublisher,
+                                                       Vitals)
+    tr = InMemoryTransport()
+    vit = Vitals(steps=lambda: 42.0,
+                 counters=lambda: {"tokens_per_sec": 77.7,
+                                   "queue_depth": 1.0},
+                 base_revision=lambda: "rev-xyz")
+    hb = HeartbeatPublisher(tr, "server", "hk-s", interval=999.0,
+                            vitals=vit)
+    try:
+        hb.beat_now()
+    finally:
+        hb.close()
+    fm = FleetMonitor(tr)
+    try:
+        assert "server" in fm.roles
+        assert fm.poll(["hk-s"]) == 1
+        rec = fm.ledger()["server/hk-s"]
+        assert rec["base_revision"] == "rev-xyz"
+        assert rec["tokens_per_sec"] == pytest.approx(77.7)
+    finally:
+        fm.close()
+
+
+def test_fleet_report_serve_columns(tmp_path):
+    """One CLI shows train -> merge -> serve lag: the report renders the
+    rev and tok_s columns from server heartbeats."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import fleet_report
+    path = tmp_path / "monitor.jsonl"
+    recs = [
+        {"heartbeat": {"hb": 1, "role": "server", "hotkey": "hk-s",
+                       "seq": 3, "t": 9.0, "base_revision": "deadbeef01",
+                       "tokens_per_sec": 88.5, "steps": 100.0}},
+        {"heartbeat": {"hb": 1, "role": "miner", "hotkey": "hk-m",
+                       "seq": 5, "t": 9.0, "base_revision": "deadbeef01",
+                       "steps": 10.0}},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    rep = fleet_report.build_report([str(path)])
+    table = fleet_report.format_table(rep)
+    assert "rev" in fleet_report.COLUMNS
+    assert "tok_s" in fleet_report.COLUMNS
+    assert "deadbeef01"[:10] in table
+    assert "88.5" in table
+    server = rep["nodes"]["server/hk-s"]
+    assert server["tokens_per_sec"] == pytest.approx(88.5)
+
+
+# ---------------------------------------------------------------------------
+# HTTP frontend + serve loop
+# ---------------------------------------------------------------------------
+
+def test_http_frontend_round_trip(setup):
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, revision="r1", max_slots=2,
+                           page_size=8)
+    loop = ServeLoop(eng, idle_poll_s=0.02).start()
+    fe = ServeHTTPFrontend(eng, 0, timeout_s=60.0)
+    port = fe.start()
+    try:
+        body = json.dumps({"tokens": prompts[0],
+                           "max_new_tokens": 8}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert out["tokens"] == reference_generate(model, params,
+                                                   prompts[0], 8)
+        assert out["status"] == "done"
+        assert out["revision"] == "r1"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+            hz = json.loads(resp.read())
+        assert hz["ok"] and hz["revision"] == "r1"
+        # malformed request: 400, not a wedged handler
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=b'{"tokens": []}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        fe.close()
+        loop.close()
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Persistent compilation cache (ROADMAP item 5, first half)
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_restart(tmp_path, setup, sink):
+    """--compile-cache-dir: a restarted serving process re-traces but
+    deserializes yesterday's executables — the cache directory gains NO
+    new entries for the identical bucket programs, and decode output
+    stays pinned. (In-memory jit caches are cleared to simulate the
+    restart; compile.ms still counts the re-dispatches, now measuring
+    cache-load cost.)"""
+    from neurons.common import enable_compile_cache
+    model, cfg, params, _, prompts = setup
+    cache_dir = str(tmp_path / "xla-cache")
+    refs = refs_for(model, params, prompts[:2], 6)
+    try:
+        def bucket_entries():
+            # the serving programs proper (incidental one-op jit_<prim>
+            # helpers may come and go; they cost microseconds)
+            return {f for f in os.listdir(cache_dir)
+                    if f.endswith("-cache")
+                    and ("jit_prefill" in f or "jit_step" in f)}
+
+        enable_compile_cache(cache_dir)
+        eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+        assert eng.generate(prompts[:2], 6) == refs
+        eng.close()
+        entries = bucket_entries()
+        assert entries, "persistent cache stayed empty"
+        jax.clear_caches()                    # the "restart"
+        reg = obs.registry()
+        compiles_before = reg.histogram("compile.ms").count
+        eng2 = GenerationEngine(model, params, max_slots=2, page_size=8)
+        assert eng2.generate(prompts[:2], 6) == refs
+        eng2.close()
+        # the restarted process re-dispatched (compile.ms moved)...
+        assert reg.histogram("compile.ms").count > compiles_before
+        # ...but every bucket program came FROM the cache: no new
+        # prefill/decode entries
+        assert bucket_entries() == entries, (
+            f"restart recompiled fresh bucket programs: "
+            f"{sorted(bucket_entries() - entries)}")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+
+
+def test_run_config_serving_flags():
+    from distributedtraining_tpu.config import RunConfig
+    cfg = RunConfig.from_args("server", [
+        "--serve-port", "8123", "--serve-slots", "4", "--page-size", "8",
+        "--kv-pages", "64", "--max-new-tokens", "32", "--swap-policy",
+        "restart", "--swap-poll", "2.5", "--compile-cache-dir", "/tmp/cc",
+        "--model", "tiny", "--backend", "memory"])
+    assert cfg.role == "server"
+    assert cfg.serve_port == 8123
+    assert cfg.serve_slots == 4
+    assert cfg.serve_page_size == 8
+    assert cfg.serve_kv_pages == 64
+    assert cfg.serve_max_new == 32
+    assert cfg.swap_policy == "restart"
+    assert cfg.swap_poll == 2.5
+    assert cfg.compile_cache_dir == "/tmp/cc"
+    # every role grows the cache flag (restarts of ALL roles skip
+    # recompiles)
+    for role in ("miner", "validator", "averager"):
+        c = RunConfig.from_args(role, ["--compile-cache-dir", "/tmp/cc"])
+        assert c.compile_cache_dir == "/tmp/cc"
