@@ -1145,6 +1145,92 @@ def _time_remediation_overhead(*, miners: int = 8, rounds: int = 4,
     }
 
 
+def _time_flight_overhead(*, steps: int = 100, trials: int = 2,
+                          log_every: int = 5,
+                          send_interval: float = 0.05) -> dict:
+    """Flight-recorder A/B (round-15 tentpole): the production MinerLoop
+    with the obs layer fully ON both sides (configured JSONLSink, span
+    emission, per-step histogram, registry flush at the log cadence,
+    pushes at a 50 ms cadence — ~16000x the production default, so the
+    measured fraction is a hard upper bound), and the contrast being
+    exactly the flight recorder (utils/flight.py): ring recording of
+    every span close + publish outcome + registry-digest snapshot
+    through the obs hooks. Interleaved off/on pairs; acceptance floor:
+    flight_overhead_frac < 0.02."""
+    import os as _os
+    import tempfile
+
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.engine.train import MinerLoop
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+    from distributedtraining_tpu.utils import flight, obs
+    from distributedtraining_tpu.utils.metrics import JSONLSink
+
+    model, cfg = gpt2.make_model("tiny")
+    seq = 64
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (BATCH, seq)), np.int32)}
+    events_recorded = 0
+    bundle_events = 0
+
+    def run_once(instrumented: bool) -> float:
+        nonlocal events_recorded, bundle_events
+        fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+        _os.close(fd)
+        sink = JSONLSink(tmp)
+        try:
+            obs.configure(sink, role="bench")
+            transport = InMemoryTransport()
+            rec = None
+            if instrumented:
+                rec = flight.configure("miner", "bench-flight",
+                                       transport=transport, capacity=512)
+            loop = MinerLoop(
+                TrainEngine(model, seq_len=seq), transport,
+                "bench-flight", send_interval=send_interval,
+                check_update_interval=1e9, log_every=log_every,
+                metrics=sink)
+            loop.bootstrap(jax.random.PRNGKey(0))
+
+            def batches():
+                while True:
+                    yield batch
+
+            loop.run(batches(), max_steps=2)   # warm compiles off-timing
+            t0 = time.perf_counter()
+            loop.run(batches(), max_steps=steps)
+            dt = time.perf_counter() - t0
+            loop.flush()
+            if rec is not None:
+                assert rec.recorded > 0, "flight ring never recorded"
+                events_recorded += rec.recorded
+                bundle = rec.freeze("bench")   # the freeze path works
+                bundle_events += len(bundle["events"])
+            return dt
+        finally:
+            flight.reset()
+            obs.reset()
+            sink.close()
+            _os.unlink(tmp)
+
+    offs, ons = [], []
+    for _ in range(trials):
+        offs.append(run_once(False))
+        ons.append(run_once(True))
+    off, on = float(np.mean(offs)), float(np.mean(ons))
+    return {
+        "flight_steps": steps,
+        "flight_send_interval_s": send_interval,
+        "flight_events_recorded": events_recorded,
+        "flight_bundle_events": bundle_events,
+        "flight_off_s": round(off, 4),
+        "flight_on_s": round(on, 4),
+        "flight_overhead_frac": round(max(0.0, on / off - 1.0), 4),
+    }
+
+
 def _param_count(model) -> int:
     abstract = jax.eval_shape(
         lambda: model.init_params(jax.random.PRNGKey(0)))
@@ -1470,6 +1556,14 @@ def main() -> None:
         extras.update(_time_remediation_overhead())
     except Exception as e:
         extras["remediation_overhead_error"] = repr(e)
+
+    try:
+        # flight-recorder cost: production miner loop with the obs layer
+        # on both sides, contrast = the postmortem event ring
+        # (round-15 tentpole; acceptance < 2%)
+        extras.update(_time_flight_overhead())
+    except Exception as e:
+        extras["flight_overhead_error"] = repr(e)
 
     if not degraded:
         try:

@@ -235,8 +235,16 @@ class RunConfig:
     heartbeat_interval: float = 0.0
     # zero-dependency Prometheus-text exporter (utils/obs_http.py):
     # serve the obs registry (+ fleet ledger, where one exists) on
-    # http://127.0.0.1:<port>/metrics. 0 disables.
+    # http://127.0.0.1:<port>/metrics — plus the postmortem debug
+    # endpoints (/debug/dump, /debug/profile, /debug/stacks). 0 disables.
     obs_port: int = 0
+    # flight recorder (utils/flight.py): bounded in-memory ring of
+    # structured events (spans, SLO fires, lease flips, publish/swap
+    # outcomes, heartbeats, sanitized config) frozen into a
+    # content-addressed __pm__ postmortem bundle on SLO breach /
+    # remediation action / crash, published through this role's
+    # transport. Value = ring capacity in events; 0 disables the plane.
+    flight_events: int = 512
     mlflow_uri: Optional[str] = None
     profile_dir: Optional[str] = None        # jax.profiler trace capture
     profile_steps: int = 5                   # train steps per capture
@@ -784,8 +792,16 @@ def build_parser(role: str) -> argparse.ArgumentParser:
     g.add_argument("--obs-port", dest="obs_port", type=int,
                    default=d.obs_port,
                    help="serve Prometheus-text metrics (obs registry + "
-                        "fleet ledger) on 127.0.0.1:<port>/metrics; "
-                        "0 disables")
+                        "fleet ledger) on 127.0.0.1:<port>/metrics, plus "
+                        "the /debug/dump, /debug/profile?ms=N and "
+                        "/debug/stacks postmortem endpoints; 0 disables")
+    g.add_argument("--flight-events", dest="flight_events", type=int,
+                   default=d.flight_events,
+                   help="flight-recorder ring capacity (utils/flight.py): "
+                        "recent spans/SLO fires/lease flips/publish "
+                        "outcomes kept in memory and frozen into a "
+                        "transport-published __pm__ postmortem bundle on "
+                        "SLO breach, remediation, or crash; 0 disables")
     if role == "miner":
         g.add_argument("--log-every", dest="log_every", type=int,
                        default=d.log_every,

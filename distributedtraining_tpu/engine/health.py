@@ -59,7 +59,7 @@ from collections import deque
 from typing import Any, Callable, Iterable, Optional, Sequence
 
 from ..transport.base import META_MAX_BYTES, heartbeat_id
-from ..utils import obs
+from ..utils import flight, obs
 from ..utils.metrics import device_memory_watermarks
 
 logger = logging.getLogger(__name__)
@@ -344,6 +344,10 @@ class HeartbeatPublisher:
                 pm(self.node_id, body)
             self.sent += 1
             obs.count("health.beats")
+            # flight ring: the LAST beats this node managed to send are
+            # exactly what a postmortem of its death wants to show
+            flight.record("heartbeat", role=self.role, hotkey=self.hotkey,
+                          seq=body.get("seq", 0), sent=True)
         except Exception:
             self.failed += 1
             obs.count("health.beat_failures")
@@ -421,6 +425,10 @@ class NodeHealth:
     # -- remediation state (engine/remediate.py owns the transitions) --------
     quarantined: bool = False           # dropped from the ingest hotkey set
     probation: bool = False             # re-admitted, still under watch
+    # content-address of the postmortem bundle frozen when this node's
+    # latest breach/remediation fired (utils/flight.py) — the forensic
+    # pointer the remediation layer attaches to its decisions
+    pm_ref: str | None = None
 
     def as_record(self, now: float | None = None) -> dict:
         rec = {
@@ -443,6 +451,8 @@ class NodeHealth:
         }
         if self.mem_peak_bytes:
             rec["mem_peak_bytes"] = self.mem_peak_bytes
+        if self.pm_ref:
+            rec["pm_ref"] = self.pm_ref
         if now is not None and self.last_seen_wall is not None:
             rec["last_seen_age_s"] = round(now - self.last_seen_wall, 3)
         # producer extras (already name-linted + type-screened by
@@ -702,6 +712,8 @@ class FleetMonitor:
             if node.pushes_failed > prev_failed:
                 node.push_fail_streak += node.pushes_failed - prev_failed
         obs.count("fleet.heartbeats")
+        flight.record("heartbeat", role=key[0], hotkey=key[1],
+                      seq=hb["seq"], observed=True)
         if self.metrics is not None:
             try:
                 self.metrics.log({"heartbeat": dict(hb),
@@ -804,10 +816,22 @@ class FleetMonitor:
                 rec = {"slo_breach": rule.name, "role": node.role,
                        "hotkey": node.hotkey, "detail": detail,
                        "round": self.round}
-                breaches.append(rec)
                 obs.count(f"fleet.slo.{rule.name}")
                 logger.warning("SLO breach: %s on %s/%s — %s", rule.name,
                                node.role, node.hotkey, detail)
+                # postmortem trigger: record the breach into the flight
+                # ring FIRST (so the frozen bundle names it), then freeze
+                # + publish — the bundle_id is the reference every
+                # downstream consumer (ledger, remediation, reports)
+                # attaches to this breach
+                flight.record("slo", rule=rule.name, role=node.role,
+                              hotkey=node.hotkey, detail=detail,
+                              round=self.round)
+                ref = flight.freeze_and_publish(f"slo_{rule.name}")
+                if ref:
+                    rec["pm_ref"] = ref
+                    node.pm_ref = ref
+                breaches.append(rec)
                 if self.metrics is not None:
                     try:
                         self.metrics.log(rec)

@@ -43,7 +43,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Optional
 
-from ..utils import obs
+from ..utils import flight, obs
 
 logger = logging.getLogger(__name__)
 
@@ -313,6 +313,12 @@ class DeltaPublisher:
             except Exception:
                 self.report.pushes_failed += 1
                 obs.count("publish.failed")
+                # flight ring: the failed push — with its correlation id
+                # — is the first thing a postmortem of this miner's death
+                # should name (utils/flight.py)
+                flight.record("publish", outcome="failed",
+                              hotkey=self.miner_id, cid=cid or "",
+                              wire="v2" if wire_v2 else "v1")
                 logger.exception("miner %s: delta push failed", self.miner_id)
                 return False
             self._publish_meta(base_revision, cid,
@@ -320,6 +326,8 @@ class DeltaPublisher:
                                extra=extra_meta)
             self.report.pushes += 1
             obs.count("publish.pushes")
+            flight.record("publish", outcome="ok", hotkey=self.miner_id,
+                          cid=cid or "", wire="v2" if wire_v2 else "v1")
             logger.info("miner %s: pushed delta #%d", self.miner_id,
                         self.report.pushes)
             return True
@@ -355,23 +363,40 @@ class DeltaPublisher:
         obs.observe("wire.encode_ms", (time.perf_counter() - t0) * 1e3)
         changed = [key for key, (digest, _) in layers.items()
                    if self._last_shards.get(key) != digest]
-        for key in changed:
-            data = shards[key]
+        shards_done = 0
+        try:
+            for key in changed:
+                data = shards[key]
+                call_with_retry(
+                    lambda key=key, data=data: tbase.publish_shard(
+                        self.transport, self.miner_id, key, data),
+                    policy=self.publish_retry,
+                    describe=f"miner {self.miner_id} shard {key}", **kw)
+                obs.count("wire.bytes_published", len(data))
+                shards_done += 1
+            obs.count("wire.shards_uploaded", len(changed))
+            obs.count("wire.shards_skipped", len(shards) - len(changed))
+            pdr = getattr(self.transport, "publish_delta_raw", None)
+            publish_manifest = (pdr if pdr is not None
+                                else self.transport.publish_raw)
             call_with_retry(
-                lambda key=key, data=data: tbase.publish_shard(
-                    self.transport, self.miner_id, key, data),
+                lambda: publish_manifest(self.miner_id, manifest),
                 policy=self.publish_retry,
-                describe=f"miner {self.miner_id} shard {key}", **kw)
-            obs.count("wire.bytes_published", len(data))
-        obs.count("wire.shards_uploaded", len(changed))
-        obs.count("wire.shards_skipped", len(shards) - len(changed))
-        pdr = getattr(self.transport, "publish_delta_raw", None)
-        publish_manifest = (pdr if pdr is not None
-                            else self.transport.publish_raw)
-        call_with_retry(
-            lambda: publish_manifest(self.miner_id, manifest),
-            policy=self.publish_retry,
-            describe=f"miner {self.miner_id} wire manifest publish", **kw)
+                describe=f"miner {self.miner_id} wire manifest publish",
+                **kw)
+        except Exception:
+            # torn shard set: some shards landed, the manifest (or a
+            # later shard) did not. Readers are safe (manifest-last), but
+            # the flight ring must NAME the tear — which push, how far it
+            # got — because this is precisely the state a mid-publish
+            # kill leaves behind and the postmortem timeline
+            # (scripts/postmortem.py) reconstructs.
+            flight.record("publish", outcome="torn",
+                          hotkey=self.miner_id,
+                          cid=obs.current_cid() or "",
+                          shards_done=shards_done,
+                          shards_total=len(changed), manifest=False)
+            raise
         obs.count("wire.bytes_published", len(manifest))
         obs.count("wire.manifest_publishes")
         self._last_shards = {key: digest

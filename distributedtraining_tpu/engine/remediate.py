@@ -64,7 +64,7 @@ import logging
 from typing import Any, Iterable, Sequence
 
 from ..transport.base import heartbeat_id, lease_id
-from ..utils import obs
+from ..utils import flight, obs
 from .batched_eval import BUCKETS
 from .health import FleetMonitor, parse_heartbeat
 
@@ -196,10 +196,27 @@ class RemediationEngine:
         return elastic_cohort(configured, healthy, compiled=compiled)
 
     # -- transitions ---------------------------------------------------------
-    def _emit(self, action: str, case: _Case, detail: str = "") -> dict:
+    def _emit(self, action: str, case: _Case, detail: str = "",
+              pm_ref: str | None = None) -> dict:
+        # postmortem attachment (utils/flight.py): every quarantine and
+        # probation flip carries a bundle reference — the TRIGGERING
+        # breach's bundle when the monitor froze one, else a fresh
+        # freeze of this role's ring at the moment of the action — and
+        # the reference lands on the node's ledger entry, so
+        # fleet_report/postmortem joins go straight from decision to
+        # evidence.
+        flight.record("remediation", action=action, hotkey=case.hotkey,
+                      rule=case.rule, round=self.fleet.round)
+        if pm_ref is None:
+            pm_ref = flight.freeze_and_publish(f"remediation_{action}")
         rec = {"remediation": action, "hotkey": case.hotkey,
                "rule": case.rule, "round": self.fleet.round,
                "detail": detail}
+        if pm_ref:
+            rec["pm_ref"] = pm_ref
+            node = self.fleet.nodes.get((self.role, case.hotkey))
+            if node is not None:
+                node.pm_ref = pm_ref
         obs.count(f"remediate.{action}")
         logger.warning("remediation: %s %s/%s (%s) %s", action, self.role,
                        case.hotkey, case.rule, detail)
@@ -210,7 +227,8 @@ class RemediationEngine:
                 logger.exception("remediation: sink emit failed")
         return rec
 
-    def _quarantine(self, hotkey: str, rule: str, detail: str) -> dict:
+    def _quarantine(self, hotkey: str, rule: str, detail: str,
+                    pm_ref: str | None = None) -> dict:
         node = self.fleet.node(self.role, hotkey)
         node.quarantined, node.probation = True, False
         relapse = hotkey in self._ever
@@ -220,7 +238,7 @@ class RemediationEngine:
             opened_round=self.fleet.round, beats_seen=node.beats)
         self.quarantines += 1
         return self._emit("requarantined" if relapse else "quarantined",
-                          case, detail)
+                          case, detail, pm_ref)
 
     def _rule(self, name: str):
         for r in self.fleet.rules:
@@ -252,7 +270,8 @@ class RemediationEngine:
             if case is not None and case.state == "quarantined":
                 continue        # already out; nothing more to do
             actions.append(self._quarantine(hotkey, rule,
-                                            b.get("detail", "")))
+                                            b.get("detail", ""),
+                                            b.get("pm_ref")))
         median = self.fleet.fleet_median_loss()
         for case in list(self.cases.values()):
             node = self.fleet.nodes.get((self.role, case.hotkey))
@@ -395,6 +414,8 @@ class LeaseManager:
             self.epoch = nxt
             obs.count("lease.acquired")
             obs.gauge(f"{self.role}.lease_epoch", float(nxt))
+            flight.record("lease", action="acquired", epoch=nxt,
+                          holder=self.hotkey, role=self.role)
             logger.info("lease %s: acquired epoch %d as %s", self.id, nxt,
                         self.hotkey)
             return True
@@ -416,6 +437,8 @@ class LeaseManager:
             cur = self.read_strict()
         except Exception:
             obs.count("lease.read_errors")
+            flight.record("lease", action="renew_failed", epoch=self.epoch,
+                          holder=self.hotkey, role=self.role)
             logger.warning("lease %s: renew read failed; standing down "
                            "this round", self.id, exc_info=True)
             return False
@@ -433,6 +456,12 @@ class LeaseManager:
                 "lease %s: superseded (held epoch %d, current epoch %d "
                 "holder %s) — standing down", self.id, self.epoch,
                 cur["epoch"], cur["holder"])
+            # losing the lease IS the failover's forensic moment on the
+            # deposed side: record + freeze, so the old primary's bundle
+            # shows what it was doing when the standby took over
+            flight.record("lease", action="lost", epoch=cur["epoch"],
+                          holder=cur["holder"], role=self.role)
+            flight.freeze_and_publish("lease_lost")
             self.epoch = 0
             return False
         try:
@@ -556,6 +585,18 @@ class StandbyAverager:
         obs.count("standby.takeovers")
         logger.warning("standby %s: took over publication at epoch %d",
                        self.lease.hotkey, self.lease.epoch)
+        # takeover forensics: freeze the standby's ring (what it watched
+        # the primary do before the silence) and attach the bundle
+        # reference to its own ledger entry, same as quarantine does
+        flight.record("lease", action="takeover", epoch=self.lease.epoch,
+                      holder=self.lease.hotkey, role=self.lease.role)
+        ref = flight.freeze_and_publish("takeover")
+        fleet = getattr(self.loop, "fleet", None)
+        if ref and fleet is not None:
+            try:
+                fleet.node("averager", self.lease.hotkey).pm_ref = ref
+            except Exception:
+                logger.exception("standby: ledger pm_ref attach failed")
         # bootstrap AFTER winning the lease: pulls the current published
         # base (never a local guess), so the first active round merges
         # against exactly what the fleet last saw

@@ -68,7 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import obs
+from ..utils import flight, obs
 from .batched_eval import _timed_compile
 
 logger = logging.getLogger(__name__)
@@ -120,6 +120,8 @@ class _Slot:
     seq_len: int         # tokens currently in the KV cache
     last_tok: int        # next input token (already emitted to req.tokens)
     order: int           # admission order (preemption picks the youngest)
+    last_emit_t: float = 0.0   # perf_counter at the last emitted token
+    #                            (drives the per-token serve.tpot_ms)
 
 
 # ---------------------------------------------------------------------------
@@ -293,11 +295,14 @@ class BaseRevisionWatcher:
             got = self._transport.fetch_base(self._template_fn())
         except Exception:
             obs.count("serve.swap_fetch_failures")
+            flight.record("swap", outcome="fetch_failed",
+                          revision=rev or "")
             logger.warning("base fetch for revision %s failed; serving "
                            "stays on the current base", rev, exc_info=True)
             return False
         if got is None:
             obs.count("serve.swap_fetch_failures")
+            flight.record("swap", outcome="torn_fetch", revision=rev or "")
             return False
         base, fetched_rev = got
         placed = jax.device_put(base)
@@ -649,6 +654,8 @@ class GenerationEngine:
         obs.observe("serve.swap_stall_ms",
                     (time.perf_counter() - t0) * 1e3)
         obs.count("serve.swaps")
+        flight.record("swap", outcome="swapped", revision=rev or "",
+                      policy=self.swap_policy)
         logger.info("hot-swapped base to revision %s", rev)
 
     # -- scheduling ---------------------------------------------------------
@@ -703,6 +710,20 @@ class GenerationEngine:
         slot.req.tokens.append(tok)
         self.tokens_emitted += 1
         obs.count("serve.tokens")
+        # request-level latency attribution: TTFT = queue admit (submit
+        # wall clock) -> first token, including queue wait — the number a
+        # CALLER experiences, which tokens/sec alone cannot show; TPOT =
+        # the wall gap between this slot's consecutive tokens (decode
+        # step + scheduler overhead as one per-token figure). Both export
+        # as dt_serve_ttft_ms_* / dt_serve_tpot_ms_* gauges and ride the
+        # server heartbeat into fleet_report's ttft95/tpot95 columns.
+        now = time.perf_counter()
+        if len(slot.req.tokens) == 1:
+            obs.observe("serve.ttft_ms",
+                        max(0.0, (time.time() - slot.req.submitted_t) * 1e3))
+        elif slot.last_emit_t:
+            obs.observe("serve.tpot_ms", (now - slot.last_emit_t) * 1e3)
+        slot.last_emit_t = now
         if (self.eos_id is not None and tok == self.eos_id) or \
                 len(slot.req.tokens) >= slot.req.max_new_tokens:
             self._finish(slot, "done")

@@ -75,6 +75,24 @@ AGG_PREFIX = "__agg__"
 # prefer those.
 SHARD_PREFIX = "__shard__"
 
+# Postmortem bundles (utils/flight.py): when a role's flight recorder
+# freezes — SLO breach, remediation action, crash hook — the bundle (a
+# content-addressed JSON document of the ring's recent events + registry
+# snapshot + sanitized config) publishes under a reserved per-(role,
+# hotkey) id through the SAME byte surface deltas use. That is the whole
+# point: forensics from a node that is about to die travel like any
+# other artifact — chaos-gated, signed when the fleet signs
+# (publish_delta_raw envelopes them), coordinator-gated on pods — and a
+# SURVIVOR fetches a dead peer's bundle from its storage slot exactly
+# like a delta (fetch_delta_bytes). Each freeze overwrites the previous
+# bundle (the storage-bounding overwrite rule); the full bundle history
+# survives in the role's metrics JSONL stream.
+PM_PREFIX = "__pm__"
+
+# consumer-side size cap for one bundle read (utils/flight.PM_MAX_BYTES
+# is the producer-side truncation bound; same number, one contract)
+PM_MAX_BYTES = 1 << 20
+
 
 def heartbeat_id(role: str, node_id: str) -> str:
     """The reserved per-node artifact id heartbeats publish under.
@@ -128,17 +146,56 @@ def is_shard_id(artifact_id: str) -> bool:
         artifact_id.startswith(SHARD_PREFIX + ".")
 
 
+def pm_id(role: str, node_id: str) -> str:
+    """The reserved artifact id a (role, hotkey)'s postmortem bundle
+    publishes under — role-qualified like heartbeat ids, because one
+    hotkey may run several roles against one store and each role's
+    forensics are distinct."""
+    return f"{PM_PREFIX}.{role}.{node_id}"
+
+
+def is_pm_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(PM_PREFIX + ".")
+
+
 def is_reserved_id(artifact_id: str) -> bool:
-    """True for any id in the reserved control-plane/shard/aggregate
-    namespace (heartbeats, leases, wire-v2 shards, partial aggregates) —
-    FLAT delta consumers must never stage these as miner submissions
-    (the hierarchy root stages ``__agg__.*`` ids deliberately, from its
-    configured node list, never from the metagraph)."""
+    """True for any id in the reserved control-plane/shard/aggregate/
+    postmortem namespace (heartbeats, leases, wire-v2 shards, partial
+    aggregates, flight-recorder bundles) — FLAT delta consumers must
+    never stage these as miner submissions (the hierarchy root stages
+    ``__agg__.*`` ids deliberately, from its configured node list,
+    never from the metagraph)."""
     return isinstance(artifact_id, str) and (
         artifact_id.startswith(HEARTBEAT_PREFIX + ".")
         or artifact_id.startswith(LEASE_PREFIX + ".")
         or artifact_id.startswith(SHARD_PREFIX + ".")
-        or artifact_id.startswith(AGG_PREFIX + "."))
+        or artifact_id.startswith(AGG_PREFIX + ".")
+        or artifact_id.startswith(PM_PREFIX + "."))
+
+
+def publish_postmortem(transport, role: str, node_id: str,
+                       data: bytes) -> None:
+    """Publish one frozen bundle's bytes under the reserved pm id.
+    Prefers ``publish_delta_raw`` (SignedTransport envelopes it under
+    the delta context — a signed fleet's forensics are attributable),
+    falling back to ``publish_raw`` on plain transports."""
+    pdr = getattr(transport, "publish_delta_raw", None)
+    if pdr is not None:
+        pdr(pm_id(role, node_id), data)
+        return
+    transport.publish_raw(pm_id(role, node_id), data)
+
+
+def fetch_postmortem_bytes(transport, role: str,
+                           node_id: str) -> bytes | None:
+    """Raw (possibly enveloped, size-capped) bundle bytes for one
+    (role, hotkey), or None — validation and envelope-stripping live in
+    utils/flight.fetch_bundle, the same split as delta reads."""
+    data = transport.fetch_delta_bytes(pm_id(role, node_id))
+    if data is not None and len(data) > PM_MAX_BYTES:
+        return None
+    return data
 
 
 def publish_shard(transport, hotkey: str, layer_key: str,

@@ -68,29 +68,65 @@ class JSONLSink:
     and only the newest ``keep_segments`` rotated segments survive — a
     multi-day soak at second-scale cadences otherwise grows one multi-GB
     file (scripts/soak.py). Readers use :func:`jsonl_segments` to walk the
-    rotation transparently. 0/None disables (the historical behavior)."""
+    rotation transparently. 0/None disables (the historical behavior).
+
+    Rotation only size-bounds what THIS run writes; segments left by a
+    previous run with a larger ``keep_segments`` (or a since-lowered
+    config) would otherwise survive forever on long soak boxes. The lazy
+    open therefore sweeps segments beyond ``retention_segments``
+    (default: ``keep_segments``) once, before the first record lands —
+    counted as ``obs.segments_pruned``."""
 
     def __init__(self, path: str, *, max_bytes: int | None = None,
-                 keep_segments: int = 3):
+                 keep_segments: int = 3,
+                 retention_segments: int | None = None):
         self.path = path
         if max_bytes is not None and max_bytes < 0:
             raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
         if keep_segments < 1:
             raise ValueError(
                 f"keep_segments must be >= 1, got {keep_segments}")
+        if retention_segments is not None and retention_segments < 1:
+            raise ValueError(f"retention_segments must be >= 1, "
+                             f"got {retention_segments}")
         self.max_bytes = max_bytes or 0
         self.keep_segments = keep_segments
+        self.retention_segments = retention_segments
         self.rotations = 0
+        self.segments_pruned = 0
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._lock = threading.Lock()
         self._fh = None  # opened lazily: no file until the first record
         self._written = None  # bytes in the current segment (lazy stat)
+
+    def _sweep_locked(self) -> None:
+        """Drop rotated segments beyond the retention bound (oldest-only
+        by construction: ``path.N`` grows with age). A failed unlink
+        stops the sweep — better a stale segment than a crashed sink."""
+        keep = self.retention_segments or self.keep_segments
+        n = keep + 1
+        pruned = 0
+        while True:
+            seg = f"{self.path}.{n}"
+            if not os.path.exists(seg):
+                break
+            try:
+                os.remove(seg)
+            except OSError:
+                break
+            pruned += 1
+            n += 1
+        if pruned:
+            self.segments_pruned += pruned
+            from . import obs
+            obs.count("obs.segments_pruned", pruned)
 
     def log(self, metrics: dict[str, Any], *, step: int | None = None) -> None:
         rec = {"ts": time.time(), "step": step, **metrics}
         line = json.dumps(rec, default=float) + "\n"
         with self._lock:
             if self._fh is None:
+                self._sweep_locked()
                 self._fh = open(self.path, "a", buffering=1)
                 if self.max_bytes:
                     self._written = self._fh.tell()  # append mode: resume

@@ -259,6 +259,11 @@ class _ObsState:
         self.sink = None          # MetricsSink or None (None = disabled)
         self.role: str | None = None
         self.tl = threading.local()
+        # attached FlightRecorder (utils/flight.py) or None: span closes,
+        # registry flushes, and anomaly triggers mirror into its bounded
+        # event ring. Held HERE (not imported) so obs stays import-light
+        # and flight -> obs stays the only dependency direction.
+        self.flight = None
 
 
 _STATE = _ObsState()
@@ -279,6 +284,20 @@ def enabled() -> bool:
 
 def registry() -> Registry:
     return _STATE.registry
+
+
+def current_sink():
+    """The configured MetricsSink (or None) — the flight recorder logs
+    frozen postmortem bundles through the same stream the spans ride."""
+    return _STATE.sink
+
+
+def attach_flight(recorder) -> None:
+    """Attach (or detach, with None) a flight recorder (utils/flight.py):
+    span closes, registry flushes, and anomaly triggers then mirror into
+    its event ring. reset() drops the attachment with the rest of the
+    process-wide state."""
+    _STATE.flight = recorder
 
 
 def reset() -> None:
@@ -337,6 +356,12 @@ def flush(sink=None, *, step: int | None = None) -> dict[str, float]:
     if snap:
         sink.log({"obs_registry": _STATE.role or "unknown", **snap},
                  step=step)
+    fl = _STATE.flight
+    if fl is not None:
+        try:
+            fl.on_flush(snap)
+        except Exception:
+            logger.exception("flight flush hook failed")
     return snap
 
 
@@ -484,6 +509,12 @@ def span(name: str, *, cid: str | None = None, **attrs):
             st.sink.log(rec)
         except Exception:  # a broken sink must never break the traced phase
             logger.exception("span sink emit failed")
+        fl = st.flight
+        if fl is not None:
+            try:
+                fl.on_span(name, dur_ms, ccid, ok)
+            except Exception:  # forensics must degrade, never break a phase
+                logger.exception("flight span hook failed")
 
 
 # ---------------------------------------------------------------------------
@@ -602,5 +633,12 @@ class AnomalyMonitor:
                 _STATE.sink.log({"anomaly": reason, **details})
             except Exception:
                 logger.exception("anomaly sink emit failed")
+        fl = _STATE.flight
+        if fl is not None:
+            try:
+                fl.record("anomaly", reason=reason,
+                          armed=self.capture is not None)
+            except Exception:
+                logger.exception("flight anomaly hook failed")
         if self.capture is not None:
             self.capture.arm()

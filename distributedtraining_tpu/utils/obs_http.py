@@ -13,11 +13,19 @@ the fleet health ledger.
   (label cardinality is bounded by the fleet size, the same reasoning
   as the validator's one-structured-record rule).
 - ``/healthz`` — a JSON liveness probe (role, metric count, fleet size).
+- ``/debug/dump`` — freeze the flight recorder's ring (utils/flight.py)
+  into a postmortem bundle NOW and return it as JSON (``?publish=1``
+  also ships it through the Transport under the reserved ``__pm__`` id);
+- ``/debug/profile?ms=N`` — capture N milliseconds of ``jax.profiler``
+  trace into the exporter's profile dir (409 while one is running);
+- ``/debug/stacks`` — an all-thread stack dump (text/plain), the
+  wedged-loop question answered without gdb.
 
 No new dependencies, no TLS, binds 127.0.0.1 by default — this is a
 scrape endpoint for a co-located agent, not a public surface. Live
 exporters are tracked in a weak set so the tests/conftest.py hygiene
-guard can fail any test that leaves a socket listening.
+guard can fail any test that leaves a socket listening (live profiler
+sessions have their own guard via flight.live_profile_sessions).
 """
 
 from __future__ import annotations
@@ -25,9 +33,12 @@ from __future__ import annotations
 import json
 import logging
 import math
+import sys
 import threading
+import traceback
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from . import obs
 
@@ -127,6 +138,22 @@ def render(registry=None, fleet=None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_stacks() -> str:
+    """All-thread stack dump (the /debug/stacks body): thread name +
+    daemon flag + current frames, newest frame last — what "where is the
+    serve loop stuck" needs, without attaching a debugger."""
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(frames.items()):
+        t = by_ident.get(ident)
+        name = t.name if t is not None else f"ident-{ident}"
+        daemon = " daemon" if t is not None and t.daemon else ""
+        out.append(f"--- thread {name}{daemon} (ident {ident}) ---")
+        out.append("".join(traceback.format_stack(frame)).rstrip())
+    return "\n".join(out) + "\n"
+
+
 class ObsHTTPExporter:
     """Serve :func:`render` on ``http://host:port/metrics``.
 
@@ -136,12 +163,16 @@ class ObsHTTPExporter:
     listener down and joins the serve thread (idempotent)."""
 
     def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
-                 registry=None, fleet=None, role: str | None = None):
+                 registry=None, fleet=None, role: str | None = None,
+                 profile_dir: str | None = None):
         self.host = host
         self.port = port
         self.registry = registry
         self.fleet = fleet
         self.role = role
+        # where /debug/profile writes its traces; None lazily falls back
+        # to a tempdir so the endpoint works on an unconfigured exporter
+        self.profile_dir = profile_dir
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -161,8 +192,59 @@ class ObsHTTPExporter:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, code: int, obj) -> None:
+                self._send(code, (json.dumps(obj, default=float)
+                                  + "\n").encode(), "application/json")
+
+            def _debug(self, path: str, query: dict) -> None:
+                from . import flight
+                if path == "/debug/stacks":
+                    self._send(200, render_stacks().encode(),
+                               "text/plain; charset=utf-8")
+                elif path == "/debug/dump":
+                    rec = flight.recorder()
+                    if rec is None:
+                        self._send_json(503, {
+                            "error": "no flight recorder configured "
+                                     "(--flight-events 0?)"})
+                        return
+                    bundle = rec.freeze("debug_dump")
+                    if query.get("publish", ["0"])[0] not in ("0", ""):
+                        rec.publish(bundle)
+                    self._send_json(200, bundle)
+                elif path == "/debug/profile":
+                    try:
+                        ms = float(query.get("ms", ["500"])[0])
+                    except ValueError:
+                        self._send_json(400, {"error": "ms must be a "
+                                                       "number"})
+                        return
+                    pdir = exporter.profile_dir
+                    if pdir is None:
+                        import tempfile
+                        pdir = exporter.profile_dir = tempfile.mkdtemp(
+                            prefix="dt-debug-profile-")
+                    try:
+                        info = flight.capture_profile(pdir, ms)
+                    except RuntimeError as e:
+                        self._send_json(409, {"error": str(e)})
+                        return
+                    except Exception:
+                        logger.exception("obs_http: profile capture "
+                                         "failed")
+                        self._send_json(500, {"error": "profile capture "
+                                                       "failed"})
+                        return
+                    self._send_json(200, info)
+                else:
+                    self._send_json(404, {"error": "unknown debug "
+                                                   "endpoint"})
+
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-                path = self.path.split("?", 1)[0]
+                path, _, rawq = self.path.partition("?")
+                if path.startswith("/debug/"):
+                    self._debug(path, parse_qs(rawq))
+                    return
                 if path in ("/metrics", "/"):
                     try:
                         body = render(exporter.registry,

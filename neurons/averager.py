@@ -109,7 +109,8 @@ def _run_sub_averager(cfg: RunConfig, c, plane) -> int:
     finally:
         plane.close()
         sub.close()
-        from distributedtraining_tpu.utils import obs
+        from distributedtraining_tpu.utils import flight, obs
+        flight.shutdown()
         obs.reset()
     logging.info("sub-averager %s done: rounds=%d accepted=%d pushes=%d",
                  node, sub.report.rounds, sub.report.last_accepted,
@@ -122,6 +123,9 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(message)s")
     cfg = RunConfig.from_args("averager", argv)
     c = build(cfg)
+    # crash-forensics triggers (utils/flight.py, see neurons/miner.py)
+    from distributedtraining_tpu.utils import flight
+    flight.install_crash_hooks()
     # fleet health plane: the averager both heartbeats AND monitors —
     # its FleetMonitor folds every gather's staging outcomes into the
     # contribution ledger and evaluates the SLO rules each round; a
@@ -216,7 +220,8 @@ def main(argv=None) -> int:
     finally:
         plane.close()  # exporter socket + heartbeat timer + fleet pool
         loop.close()   # drain the ingest pool's worker threads
-        # see neurons/miner.py: global obs state must not outlive the role
+        # see neurons/miner.py: crash bundle, then global obs state reset
+        flight.shutdown()
         from distributedtraining_tpu.utils import obs
         obs.reset()
     logging.info("averager done: rounds=%d accepted=%d rejected=%d loss=%.4f",

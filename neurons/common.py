@@ -212,8 +212,10 @@ def build_health_plane(cfg: RunConfig, c: Components, *,
             "heartbeat-fed FleetMonitor")
     if cfg.obs_port:
         from distributedtraining_tpu.utils.obs_http import ObsHTTPExporter
-        plane.exporter = ObsHTTPExporter(cfg.obs_port, fleet=plane.fleet,
-                                         role=cfg.role)
+        plane.exporter = ObsHTTPExporter(
+            cfg.obs_port, fleet=plane.fleet, role=cfg.role,
+            profile_dir=os.path.join(cfg.work_dir, "debug_traces",
+                                     cfg.hotkey))
         plane.exporter.start()
     return plane
 
@@ -483,6 +485,17 @@ def build(cfg: RunConfig) -> Components:
         # it on exit so sequential in-process role runs (e2e) stay clean.
         from distributedtraining_tpu.utils import obs
         obs.configure(metrics, role=cfg.role)
+    if cfg.flight_events > 0:
+        # flight recorder (utils/flight.py): the bounded forensic ring
+        # every role keeps, frozen into a transport-published __pm__
+        # bundle on SLO breach / remediation / crash. Configured on every
+        # process — bundle PUBLISHES ride the coordinator-gated transport
+        # like any other write, so pod workers record locally and ship
+        # nothing. Role mains install the crash hooks and call
+        # flight.shutdown() on exit.
+        from distributedtraining_tpu.utils import flight
+        flight.configure(cfg.role, cfg.hotkey, transport=transport,
+                         capacity=cfg.flight_events, config=cfg)
 
     lora_cfg = None
     if cfg.lora_rank > 0:

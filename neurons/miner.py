@@ -55,6 +55,11 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(message)s")
     cfg = RunConfig.from_args("miner", argv)
     c = build(cfg)
+    # crash-forensics triggers (utils/flight.py): an unhandled exception
+    # (main or worker thread) or interpreter exit freezes the flight
+    # ring into a transport-published postmortem bundle
+    from distributedtraining_tpu.utils import flight
+    flight.install_crash_hooks()
 
     trace = None
     if cfg.profile_dir:
@@ -166,9 +171,12 @@ def main(argv=None) -> int:
         if store is not None:
             store.close()
         plane.close()   # exporter socket + heartbeat timer (idempotent)
-        # drop the process-wide observability state: sequential in-process
-        # role runs (scripts/e2e_round.py, tests) must not bleed this
-        # role's registry/sink into the next
+        # crash bundle first (an exceptional exit freezes the ring here,
+        # while the transport is still wired), then drop the process-wide
+        # observability state: sequential in-process role runs
+        # (scripts/e2e_round.py, tests) must not bleed this role's
+        # recorder/registry/sink into the next
+        flight.shutdown()
         from distributedtraining_tpu.utils import obs
         obs.reset()
     logging.info("miner done: steps=%d pushes=%d (failed=%d superseded=%d) "

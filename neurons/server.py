@@ -75,6 +75,9 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(message)s")
     cfg = RunConfig.from_args("server", argv)
     c = build(cfg)
+    # crash-forensics triggers (utils/flight.py, see neurons/miner.py)
+    from distributedtraining_tpu.utils import flight
+    flight.install_crash_hooks()
 
     watcher = BaseRevisionWatcher(
         c.transport, lambda: host_param_template(c.model),
@@ -94,11 +97,30 @@ def main(argv=None) -> int:
     # tokens/sec and queue depth as numeric extras — fleet_report's
     # served_rev/tok_s columns come from here
     from distributedtraining_tpu.engine.health import Vitals
+    from distributedtraining_tpu.utils import obs as _obs
+
+    def _serve_counters():
+        out = {"tokens_per_sec": engine.tokens_per_sec,
+               "queue_depth": float(engine.queue_depth),
+               "tokens": float(engine.tokens_emitted)}
+        # request-level latency percentiles (engine/serve.py observes
+        # serve.ttft_ms / serve.tpot_ms per token): ride the heartbeat
+        # as numeric extras so fleet_report's ttft95/tpot95 columns show
+        # caller-experienced latency next to tokens/sec. names() guards
+        # the read — histogram() would CREATE an empty series and skew
+        # the registry digest on idle servers.
+        names = _obs.registry().names()
+        for metric, field in (("serve.ttft_ms", "ttft_ms_p95"),
+                              ("serve.tpot_ms", "tpot_ms_p95")):
+            if metric in names:
+                h = _obs.registry().histogram(metric)
+                if h.count:
+                    out[field] = h.percentiles((95.0,))["p95"]
+        return out
+
     vitals = Vitals(
         steps=lambda: engine.steps,
-        counters=lambda: {"tokens_per_sec": engine.tokens_per_sec,
-                          "queue_depth": float(engine.queue_depth),
-                          "tokens": float(engine.tokens_emitted)},
+        counters=_serve_counters,
         base_revision=lambda: engine.revision)
     plane = build_health_plane(cfg, c, vitals=vitals)
 
@@ -148,6 +170,8 @@ def main(argv=None) -> int:
         engine.close()
         if c.metrics is not None:
             obs.flush(step=engine.steps)
+        # crash bundle (exceptional exits), then global obs state reset
+        flight.shutdown()
         obs.reset()
     logger.info("server done: steps=%d tokens=%d revision=%s",
                 engine.steps, engine.tokens_emitted, engine.revision)

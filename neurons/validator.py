@@ -33,6 +33,9 @@ def main(argv=None) -> int:
                         format="%(asctime)s %(name)s %(message)s")
     cfg = RunConfig.from_args("validator", argv)
     c = build(cfg)
+    # crash-forensics triggers (utils/flight.py, see neurons/miner.py)
+    from distributedtraining_tpu.utils import flight
+    flight.install_crash_hooks()
     validator = Validator(c.engine, c.transport, c.chain,
                           eval_batches=c.eval_batches(),
                           metric=cfg.score_metric,
@@ -98,7 +101,8 @@ def main(argv=None) -> int:
     finally:
         plane.close()       # exporter socket + heartbeat timer + pool
         validator.close()   # drain the ingest pool's worker threads
-        # see neurons/miner.py: global obs state must not outlive the role
+        # see neurons/miner.py: crash bundle, then global obs state reset
+        flight.shutdown()
         from distributedtraining_tpu.utils import obs
         obs.reset()
     return 0 if ok else 1

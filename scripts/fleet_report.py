@@ -38,8 +38,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
-           "loss_ema", "rev", "tok_s", "published", "accepted", "declined",
-           "stale_rounds", "wire_b", "score", "quar", "slo")
+           "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "published",
+           "accepted", "declined", "stale_rounds", "wire_b", "score",
+           "quar", "slo")
 
 
 def build_report(paths: list[str]) -> dict:
@@ -72,15 +73,16 @@ def build_report(paths: list[str]) -> dict:
         if isinstance(rec.get("slo_breach"), str):
             breaches.append({k: rec.get(k) for k in
                              ("slo_breach", "role", "hotkey", "detail",
-                              "round", "ts")})
+                              "round", "ts", "pm_ref")})
             continue
         if isinstance(rec.get("remediation"), str):
             # quarantine / readmission / failover actions
             # (engine/remediate.py) — the what-was-DONE half of the
-            # breach records above
+            # breach records above; pm_ref points at the postmortem
+            # bundle the action attached (scripts/postmortem.py)
             remediations.append({k: rec.get(k) for k in
                                  ("remediation", "hotkey", "rule",
-                                  "round", "detail", "ts")})
+                                  "round", "detail", "ts", "pm_ref")})
             continue
         pr = rec.get("fleet_pruned")
         if isinstance(pr, dict):
@@ -137,6 +139,13 @@ def _cell(node: dict, col: str) -> str:
     if col == "tok_s":
         # serving throughput (server-role heartbeats only)
         v = node.get("tokens_per_sec")
+        return "-" if v is None else f"{v:.1f}"
+    if col in ("ttft95", "tpot95"):
+        # request-level serving latency (server heartbeats, engine/serve
+        # serve.ttft_ms / serve.tpot_ms p95): queue-admit -> first token,
+        # and the per-token decode gap — what a CALLER experiences,
+        # which tok_s alone cannot show
+        v = node.get("ttft_ms_p95" if col == "ttft95" else "tpot_ms_p95")
         return "-" if v is None else f"{v:.1f}"
     if col == "wire_b":
         # transport bytes the monitor role fetched staging this miner
@@ -197,8 +206,9 @@ def format_table(rep: dict) -> str:
                    "health.beats", "fleet.heartbeats",
                    "device.mem_peak_bytes",
                    "serve.tokens", "serve.tokens_per_sec",
-                   "serve.token_ms.p95", "serve.swap_stall_ms.p95",
-                   "serve.swaps")
+                   "serve.token_ms.p95", "serve.ttft_ms.p95",
+                   "serve.tpot_ms.p95", "serve.swap_stall_ms.p95",
+                   "serve.swaps", "flight.bundles")
     for role, snap in sorted(reg.items()):
         picks = {k: snap[k] for k in interesting if k in snap}
         if picks:

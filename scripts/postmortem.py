@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Reconstruct a causal round timeline from postmortem bundles + obs JSONL.
+
+The flight recorder (utils/flight.py) freezes each role's bounded event
+ring into a content-addressed bundle on SLO breach / remediation /
+crash, published through the Transport under the reserved
+``__pm__.<role>.<hotkey>`` id and mirrored into the role's metrics JSONL
+as a ``{"postmortem": ...}`` record. This script is the offline half: it
+ingests bundles from N roles (files fetched/copied from the transport
+store, or the JSONL mirrors) plus the ordinary per-role obs JSONL
+segments, and stitches ONE time-ordered timeline — who published what,
+which publish tore, which SLO rule fired where, what the quarantine or
+failover actually saw — joined on the correlation id (cid), round
+number, and base revision the PR-3 tracing already threads end to end.
+
+Usage:
+    python scripts/postmortem.py miner.jsonl averager.jsonl __pm__.miner.m0
+    python scripts/postmortem.py --work-dir ./run    # *.jsonl + __pm__*
+    python scripts/postmortem.py ... --json          # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import obs_report  # noqa: E402 — same directory; shares record loading
+
+# mirror of utils/flight.EVENT_KINDS (scripts stay import-free of the
+# package): events whose kind is not in this closed vocabulary are
+# REJECTED on ingest, the same bundle-schema lint consumers apply
+EVENT_KINDS = ("config", "span", "metrics", "anomaly", "slo", "lease",
+               "swap", "publish", "heartbeat", "remediation", "crash",
+               "note")
+
+# a torn or failed publish outcome — the needle a crash forensics pass
+# is usually looking for
+_BAD_PUBLISH = ("failed", "torn")
+
+
+def _load_bundle_file(path: str) -> list[dict]:
+    """A bundle file is the raw published artifact (one JSON object,
+    possibly signature-enveloped). Returns [] when the file is not
+    parseable JSON (e.g. an envelope without the strip tooling) — the
+    JSONL mirror of the same bundle still reads."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        print(f"warning: cannot read {path}: {e}", file=sys.stderr)
+        return []
+    # tolerate a signature envelope by scanning to the first '{' — the
+    # payload of an enveloped bundle is still one JSON document
+    start = data.find(b"{")
+    if start < 0:
+        return []
+    try:
+        obj = json.loads(data[start:])
+    except ValueError:
+        print(f"warning: {path} is not a JSON bundle (signed envelope? "
+              "use the JSONL mirror)", file=sys.stderr)
+        return []
+    return [obj] if isinstance(obj, dict) else []
+
+
+def normalize_bundle(obj: dict) -> dict | None:
+    """Consumer-side bundle lint (mirrors utils/flight.parse_bundle):
+    versioned, role/hotkey validated, unknown event kinds rejected."""
+    v = obj.get("pm")
+    if not isinstance(v, (int, float)) or int(v) < 1:
+        return None
+    role, hotkey = obj.get("role"), obj.get("hotkey")
+    if not (isinstance(role, str) and role) \
+            or not (isinstance(hotkey, str) and hotkey):
+        return None
+    events, rejected = [], 0
+    for ev in obj.get("events") or []:
+        if not (isinstance(ev, dict) and ev.get("kind") in EVENT_KINDS
+                and isinstance(ev.get("t"), (int, float))):
+            rejected += 1
+            continue
+        events.append(ev)
+    return {
+        "role": role, "hotkey": hotkey,
+        "t": obj.get("t"), "reason": obj.get("reason"),
+        "bundle_id": obj.get("bundle_id"),
+        "events": events, "events_rejected": rejected,
+        "registry": obj.get("registry") if isinstance(obj.get("registry"),
+                                                      dict) else {},
+        "crash": obj.get("crash") if isinstance(obj.get("crash"),
+                                                dict) else None,
+    }
+
+
+def _entry(t, source, kind, via, fields: dict) -> dict:
+    out = {"t": float(t), "source": source, "kind": kind, "via": via}
+    out.update({k: v for k, v in fields.items()
+                if k not in ("t", "kind") and v is not None})
+    return out
+
+
+def collect(paths: list[str]) -> tuple[list[dict], list[dict]]:
+    """(bundles, timeline_entries) from every input: bundle files,
+    JSONL streams (including their ``postmortem`` mirrors), rotated
+    segments transparently."""
+    bundle_paths = [p for p in paths
+                    if os.path.basename(p).startswith("__pm__")]
+    jsonl_paths = [p for p in paths if p not in set(bundle_paths)]
+    raw_bundles: list[dict] = []
+    for path in bundle_paths:
+        raw_bundles += _load_bundle_file(path)
+    records = obs_report.load_records(jsonl_paths)
+    for rec in records:
+        pm = rec.get("postmortem")
+        if isinstance(pm, dict):
+            raw_bundles.append(pm)
+    # dedup on bundle_id (the content address): the transport artifact
+    # and its JSONL mirror are the same evidence
+    bundles, seen = [], set()
+    for obj in raw_bundles:
+        b = normalize_bundle(obj)
+        if b is None:
+            continue
+        key = b.get("bundle_id") or id(obj)
+        if key in seen:
+            continue
+        seen.add(key)
+        bundles.append(b)
+
+    timeline: list[dict] = []
+    for b in bundles:
+        src = f"{b['role']}/{b['hotkey']}"
+        via = f"bundle:{b.get('bundle_id') or '?'}"
+        for ev in b["events"]:
+            timeline.append(_entry(ev["t"], src, ev["kind"], via, ev))
+        if b.get("crash"):
+            timeline.append(_entry(b.get("t") or 0.0, src, "crash", via,
+                                   dict(b["crash"], reason=b["reason"])))
+    for rec in records:
+        ts = rec.get("ts") or rec.get("t0") or 0.0
+        if isinstance(rec.get("span"), str):
+            timeline.append(_entry(
+                rec.get("t0", ts), f"{rec.get('role', '?')}/-", "span",
+                "jsonl", {"name": rec["span"], "dur_ms": rec.get("dur_ms"),
+                          "cid": rec.get("cid"),
+                          "error": rec.get("error")}))
+        elif isinstance(rec.get("slo_breach"), str):
+            timeline.append(_entry(
+                ts, f"{rec.get('role', '?')}/{rec.get('hotkey', '?')}",
+                "slo", "jsonl", {"rule": rec["slo_breach"],
+                                 "detail": rec.get("detail"),
+                                 "round": rec.get("round"),
+                                 "pm_ref": rec.get("pm_ref")}))
+        elif isinstance(rec.get("remediation"), str):
+            timeline.append(_entry(
+                ts, f"-/{rec.get('hotkey', '?')}", "remediation", "jsonl",
+                {"action": rec["remediation"], "rule": rec.get("rule"),
+                 "round": rec.get("round"), "pm_ref": rec.get("pm_ref")}))
+        elif isinstance(rec.get("heartbeat"), dict):
+            hb = rec["heartbeat"]
+            timeline.append(_entry(
+                ts, f"{hb.get('role', '?')}/{hb.get('hotkey', '?')}",
+                "heartbeat", "jsonl", {"seq": hb.get("seq"),
+                                       "observed": True}))
+        elif "merged_loss" in rec:
+            timeline.append(_entry(
+                ts, "averager/-", "publish", "jsonl",
+                {"outcome": "ok" if rec.get("published") else "declined",
+                 "merged_loss": rec.get("merged_loss"),
+                 "round": rec.get("step"),
+                 "cids": sorted((rec.get("merge_delta_ids") or {})
+                                .values())}))
+    timeline.sort(key=lambda e: e["t"])
+    return bundles, timeline
+
+
+def _cids_of(entry: dict) -> list[str]:
+    out = []
+    if isinstance(entry.get("cid"), str) and entry["cid"]:
+        out.append(entry["cid"])
+    if isinstance(entry.get("cids"), list):
+        out += [c for c in entry["cids"] if isinstance(c, str) and c]
+    return out
+
+
+def report(paths: list[str]) -> dict:
+    bundles, timeline = collect(paths)
+    by_cid: dict[str, list[dict]] = {}
+    by_round: dict[str, list[dict]] = {}
+    by_revision: dict[str, list[dict]] = {}
+    for e in timeline:
+        for cid in _cids_of(e):
+            by_cid.setdefault(cid, []).append(e)
+        rnd = e.get("round")
+        if isinstance(rnd, (int, float)):
+            by_round.setdefault(str(int(rnd)), []).append(e)
+        rev = e.get("revision") or e.get("base_revision")
+        if isinstance(rev, str) and rev:
+            by_revision.setdefault(rev, []).append(e)
+    torn = [e for e in timeline if e["kind"] == "publish"
+            and e.get("outcome") in _BAD_PUBLISH]
+    slo = [e for e in timeline if e["kind"] == "slo"]
+    crashes = [e for e in timeline if e["kind"] == "crash"]
+    # the causal joins: cids (and rounds) whose events span >1 source —
+    # one artifact's life (or one round's decisions) seen from multiple
+    # roles at once, which is the whole point of the postmortem plane
+    joined_cids = {cid: sorted({e["source"] for e in evs})
+                   for cid, evs in by_cid.items()
+                   if len({e["source"] for e in evs}) > 1}
+    return {
+        "files": paths,
+        "bundles": [{k: b[k] for k in ("role", "hotkey", "reason",
+                                       "bundle_id", "t",
+                                       "events_rejected")}
+                    | {"events": len(b["events"]),
+                       "crash": bool(b.get("crash"))}
+                    for b in bundles],
+        "timeline": timeline,
+        "by_cid": by_cid,
+        "by_round": by_round,
+        "by_revision": by_revision,
+        "joined_cids": joined_cids,
+        "torn_publishes": torn,
+        "slo_fired": slo,
+        "crashes": crashes,
+        "roles": sorted({b["role"] for b in bundles}
+                        | {e["source"].split("/", 1)[0]
+                           for e in timeline if e["source"][0] != "-"}),
+    }
+
+
+def _fmt(e: dict) -> str:
+    skip = ("t", "source", "kind", "via", "snapshot")
+    detail = " ".join(f"{k}={e[k]}" for k in e
+                      if k not in skip and not isinstance(e[k], (dict,)))
+    return f"{e['t']:.3f}  {e['source']:<24} {e['kind']:<12} {detail}"
+
+
+def format_report(rep: dict) -> str:
+    lines = [f"{len(rep['bundles'])} bundle(s) from "
+             f"{len(rep['roles'])} role(s): "
+             + ", ".join(f"{b['role']}/{b['hotkey']} "
+                         f"({b['reason']}, {b['events']} ev)"
+                         for b in rep["bundles"])]
+    lines.append("")
+    for e in rep["timeline"]:
+        lines.append(_fmt(e))
+    lines.append("")
+    if rep["torn_publishes"]:
+        lines.append("torn/failed publishes:")
+        for e in rep["torn_publishes"]:
+            lines.append("  " + _fmt(e))
+    if rep["slo_fired"]:
+        lines.append("SLO rules fired:")
+        for e in rep["slo_fired"]:
+            lines.append("  " + _fmt(e))
+    if rep["crashes"]:
+        lines.append("crashes:")
+        for e in rep["crashes"]:
+            lines.append("  " + _fmt(e))
+    if rep["joined_cids"]:
+        lines.append("cids joined across roles:")
+        for cid, sources in sorted(rep["joined_cids"].items()):
+            lines.append(f"  {cid}: {' + '.join(sources)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*",
+                   help="postmortem bundle files (__pm__*) and/or "
+                        "per-role JSONL metric files")
+    p.add_argument("--work-dir", default=None,
+                   help="glob <work-dir>/*.jsonl plus the localfs "
+                        "transport's __pm__ artifacts instead of "
+                        "listing files")
+    p.add_argument("--json", dest="json_out", action="store_true",
+                   help="print the full report as JSON (machine-readable)")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON report to this path")
+    a = p.parse_args(argv)
+    paths = list(a.files)
+    if a.work_dir:
+        paths += sorted(glob.glob(os.path.join(a.work_dir, "*.jsonl")))
+        for sub in ("artifacts/deltas", "deltas"):
+            paths += sorted(glob.glob(
+                os.path.join(a.work_dir, sub, "__pm__*")))
+    if not paths:
+        p.error("no input files (pass bundles/JSONL paths or --work-dir)")
+    rep = report(paths)
+    if not rep["bundles"] and not rep["timeline"]:
+        print(f"no postmortem bundles or obs records found in "
+              f"{len(paths)} file(s) — are the roles running with "
+              "--flight-events > 0 and --metrics-path?")
+        return 1
+    if a.json_out:
+        print(json.dumps(rep, indent=1, default=float))
+    else:
+        print(format_report(rep))
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(rep, f, indent=1, default=float)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head et al. closing stdout is not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

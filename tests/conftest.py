@@ -217,6 +217,40 @@ def _no_leaked_serving_plane():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _no_leaked_flight_state():
+    """Postmortem-plane hygiene (utils/flight.py): a configured flight
+    recorder is PROCESS-WIDE state (same rule as the obs guard below), a
+    leaked crash hook rewrites sys.excepthook/threading.excepthook for
+    every later module, and a /debug/profile session whose jax profiler
+    is still running poisons every later capture in the process (the
+    profiler is a process global). Debug-endpoint SOCKETS ride the
+    exporter and are covered by the health-plane guard above. Force-clean
+    so one offender cannot cascade, then fail the module."""
+    yield
+    from distributedtraining_tpu.utils import flight
+
+    live = flight.live_profile_sessions()
+    for sess in live:
+        try:
+            sess.stop()
+        except Exception:
+            pass
+    was_dirty = flight.dirty()
+    had_hooks = flight.hooks_installed()
+    flight.reset()
+    assert not live, (
+        f"test module left a /debug/profile session running: {live}; "
+        "flight.capture_profile must stop its own trace")
+    assert not was_dirty, (
+        "test module left a configured flight recorder behind; call "
+        "flight.reset() in teardown")
+    assert not had_hooks, (
+        "test module left flight crash hooks installed "
+        "(sys.excepthook/threading.excepthook/atexit); call "
+        "flight.uninstall_crash_hooks() or flight.reset() in teardown")
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _no_leaked_obs_state():
     """Observability hygiene (mirrors the thread-leak guard above): the
     span/metric layer (utils/obs.py) is PROCESS-WIDE state — a test that

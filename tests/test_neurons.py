@@ -17,6 +17,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from neurons import averager, miner, validator  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _clean_flight():
+    """build() now configures the flight recorder (utils/flight.py);
+    the role mains shut it down on exit, but the tests below that call
+    common.build() DIRECTLY (no main, no finally) must not leak it into
+    the module guard."""
+    yield
+    from distributedtraining_tpu.utils import flight
+    flight.reset()
+
+
 def _common(tmp_path, hotkey, extra=()):
     return [
         "--backend", "local", "--work-dir", str(tmp_path),

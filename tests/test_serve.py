@@ -349,6 +349,50 @@ def test_preemption_under_page_pressure(setup, sink):
 # Metrics / exporter / fleet report
 # ---------------------------------------------------------------------------
 
+def test_serve_ttft_tpot_histograms(setup, sink):
+    """Request-level latency observability: TTFT (queue admit -> first
+    token, one sample per finished admission) and TPOT (the wall gap
+    between a slot's consecutive tokens) land as registry histograms and
+    export as dt_serve_ttft_ms_* / dt_serve_tpot_ms_* gauges."""
+    from distributedtraining_tpu.utils import obs_http
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=4, page_size=8)
+    try:
+        outs = eng.generate(prompts[:3], GEN)
+        reg = obs.registry()
+        ttft = reg.histogram("serve.ttft_ms")
+        tpot = reg.histogram("serve.tpot_ms")
+        # one TTFT sample per request; TPOT covers every non-first token
+        assert ttft.count == 3
+        assert tpot.count == sum(len(o) for o in outs) - 3
+        assert ttft.percentiles((95.0,))["p95"] >= 0.0
+        text = obs_http.render()
+        assert "dt_serve_ttft_ms_p95" in text
+        assert "dt_serve_tpot_ms_p95" in text
+    finally:
+        eng.close()
+
+
+def test_fleet_report_ttft_tpot_columns(tmp_path):
+    """The serving-latency heartbeat extras reach the fleet table as
+    ttft95/tpot95 columns (scripts/fleet_report.py)."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    import fleet_report
+    path = tmp_path / "monitor.jsonl"
+    path.write_text(json.dumps(
+        {"heartbeat": {"hb": 1, "role": "server", "hotkey": "hk-s",
+                       "seq": 3, "t": 9.0, "tokens_per_sec": 88.5,
+                       "ttft_ms_p95": 41.25, "tpot_ms_p95": 7.5,
+                       "steps": 100.0}}) + "\n")
+    rep = fleet_report.build_report([str(path)])
+    table = fleet_report.format_table(rep)
+    assert "ttft95" in fleet_report.COLUMNS
+    assert "tpot95" in fleet_report.COLUMNS
+    assert "41.2" in table and "7.5" in table
+
+
 def test_serve_metrics_reach_prometheus_exporter(setup, sink):
     from distributedtraining_tpu.utils import obs_http
     model, cfg, params, _, prompts = setup
