@@ -1343,43 +1343,57 @@ def _time_merge(model) -> dict:
     return out
 
 
-def _require_backend(timeout_s: float = 180.0) -> str:
-    """First backend touch with a deadline; returns the live backend name.
+def _require_backend(timeout_s: float = 180.0) -> tuple[str, str | None]:
+    """First backend touch with a deadline; returns ``(backend,
+    degraded_reason)`` — reason None only on a live TPU.
 
     This rig's TPU tunnel can wedge so hard that jax.devices() blocks
-    forever (docs/perf.md). BENCH_r02–r05 all exited rc=3 here — four
-    rounds with no number at all. Now a wedged (or absent) TPU backend
-    DEGRADES instead of aborting: jax is re-pointed at the CPU platform
-    and main() runs the reduced CPU A/B suite (every contrast that is
-    host/dispatch/network time — validator cohorts, push overlap, ingest,
-    heartbeat/remediation overhead — is real on any backend; only the
-    throughput headline is rig-specific). rc=3 remains for the case where
-    even the CPU backend cannot initialize (a poisoned process). The
-    stuck worker thread is daemon — abandoned, exactly like every other
-    wedge-prone call under run_with_timeout."""
+    forever (docs/perf.md). BENCH_r02–r05 all wedged here and surfaced
+    rc=3 with ``value: 0.0`` — four rounds with no number at all and a
+    headline that read as a throughput regression. Now a wedged (or
+    absent) TPU backend DEGRADES instead of aborting: jax is re-pointed
+    at the CPU platform and main() runs the reduced CPU A/B suite
+    (every contrast that is host/dispatch/network time — validator
+    cohorts, push overlap, ingest, heartbeat/remediation overhead — is
+    real on any backend; only the throughput headline is rig-specific).
+    Every record a degraded run emits carries ``degraded_reason`` so
+    downstream consumers can tell "the tunnel was down" from "the code
+    got slower". Even the poisoned-process case (the CPU backend itself
+    cannot initialize) now exits 0: the record says exactly what
+    happened and value 0.0 + degraded_reason is an environment fact,
+    not a bench failure for the driver to page on. The stuck worker
+    thread is daemon — abandoned, exactly like every other wedge-prone
+    call under run_with_timeout."""
     import sys
 
     from distributedtraining_tpu.utils import ChainTimeout, run_with_timeout
 
     try:
         run_with_timeout(jax.devices, timeout_s, name="tpu-backend")
-        return jax.default_backend()
+        backend = jax.default_backend()
+        if backend == "tpu":
+            return backend, None
+        return backend, f"no TPU backend (jax initialized {backend!r})"
     except ChainTimeout:
         print(f"bench: TPU backend unreachable after {timeout_s:.0f}s; "
               "degrading to the CPU A/B suite", file=sys.stderr)
+    reason = (f"TPU backend unreachable after {timeout_s:.0f}s "
+              "(tunnel wedged; see docs/perf.md)")
     try:
         jax.config.update("jax_platforms", "cpu")
         run_with_timeout(jax.devices, 60.0, name="cpu-backend")
-        return "cpu_fallback"
+        return "cpu_fallback", reason
     except Exception:
         print(json.dumps({
             "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
-            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": None,
+            "degraded_reason": reason + " AND the CPU fallback failed "
+                                        "to initialize",
             "error": f"TPU backend unreachable after {timeout_s:.0f}s "
                      "AND the CPU fallback failed to initialize "
                      "(tunnel wedged; see docs/perf.md)"}))
         sys.stdout.flush()
-        sys.exit(3)
+        sys.exit(0)
 
 
 def main() -> None:
@@ -1387,8 +1401,8 @@ def main() -> None:
 
     from distributedtraining_tpu.models import gpt2
 
-    backend = _require_backend()
-    degraded = backend not in ("tpu",)
+    backend, degraded_reason = _require_backend()
+    degraded = degraded_reason is not None
     preset = "gpt2-124m"
     if degraded:
         # CPU A/B suite (ROADMAP item 5, first half): the tiny preset at
@@ -1408,6 +1422,7 @@ def main() -> None:
     extras = {"backend": backend}
     if degraded:
         extras["degraded_cpu"] = True
+        extras["degraded_reason"] = degraded_reason
         extras["bench_model"] = preset
     if not degraded:
         try:

@@ -1,0 +1,1431 @@
+"""Fleet-scale observatory: a deterministic thousand-node fleet simulator.
+
+Every self-healing and serving SLO this repo claims — quarantine
+precision, averager failover, postmortem coverage — was demonstrated on
+<= 32-node tests (tests/test_remediate.py, test_health.py). The paper's
+premise is an OPEN fleet of untrusted, churning miners, where failure is
+the steady state, not the exception; extrapolating a 32-node pass to a
+1000-node claim is exactly the kind of unmeasured scale statement the
+observability planes were built to kill. This module makes fleet-scale
+behavior an *input*: a single-process, seed-deterministic simulator that
+runs hundreds-to-thousands of miner / validator / sub-averager / server
+roles as lightweight cooperative ACTORS over a shared transport hub,
+with chaos (seeded fault rates, partitions, role kills) layered per
+actor through the existing :class:`~..transport.chaos.ChaosTransport`.
+
+What is real and what is simulated, stated plainly:
+
+- **Real**: the transport protocol (every artifact travels as the bytes
+  the production wire carries — msgpack deltas, JSON meta riders,
+  reserved ``__hb__``/``__lease__``/``__agg__``/``__pm__`` ids), the
+  fleet health plane (:class:`~.health.FleetMonitor` + ``SLORule``
+  verbatim), remediation (:class:`~.remediate.RemediationEngine`
+  verbatim), averager failover (:class:`~.remediate.LeaseManager` +
+  :class:`~.remediate.StandbyAverager` verbatim), the flight recorder
+  (:class:`~..utils.flight.FlightRecorder` per actor, bundles published
+  and fetched through the transport), and hostile payloads
+  (utils/loadgen poison modes against the real admission screens).
+- **Simulated**: the model. Miners "train" a small synthetic parameter
+  tree (delta = lr * (target - base) + noise), so a 1000-actor,
+  many-round run completes in CPU-minutes while the *protocol* work —
+  publishes, heartbeat polls, SLO evaluation, quarantine state
+  machines, lease arbitration — is executed at full fidelity and full
+  scale.
+- **Virtual clock**: one :class:`SimClock` shared by every component
+  that accepts a clock (monitors, leases, recorders, chaos latency);
+  each round advances it by ``spec.round_s``. Nothing sleeps; nothing
+  reads the wall clock inside the seeded region, which is what makes
+  same-seed reruns byte-identical.
+
+Threading discipline: the simulator is SINGLE-THREADED by construction
+— every FleetMonitor is built with ``workers=1`` (the ingest pool runs
+inline at that setting) and actors never spawn threads — because the
+seeded ChaosTransport draws one RNG value per gated operation in call
+order, and any concurrency would let the schedule interleave
+differently between runs. Determinism is a test-pinned contract
+(tests/test_fleetsim.py), not an aspiration.
+
+The output of a run is a **scorecard**: one JSON verdict artifact
+(assembled by :func:`assemble_scorecard`, gated by
+:func:`evaluate_gates`, content-addressed by :func:`scorecard_id`)
+asserting rounds completed, merged-base parity against a churn-free
+control run, quarantine precision/recall against the *injected* ground
+truth, postmortem-bundle coverage of every injected kill, bytes on the
+wire per round, and — when the open-loop serving harness
+(utils/loadgen.run_open_loop) contributes load points — the
+ttft/tpot-vs-arrival-rate curve. ``scripts/fleetsim.py`` is the CLI
+that runs the whole observatory and exits nonzero when a gate
+regresses, turning the scale claim into a CI-checkable observation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import math
+import random
+import weakref
+from typing import Any, Sequence
+
+import numpy as np
+
+from .. import serialization as ser
+from .. import signing
+from ..transport.base import (agg_id, encode_delta_meta, heartbeat_id,
+                              lease_id)
+from ..transport.chaos import ChaosError, ChaosSpec, ChaosTransport
+from ..transport.memory import InMemoryTransport
+from ..utils import loadgen, obs
+from ..utils.flight import FlightRecorder, fetch_bundle
+from .health import FleetMonitor, build_heartbeat
+from .remediate import (LeaseManager, RemediationEngine, StandbyAverager,
+                        parse_lease)
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+# live simulators, for the tests/conftest.py hygiene guard (the same
+# weak-set discipline as obs_http.live_exporters / serve.live_frontends):
+# a FleetSim owns FleetMonitors whose ingest pools and ledgers are
+# process machinery the owning test must close()
+_LIVE_SIMS: "weakref.WeakSet[FleetSim]" = weakref.WeakSet()
+
+
+def live_sims() -> list["FleetSim"]:
+    return [s for s in _LIVE_SIMS if not s.closed]
+
+
+# ---------------------------------------------------------------------------
+# Virtual clock
+# ---------------------------------------------------------------------------
+
+class SimClock:
+    """The simulation's shared virtual clock (Clock protocol). ``sleep``
+    ADVANCES it — chaos latency schedules, lease deadlines, and
+    heartbeat ages all move in simulated seconds, so a 1000-actor,
+    many-round run spends zero wall time waiting and two same-seed runs
+    read identical timestamps everywhere."""
+
+    def __init__(self, start: float = 1_600_000_000.0):
+        self._t = float(start)
+
+    def now(self) -> float:
+        return self._t
+
+    def sleep(self, seconds: float) -> None:
+        self._t += max(0.0, float(seconds))
+
+    def advance(self, seconds: float) -> None:
+        self._t += float(seconds)
+
+
+def _derived_seed(seed: int, tag: str, index: int = 0) -> int:
+    """Stable per-(purpose, actor) seed: sha256, NOT Python hash()
+    (which is process-salted and would break cross-process
+    determinism)."""
+    h = hashlib.sha256(f"{seed}:{tag}:{index}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+# ---------------------------------------------------------------------------
+# The shared transport hub
+# ---------------------------------------------------------------------------
+
+class SimHub:
+    """One in-memory artifact store shared by every actor, with two sim
+    responsibilities the per-actor ChaosTransport wrappers cannot cover:
+
+    - **bytes-on-wire accounting**: every publish/fetch payload byte is
+      counted (the scorecard's ``wire`` section; ``sample_round``
+      snapshots the cumulative counters at each round boundary);
+    - **fleet-visible partitions**: a ChaosTransport partition is state
+      on ONE wrapper, but "that miner's repo is down" must be true for
+      every reader — the hub raises :class:`ChaosError` for any
+      operation touching a partitioned node's artifacts (its delta id,
+      its heartbeat, its postmortem slot), from any actor.
+
+    Single-threaded by the simulator's construction, so no locks.
+    """
+
+    def __init__(self):
+        self.inner = InMemoryTransport()
+        self.publish_bytes = 0
+        self.fetch_bytes = 0
+        self.publishes = 0
+        self.fetches = 0
+        self.partition_faults = 0
+        self._partitioned: set[str] = set()
+        self.round_samples: list[dict] = []
+
+    # -- partitions ----------------------------------------------------------
+    @staticmethod
+    def _owner(artifact_id: str) -> str:
+        """The node a reserved id belongs to (``__hb__.miner.m0007`` ->
+        ``m0007``); plain delta ids are their own owner. Sim hotkeys
+        never contain dots, so the last segment is unambiguous."""
+        return artifact_id.rsplit(".", 1)[-1] if "." in artifact_id \
+            else artifact_id
+
+    def partition(self, hotkey: str) -> None:
+        self._partitioned.add(hotkey)
+
+    def heal(self, hotkey: str) -> None:
+        self._partitioned.discard(hotkey)
+
+    def _check(self, artifact_id: str | None) -> None:
+        if artifact_id is not None \
+                and self._owner(artifact_id) in self._partitioned:
+            self.partition_faults += 1
+            raise ChaosError(
+                f"sim[partition]: {artifact_id} is unreachable")
+
+    # -- delta plane ---------------------------------------------------------
+    def publish_delta(self, miner_id: str, delta: Params):
+        return self.publish_raw(miner_id, ser.to_msgpack(delta))
+
+    def publish_raw(self, miner_id: str, data: bytes):
+        self._check(miner_id)
+        self.publishes += 1
+        self.publish_bytes += len(data)
+        return self.inner.publish_raw(miner_id, data)
+
+    def publish_delta_raw(self, miner_id: str, data: bytes):
+        return self.publish_raw(miner_id, data)
+
+    def fetch_delta(self, miner_id: str, template: Params):
+        data = self.fetch_delta_bytes(miner_id)
+        if data is None:
+            return None
+        try:
+            return ser.validated_load(signing.strip_envelope(data),
+                                      template)
+        except ser.PayloadError:
+            return None
+
+    def fetch_delta_bytes(self, miner_id: str):
+        self._check(miner_id)
+        self.fetches += 1
+        data = self.inner.fetch_delta_bytes(miner_id)
+        if data is not None:
+            self.fetch_bytes += len(data)
+        return data
+
+    def delta_revision(self, miner_id: str):
+        self._check(miner_id)
+        return self.inner.delta_revision(miner_id)
+
+    def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
+        self._check(miner_id)
+        self.publishes += 1
+        self.publish_bytes += len(encode_delta_meta(meta))
+        self.inner.publish_delta_meta(miner_id, meta)
+
+    def fetch_delta_meta(self, miner_id: str):
+        self._check(miner_id)
+        self.fetches += 1
+        meta = self.inner.fetch_delta_meta(miner_id)
+        if meta is not None:
+            self.fetch_bytes += len(encode_delta_meta(meta))
+        return meta
+
+    # -- base plane ----------------------------------------------------------
+    def publish_base(self, base: Params):
+        return self.publish_base_raw(ser.to_msgpack(base))
+
+    def publish_base_raw(self, data: bytes):
+        self.publishes += 1
+        self.publish_bytes += len(data)
+        return self.inner.publish_base_raw(data)
+
+    def fetch_base(self, template: Params):
+        self.fetches += 1
+        data = self.inner.fetch_base_bytes()
+        if data is None:
+            return None
+        self.fetch_bytes += len(data)
+        try:
+            tree = ser.validated_load(signing.strip_envelope(data),
+                                      template)
+        except ser.PayloadError:
+            return None
+        return tree, self.inner.base_revision()
+
+    def fetch_base_bytes(self):
+        self.fetches += 1
+        data = self.inner.fetch_base_bytes()
+        if data is not None:
+            self.fetch_bytes += len(data)
+        return data
+
+    def base_revision(self):
+        return self.inner.base_revision()
+
+    def gc(self) -> None:
+        pass
+
+    # -- accounting ----------------------------------------------------------
+    def sample_round(self, round_no: int) -> dict:
+        """Snapshot the cumulative wire counters at a round boundary;
+        the scorecard derives per-round bytes from consecutive
+        samples."""
+        rec = {"round": round_no, "publish_bytes": self.publish_bytes,
+               "fetch_bytes": self.fetch_bytes,
+               "publishes": self.publishes, "fetches": self.fetches,
+               "partition_faults": self.partition_faults}
+        self.round_samples.append(rec)
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# The spec
+# ---------------------------------------------------------------------------
+
+# miner misbehaviors with a ground-truth quarantine expectation, each
+# mapping to exactly one default SLO rule (docs/fleetsim.md):
+#   stale      -> stale_node         (stops heartbeating at fault_round)
+#   divergent  -> loss_divergence    (reports loss far above the median)
+#   pushfail   -> push_failure_streak (reports growing failed pushes)
+# "poison" miners publish hostile payloads (loadgen modes) that the
+# admission screens must DECLINE — they heartbeat healthily and are
+# deliberately NOT quarantine ground truth.
+BEHAVIORS = ("honest", "stale", "divergent", "pushfail", "poison")
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSpec:
+    """Declarative fleet + chaos + fault-injection configuration. Every
+    field participates in the seeded region: two runs with equal specs
+    and seeds produce byte-identical scorecards (modulo the timestamp
+    the CLI stamps outside the region)."""
+    miners: int = 16
+    validators: int = 1
+    servers: int = 1
+    sub_averagers: int = 0          # 0 = flat merge; N = hier fan-in
+    standby: bool = True            # run a standby averager
+    rounds: int = 8
+    seed: int = 0
+    # synthetic training problem (layers x dim float32 tree)
+    layers: int = 4
+    dim: int = 64
+    lr: float = 0.2
+    noise_scale: float = 1e-3
+    max_delta_abs: float = 1e3      # admission screen cap
+    # injected ground truth
+    stale_miners: int = 0
+    divergent_miners: int = 0
+    pushfail_miners: int = 0
+    poison_miners: int = 0
+    kills: int = 0                  # miner/server preemption kills
+    kill_primary_round: int = 0     # 0 = never kill the primary averager
+    partitions_per_round: int = 0
+    partition_rounds: int = 2       # < stale threshold: transient, heals
+    fault_round: int = 2            # round injected behaviors begin
+    # chaos transport (per-actor ChaosTransport over the hub)
+    chaos: bool = True
+    publish_error_rate: float = 0.02
+    fetch_error_rate: float = 0.02
+    latency_s: float = 0.0
+    latency_jitter: float = 0.0
+    # cadence / bookkeeping
+    round_s: float = 30.0
+    failover_deadline_rounds: float = 1.5
+    validator_cohort: int = 32      # miners each validator stages per round
+    registry_max_names: int = 256   # per-actor cardinality cap
+    flight_capacity: int = 64
+
+    def __post_init__(self):
+        if self.miners < 1 or self.rounds < 1:
+            raise ValueError("need >= 1 miner and >= 1 round")
+        if self.validators < 0 or self.servers < 0 or self.sub_averagers < 0:
+            raise ValueError("role counts must be >= 0")
+        bad = (self.stale_miners + self.divergent_miners
+               + self.pushfail_miners + self.poison_miners)
+        if bad > self.miners:
+            raise ValueError(f"{bad} misbehaving miners > {self.miners} "
+                             "miners")
+        if self.kills < 0 or self.kills > self.miners + self.servers:
+            raise ValueError("kills must fit in miners + servers")
+        if self.sub_averagers > self.miners:
+            raise ValueError("more sub-averagers than miners")
+        if self.kill_primary_round < 0 or \
+                self.kill_primary_round > self.rounds:
+            raise ValueError("kill_primary_round outside the run")
+        if self.round_s <= 0:
+            raise ValueError("round_s must be > 0")
+
+    @property
+    def averagers(self) -> int:
+        return 2 if self.standby else 1
+
+    @property
+    def total_actors(self) -> int:
+        return (self.miners + self.validators + self.servers
+                + self.sub_averagers + self.averagers)
+
+    def control(self) -> "FleetSpec":
+        """The churn-free twin: chaos, kills, and partitions OFF,
+        injected *behaviors* (stale/divergent/pushfail/poison miners)
+        KEPT — parity then isolates what churn itself cost, not what
+        the misbehaving minority cost."""
+        return dataclasses.replace(self, chaos=False, kills=0,
+                                   kill_primary_round=0,
+                                   partitions_per_round=0)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FleetSpec":
+        """CLI surface; unknown keys are an error (the ChaosSpec rule: a
+        typo'd fault knob silently injecting nothing defeats the
+        point)."""
+        raw = json.loads(text)
+        if not isinstance(raw, dict):
+            raise ValueError(f"fleet spec must be a JSON object, got "
+                             f"{type(raw).__name__}")
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(raw) - fields
+        if unknown:
+            raise ValueError(f"unknown fleet spec keys {sorted(unknown)}; "
+                             f"expected a subset of {sorted(fields)}")
+        return cls(**raw)
+
+
+# the StagedDelta shape FleetMonitor.record_staging reads (hotkey,
+# revision, delta, reason, wire_bytes) — the simulator's staging
+# decisions feed the REAL contribution ledger through the same record
+@dataclasses.dataclass
+class SimStaged:
+    hotkey: str
+    revision: str | None
+    delta: Any
+    reason: str
+    wire_bytes: int = 0
+
+
+def _zeros_tree(layers: int, dim: int) -> dict:
+    return {f"layer_{i:02d}": np.zeros(dim, np.float32)
+            for i in range(layers)}
+
+
+def _tree_sub(a: dict, b: dict) -> dict:
+    return {k: a[k] - b[k] for k in a}
+
+
+def _screen(tree: dict | None, cap: float) -> str | None:
+    """The simulator's admission screen (the numeric half of
+    delta.screen_deltas): decline reason or None for accept."""
+    if tree is None:
+        return "decode"
+    for leaf in tree.values():
+        arr = np.asarray(leaf)
+        if not np.all(np.isfinite(arr)):
+            return "nonfinite"
+        if arr.size and float(np.max(np.abs(arr))) > cap:
+            return "max_abs"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Actors
+# ---------------------------------------------------------------------------
+
+class Actor:
+    """One simulated role instance: a hotkey, a (possibly chaos-wrapped)
+    view of the hub, a capped per-actor obs Registry, and a flight
+    recorder whose bundles publish through that same transport view."""
+
+    def __init__(self, sim: "FleetSim", role: str, hotkey: str,
+                 index: int):
+        self.sim = sim
+        self.spec = sim.spec
+        self.role = role
+        self.hotkey = hotkey
+        self.index = index
+        self.alive = True
+        self.clock = sim.clock
+        self.role_token = f"{role}.{hotkey}"
+        if self.spec.chaos:
+            self.chaos: ChaosTransport | None = ChaosTransport(
+                sim.hub,
+                ChaosSpec(
+                    publish_error_rate=self.spec.publish_error_rate,
+                    fetch_error_rate=self.spec.fetch_error_rate,
+                    latency_s=self.spec.latency_s,
+                    latency_jitter=self.spec.latency_jitter,
+                    seed=_derived_seed(self.spec.seed, "chaos", index)),
+                role=self.role_token, sleep=sim.clock.sleep)
+            self.transport = self.chaos
+        else:
+            self.chaos = None
+            self.transport = sim.hub
+        self.registry = obs.Registry(
+            max_names=self.spec.registry_max_names)
+        self.flight = FlightRecorder(
+            role, hotkey, capacity=self.spec.flight_capacity,
+            transport=self.transport, clock=sim.clock.now)
+        self.rng = np.random.default_rng(
+            _derived_seed(self.spec.seed, f"rng.{role}", index))
+
+    # -- shared plumbing -----------------------------------------------------
+    def count(self, name: str, n: float = 1.0) -> None:
+        self.registry.counter(name).inc(n)
+
+    def publish_heartbeat(self, **fields) -> None:
+        self.hb_seq = getattr(self, "hb_seq", 0) + 1
+        body = build_heartbeat(self.role, self.hotkey, self.hb_seq,
+                               now=self.clock.now(), **fields)
+        try:
+            self.transport.publish_delta_meta(
+                heartbeat_id(self.role, self.hotkey), body)
+            self.count("sim.beats")
+            self.flight.record("heartbeat", role=self.role,
+                               hotkey=self.hotkey, seq=self.hb_seq,
+                               sent=True)
+        except OSError:
+            self.count("sim.beat_faults")
+
+    def preempt(self, round_no: int) -> bool:
+        """The injected kill: the actor's dying breath is a crash-frozen
+        postmortem bundle published through its OWN (still live)
+        transport — the in-process spelling of a preemption warning:
+        freeze, publish, then the kill switch cuts all I/O. Returns
+        whether the bundle landed (chaos publish faults can eat
+        attempts; the retry budget mirrors transport/retry.py's
+        small-finite discipline)."""
+        self.flight.record("crash", reason="preempted", round=round_no)
+        bundle = self.flight.freeze("preempted")
+        published = False
+        for _ in range(3):
+            if self.flight.publish(bundle):
+                published = True
+                break
+        if self.chaos is not None:
+            self.chaos.kill_role(self.role_token)
+        self.alive = False
+        self.count("sim.preempted")
+        return published
+
+    def step(self, round_no: int) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MinerActor(Actor):
+    """Publishes one synthetic delta + one heartbeat per round, under one
+    of the :data:`BEHAVIORS`. The honest delta pulls the base toward the
+    shared target (classic federated averaging on a toy problem), so the
+    merged base converges and parity against the control run is a
+    meaningful number."""
+
+    def __init__(self, sim: "FleetSim", hotkey: str, index: int,
+                 behavior: str):
+        super().__init__(sim, "miner", hotkey, index)
+        assert behavior in BEHAVIORS
+        self.behavior = behavior
+        self.steps = 0
+        self.pushes = 0
+        self.pushes_failed = 0
+        self.base_view = _zeros_tree(self.spec.layers, self.spec.dim)
+        self._poison_i = 0
+
+    def _pull_base(self) -> None:
+        template = _zeros_tree(self.spec.layers, self.spec.dim)
+        try:
+            got = self.transport.fetch_base(template)
+        except OSError:
+            self.count("sim.base_pull_faults")
+            return
+        if got is not None:
+            self.base_view = got[0]
+            self.count("sim.base_pulls")
+
+    def _delta(self) -> dict:
+        spec = self.spec
+        return {k: (spec.lr * (self.sim.target[k] - self.base_view[k])
+                    + spec.noise_scale
+                    * self.rng.standard_normal(spec.dim)
+                    ).astype(np.float32)
+                for k in self.base_view}
+
+    def _publish_delta(self, faulty: bool) -> None:
+        if self.behavior == "pushfail" and faulty:
+            # the node's publish retries exhaust every round: no fresh
+            # artifact, and the heartbeat truthfully reports the streak
+            self.pushes_failed += 1
+            return
+        try:
+            if self.behavior == "poison" and faulty:
+                self._publish_poison()
+            else:
+                self.transport.publish_delta(self.hotkey, self._delta())
+            self.pushes += 1
+            self.count("sim.pushes")
+        except OSError:
+            self.pushes_failed += 1
+            self.count("sim.push_faults")
+
+    def _publish_poison(self) -> None:
+        """Rotate the tree-level loadgen poison modes plus raw garbage —
+        the hostile-miner surface the admission screens must hold."""
+        modes = ("nan", "huge", "shape", "garbage")
+        mode = modes[self._poison_i % len(modes)]
+        self._poison_i += 1
+        template = _zeros_tree(self.spec.layers, self.spec.dim)
+        if mode == "garbage":
+            raw = bytes(self.rng.integers(0, 256, 128, dtype=np.uint8))
+            self.transport.publish_raw(self.hotkey, raw)
+        else:
+            tree = loadgen.poisoned_delta(template, mode, self.rng,
+                                          scale=self.spec.lr)
+            self.transport.publish_delta(self.hotkey, tree)
+        self.count(f"sim.poison_{mode}")
+
+    def step(self, round_no: int) -> None:
+        if not self.alive:
+            return
+        faulty = round_no >= self.spec.fault_round
+        self._pull_base()
+        self.steps += 50
+        # a gently converging loss curve with per-miner jitter; the
+        # divergent behavior reports a loss far above any plausible
+        # fleet median (x6 with the default loss_divergence factor 1.5)
+        loss = (2.5 * math.exp(-0.15 * round_no)
+                + 0.05 * abs(float(self.rng.standard_normal())))
+        if self.behavior == "divergent" and faulty:
+            loss = loss * 6.0 + 2.0
+        self._publish_delta(faulty)
+        if self.behavior == "stale" and faulty:
+            return  # wedged: no more heartbeats, artifact goes stale
+        self.publish_heartbeat(
+            steps=self.steps,
+            step_rate=50.0 / self.spec.round_s,
+            loss_ema=loss,
+            pushes=self.pushes,
+            pushes_failed=self.pushes_failed,
+            base_revision=self.sim.hub.base_revision())
+
+
+class ServerActor(Actor):
+    """A serving-plane node as the health plane sees it: heartbeats with
+    the ``ttft_ms_p95``/``tpot_ms_p95``/``tokens_per_sec`` extras the
+    real server role publishes (engine/serve.py); the open-loop latency
+    HARNESS drives one real GenerationEngine separately
+    (utils/loadgen.run_open_loop) — a thousand live decode engines in
+    one process would measure the host, not the fleet."""
+
+    def step(self, round_no: int) -> None:
+        if not self.alive:
+            return
+        jitter = float(self.rng.standard_normal())
+        self.publish_heartbeat(
+            steps=float(round_no),
+            step_rate=1.0 / self.spec.round_s,
+            ttft_ms_p95=80.0 + 4.0 * abs(jitter),
+            tpot_ms_p95=9.0 + 0.5 * abs(jitter),
+            tokens_per_sec=900.0 - 20.0 * abs(jitter),
+            queue_depth=float(self.index % 3),
+            base_revision=self.sim.hub.base_revision())
+
+
+class ValidatorActor(Actor):
+    """Runs a real FleetMonitor over the fleet (heartbeat polls + SLO
+    evaluation) and stages a rotating cohort of miner submissions
+    through the real admission screens, feeding the contribution ledger
+    — the read-side load a validator puts on a 1000-node fleet."""
+
+    def __init__(self, sim: "FleetSim", hotkey: str, index: int):
+        super().__init__(sim, "validator", hotkey, index)
+        self.fleet = FleetMonitor(self.transport, workers=1,
+                                  clock=self.clock, metrics=sim.sink)
+        self._seen_rev: dict[str, str | None] = {}
+
+    def _stage_cohort(self, round_no: int) -> list[SimStaged]:
+        spec = self.spec
+        k = min(spec.validator_cohort, spec.miners)
+        hotkeys = self.sim.miner_hotkeys
+        start = (round_no * k + self.index) % len(hotkeys)
+        cohort = [hotkeys[(start + j) % len(hotkeys)] for j in range(k)]
+        template = _zeros_tree(spec.layers, spec.dim)
+        staged = []
+        for h in cohort:
+            staged.append(stage_submission(
+                self.transport, h, template, self._seen_rev,
+                cap=spec.max_delta_abs))
+        return staged
+
+    def step(self, round_no: int) -> None:
+        if not self.alive:
+            return
+        try:
+            self.fleet.poll(self.sim.polled_hotkeys,
+                            roles=("miner", "server"))
+            self.fleet.evaluate_slos()
+            self.fleet.record_staging(self._stage_cohort(round_no))
+            self.count("sim.polls")
+        except OSError:
+            self.count("sim.poll_faults")
+
+    def close(self) -> None:
+        self.fleet.close()
+
+
+def stage_submission(transport, hotkey: str, template: dict,
+                     seen_rev: dict, *, cap: float) -> SimStaged:
+    """One miner submission through the revision-probe -> fetch ->
+    decode -> screen pipeline (the DeltaIngestor decision shape at sim
+    scale): unchanged revisions stage zero wire bytes, hostile payloads
+    decline with the screen's reason, transport faults decline as
+    ``fetch_error`` — all of it landing in the real ledger."""
+    try:
+        rev = transport.delta_revision(hotkey)
+    except OSError:
+        return SimStaged(hotkey, None, None, "fetch_error")
+    if rev is None:
+        return SimStaged(hotkey, None, None, "no_delta")
+    if seen_rev.get(hotkey) == rev:
+        return SimStaged(hotkey, rev, None, "stale")
+    try:
+        data = transport.fetch_delta_bytes(hotkey)
+    except OSError:
+        return SimStaged(hotkey, rev, None, "fetch_error")
+    if data is None:
+        return SimStaged(hotkey, rev, None, "no_delta")
+    try:
+        tree = ser.validated_load(signing.strip_envelope(data), template)
+    except ser.PayloadError:
+        tree = None
+    reason = _screen(tree, cap)
+    seen_rev[hotkey] = rev
+    if reason is not None:
+        return SimStaged(hotkey, rev, None, reason, wire_bytes=len(data))
+    return SimStaged(hotkey, rev, tree, "accepted", wire_bytes=len(data))
+
+
+class SubAveragerActor(Actor):
+    """Tree-aggregation tier: folds its fan-in slice of miners into ONE
+    partial aggregate published as an ordinary delta under the reserved
+    ``__agg__.<node>`` id with the weight-mass meta rider — the
+    engine/hier_average.py wire contract, at actor weight."""
+
+    def __init__(self, sim: "FleetSim", hotkey: str, index: int,
+                 miners: list[str]):
+        super().__init__(sim, "subavg", hotkey, index)
+        self.miners = miners
+        self.node_id = agg_id(hotkey)
+        self._seen_rev: dict[str, str | None] = {}
+
+    def step(self, round_no: int) -> None:
+        if not self.alive:
+            return
+        spec = self.spec
+        template = _zeros_tree(spec.layers, spec.dim)
+        excluded = self.sim.is_excluded
+        accepted = []
+        for h in self.miners:
+            if excluded(h):
+                continue
+            s = stage_submission(self.transport, h, template,
+                                 self._seen_rev, cap=spec.max_delta_abs)
+            if s.delta is not None:
+                accepted.append(s.delta)
+        if not accepted:
+            self.count("sim.empty_agg_rounds")
+            return
+        agg = {k: np.mean([d[k] for d in accepted], axis=0,
+                          dtype=np.float32)
+               for k in template}
+        try:
+            self.transport.publish_delta(self.node_id, agg)
+            self.transport.publish_delta_meta(
+                self.node_id, {"agg": float(len(accepted)),
+                               "node": self.hotkey})
+            self.count("sim.agg_publishes")
+        except OSError:
+            self.count("sim.agg_publish_faults")
+
+
+class AveragerActor(Actor):
+    """The merge root: lease-arbitrated single writer of the base. The
+    primary renews the REAL LeaseManager before every publish; the
+    standby runs the REAL StandbyAverager watch loop (this actor is its
+    ``loop`` — it has ``transport``, ``fleet``, and ``bootstrap``) and
+    takes over publication at the successor epoch when the primary's
+    signals stall. Owns the fleet's RemediationEngine: SLO breaches
+    quarantine miners out of the very ingest set the merge (and every
+    sub-averager) stages from."""
+
+    def __init__(self, sim: "FleetSim", hotkey: str, index: int,
+                 standby: bool):
+        super().__init__(sim, "averager", hotkey, index)
+        spec = sim.spec
+        self.is_standby = standby
+        self.active = not standby
+        self.base = _zeros_tree(spec.layers, spec.dim)
+        self.rounds_completed = 0
+        self.lease = LeaseManager(self.transport, hotkey,
+                                  clock=self.clock)
+        self.fleet = FleetMonitor(self.transport, workers=1,
+                                  clock=self.clock, metrics=sim.sink)
+        self.remediation = RemediationEngine(self.fleet,
+                                             metrics=sim.sink)
+        self.quarantine_actions: list[dict] = []
+        self._seen_rev: dict[str, str | None] = {}
+        self.standby_machine = StandbyAverager(
+            self, self.lease,
+            deadline_s=spec.failover_deadline_rounds * spec.round_s,
+            poll_s=spec.round_s, clock=self.clock) if standby else None
+
+    # -- the StandbyAverager "loop" surface ---------------------------------
+    def bootstrap(self) -> None:
+        """Takeover bootstrap: pull the CURRENT published base (never a
+        local guess). A chaos fault here must not abort the takeover —
+        retry within the small-finite budget, else merge from the last
+        known view (the next successful pull converges it)."""
+        template = _zeros_tree(self.spec.layers, self.spec.dim)
+        for _ in range(3):
+            try:
+                got = self.transport.fetch_base(template)
+            except OSError:
+                continue
+            if got is not None:
+                self.base = got[0]
+                return
+
+    # -- merge ---------------------------------------------------------------
+    def _gather_flat(self) -> list[SimStaged]:
+        template = _zeros_tree(self.spec.layers, self.spec.dim)
+        staged = []
+        for h in self.sim.miner_hotkeys:
+            if self.remediation.is_excluded(h):
+                staged.append(SimStaged(h, None, None, "quarantined"))
+                continue
+            staged.append(stage_submission(
+                self.transport, h, template, self._seen_rev,
+                cap=self.spec.max_delta_abs))
+        return staged
+
+    def _gather_hier(self) -> tuple[list[SimStaged], list, list[float]]:
+        """Stage the sub-averagers' partial aggregates (the root never
+        touches per-miner artifacts in hier mode); returns (staged
+        records, aggregate trees, weight masses)."""
+        template = _zeros_tree(self.spec.layers, self.spec.dim)
+        staged, trees, weights = [], [], []
+        for sub in self.sim.sub_hotkeys:
+            node = agg_id(sub)
+            s = stage_submission(self.transport, node, template,
+                                 self._seen_rev,
+                                 cap=self.spec.max_delta_abs)
+            staged.append(s)
+            if s.delta is None:
+                continue
+            try:
+                meta = self.transport.fetch_delta_meta(node)
+            except OSError:
+                meta = None
+            w = meta.get("agg") if isinstance(meta, dict) else None
+            weights.append(float(w) if isinstance(w, (int, float))
+                           and w > 0 else 1.0)
+            trees.append(s.delta)
+        return staged, trees, weights
+
+    def _merge_and_publish(self) -> None:
+        if self.sim.sub_hotkeys:
+            staged, trees, weights = self._gather_hier()
+        else:
+            staged = self._gather_flat()
+            trees = [s.delta for s in staged if s.delta is not None]
+            weights = [1.0] * len(trees)
+        if trees:
+            total = sum(weights)
+            merged = {k: sum(w * t[k] for w, t in zip(weights, trees))
+                      / total for k in trees[0]}
+            self.base = {k: (self.base[k] + merged[k]).astype(np.float32)
+                         for k in self.base}
+        try:
+            rev = self.transport.publish_base(self.base)
+            self.lease.stamp(rev)
+            self.count("sim.base_publishes")
+        except OSError:
+            self.count("sim.base_publish_faults")
+        self.fleet.record_staging(staged)
+        self.rounds_completed += 1
+
+    def _observe_fleet(self) -> None:
+        try:
+            self.fleet.poll(self.sim.polled_hotkeys,
+                            roles=("miner", "server"))
+        except OSError:
+            self.count("sim.poll_faults")
+        breaches = self.fleet.evaluate_slos()
+        actions = self.remediation.observe_round(breaches)
+        for a in actions:
+            if a.get("remediation") in ("quarantined", "requarantined"):
+                self.quarantine_actions.append(a)
+
+    def step(self, round_no: int) -> None:
+        if not self.alive:
+            return
+        if self.is_standby and not self.active:
+            status = self.standby_machine.poll_once()
+            if status != "takeover":
+                return
+            self.active = True
+            self.count("sim.takeovers")
+            # fall through: the new primary merges THIS round
+        if not self.lease.renew():
+            # superseded (or unreadable token): single-writer discipline
+            # says do not publish; a deposed primary stays passive
+            self.count("sim.lease_standdowns")
+            if self.lease.epoch == 0 and not self.is_standby:
+                self.active = False
+            return
+        self._observe_fleet()
+        self._merge_and_publish()
+
+    def close(self) -> None:
+        self.fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# The simulator
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetResult:
+    """Everything one run contributes to the scorecard."""
+    spec: FleetSpec
+    rounds_completed: int
+    final_base: dict
+    quarantined_ever: list[str]
+    truth_bad: list[str]
+    kills: list[dict]               # {role, hotkey, round, pm_published}
+    pm_fetched: int
+    partitions: list[dict]
+    declines_by_reason: dict[str, int]
+    poison_declines: int
+    registry: dict[str, float]
+    chaos_faults: int
+    chaos_ops: int
+    takeovers: int
+    final_lease_epoch: int
+    wire_samples: list[dict]
+    sim_seconds: float
+
+
+class FleetSim:
+    """Build the fleet from a spec, run ``spec.rounds`` rounds, collect
+    a :class:`FleetResult`. One instance = one run; ``close()`` releases
+    the monitors (the conftest guard force-closes leaked ones)."""
+
+    def __init__(self, spec: FleetSpec, *, sink=None):
+        self.spec = spec
+        self.sink = sink
+        self.clock = SimClock()
+        self.hub = SimHub()
+        self.closed = False
+        rng = random.Random(_derived_seed(spec.seed, "schedule"))
+        self.target = {
+            k: np.asarray(
+                np.random.default_rng(
+                    _derived_seed(spec.seed, "target", i))
+                .standard_normal(spec.dim), np.float32)
+            for i, k in enumerate(sorted(_zeros_tree(spec.layers,
+                                                     spec.dim)))}
+
+        # -- actors ----------------------------------------------------------
+        behaviors = (["stale"] * spec.stale_miners
+                     + ["divergent"] * spec.divergent_miners
+                     + ["pushfail"] * spec.pushfail_miners
+                     + ["poison"] * spec.poison_miners)
+        behaviors += ["honest"] * (spec.miners - len(behaviors))
+        rng.shuffle(behaviors)
+        idx = 0
+        self.miners = []
+        for i in range(spec.miners):
+            self.miners.append(MinerActor(self, f"m{i:04d}", idx,
+                                          behaviors[i]))
+            idx += 1
+        self.servers = []
+        for i in range(spec.servers):
+            self.servers.append(ServerActor(self, "server",
+                                            f"srv{i:03d}", idx))
+            idx += 1
+        self.validators = []
+        for i in range(spec.validators):
+            self.validators.append(ValidatorActor(self, f"val{i:03d}",
+                                                  idx))
+            idx += 1
+        self.sub_hotkeys: list[str] = []
+        self.subs = []
+        if spec.sub_averagers:
+            slices = [self.miner_hotkeys[i::spec.sub_averagers]
+                      for i in range(spec.sub_averagers)]
+            for i, sl in enumerate(slices):
+                hk = f"sub{i:03d}"
+                self.sub_hotkeys.append(hk)
+                self.subs.append(SubAveragerActor(self, hk, idx, sl))
+                idx += 1
+        self.averagers = [AveragerActor(self, "avg0", idx,
+                                        standby=False)]
+        idx += 1
+        if spec.standby:
+            self.averagers.append(AveragerActor(self, "avg1", idx,
+                                                standby=True))
+            idx += 1
+
+        # -- schedules -------------------------------------------------------
+        self._by_hotkey = {a.hotkey: a for a in
+                           self.miners + self.servers}
+        self.kill_schedule: dict[int, list[Actor]] = {}
+        self.kill_log: list[dict] = []
+        killable = ([a for a in self.miners if a.behavior == "honest"]
+                    + self.servers)
+        victims = rng.sample(killable, min(spec.kills, len(killable)))
+        # kill window: early enough that the stale rule (threshold 3
+        # observation rounds) can see the silence AND quarantine before
+        # the run ends — a kill at round r breaches at r+3
+        lo = spec.fault_round + 1
+        hi = max(lo, spec.rounds - 4)
+        for v in victims:
+            r = rng.randint(lo, hi)
+            self.kill_schedule.setdefault(r, []).append(v)
+        if spec.kill_primary_round:
+            self.kill_schedule.setdefault(
+                spec.kill_primary_round, []).append(self.averagers[0])
+        self.partition_schedule: dict[int, list[tuple[str, str]]] = {}
+        self.partition_log: list[dict] = []
+        if spec.partitions_per_round:
+            honest = [a.hotkey for a in self.miners
+                      if a.behavior == "honest"
+                      and a not in victims]
+            for r in range(spec.fault_round,
+                           max(spec.fault_round,
+                               spec.rounds - spec.partition_rounds)):
+                picks = rng.sample(
+                    honest, min(spec.partitions_per_round, len(honest)))
+                for h in picks:
+                    self.partition_schedule.setdefault(r, []).append(
+                        ("partition", h))
+                    self.partition_schedule.setdefault(
+                        r + spec.partition_rounds, []).append(("heal", h))
+        _LIVE_SIMS.add(self)
+
+    # -- lookups actors consult ---------------------------------------------
+    @property
+    def miner_hotkeys(self) -> list[str]:
+        return [a.hotkey for a in self.miners]
+
+    @property
+    def polled_hotkeys(self) -> list[str]:
+        return self.miner_hotkeys + [a.hotkey for a in self.servers]
+
+    def active_averager(self) -> AveragerActor:
+        for a in self.averagers:
+            if a.active and a.alive:
+                return a
+        return self.averagers[0]
+
+    def is_excluded(self, hotkey: str) -> bool:
+        """The shared ingest-exclusion hook sub-averagers consult: the
+        ACTIVE averager's remediation verdicts (ownership follows the
+        lease across a failover, like the production shared-ingest
+        filter does)."""
+        return self.active_averager().remediation.is_excluded(hotkey)
+
+    # -- the run -------------------------------------------------------------
+    def run(self) -> FleetResult:
+        spec = self.spec
+        self.hub.publish_base(_zeros_tree(spec.layers, spec.dim))
+        order: list[Actor] = (self.miners + self.servers
+                              + self.validators + self.subs
+                              + self.averagers)
+        for r in range(1, spec.rounds + 1):
+            for action, hotkey in self.partition_schedule.get(r, ()):
+                # a partition is BIDIRECTIONAL: readers cannot reach the
+                # node's artifacts (hub side) and the node itself cannot
+                # reach the hub (its own chaos kill switch, revived on
+                # heal) — half-open partitions are a different failure
+                # mode than the one this schedule injects
+                victim = self._by_hotkey.get(hotkey)
+                if action == "partition":
+                    self.hub.partition(hotkey)
+                    if victim is not None and victim.chaos is not None:
+                        victim.chaos.kill_role(victim.role_token)
+                    self.partition_log.append({"round": r,
+                                               "hotkey": hotkey})
+                else:
+                    self.hub.heal(hotkey)
+                    if victim is not None and victim.chaos is not None \
+                            and victim.alive:
+                        victim.chaos.revive_role(victim.role_token)
+            for actor in self.kill_schedule.get(r, ()):
+                ok = actor.preempt(r)
+                logger.info("fleetsim: round %d killed %s/%s "
+                            "(postmortem %s)", r, actor.role,
+                            actor.hotkey,
+                            "published" if ok else "LOST")
+                self.kill_log.append({"role": actor.role,
+                                      "hotkey": actor.hotkey,
+                                      "round": r, "pm_published": ok})
+            for actor in order:
+                actor.step(r)
+            self.clock.advance(spec.round_s)
+            self.hub.sample_round(r)
+        return self._collect()
+
+    # -- result assembly -----------------------------------------------------
+    def _truth_bad(self) -> list[str]:
+        """The injected ground truth a perfect detector would
+        quarantine: behavioral misfits (stale/divergent/pushfail) plus
+        miners killed early enough for the stale rule (threshold 3
+        observation rounds) to see the silence before the run ends."""
+        truth = {a.hotkey for a in self.miners
+                 if a.behavior in ("stale", "divergent", "pushfail")}
+        for k in self.kill_log:
+            if k["role"] == "miner" and k["round"] <= self.spec.rounds - 3:
+                truth.add(k["hotkey"])
+        return sorted(truth)
+
+    def _collect(self) -> FleetResult:
+        spec = self.spec
+        quarantined = sorted({a["hotkey"]
+                              for avg in self.averagers
+                              for a in avg.quarantine_actions})
+        pm_fetched = 0
+        for k in self.kill_log:
+            if fetch_bundle(self.hub, k["role"], k["hotkey"]) is not None:
+                pm_fetched += 1
+        declines: dict[str, int] = {}
+        poison_hotkeys = {a.hotkey for a in self.miners
+                          if a.behavior == "poison"}
+        poison_declines = 0
+        # staging verdicts live in every delta-consumer's ledger: the
+        # averagers' (per-miner in flat mode, per-subtree in hier mode)
+        # AND the validators' rotating cohorts — in hier mode the
+        # validators are the only ledger that still sees individual
+        # hostile submissions
+        for owner in self.averagers + self.validators:
+            for node in owner.fleet.nodes.values():
+                if node.declined:
+                    declines[node.last_reason] = declines.get(
+                        node.last_reason, 0) + node.declined
+                if node.hotkey in poison_hotkeys:
+                    poison_declines += node.declined
+        merged = obs.Registry()
+        for actor in (self.miners + self.servers + self.validators
+                      + self.subs + self.averagers):
+            merged.merge(actor.registry)
+        chaos_faults = sum(a.chaos.faults for a in
+                           self.miners + self.servers + self.validators
+                           + self.subs + self.averagers
+                           if a.chaos is not None)
+        chaos_ops = sum(a.chaos.ops for a in
+                        self.miners + self.servers + self.validators
+                        + self.subs + self.averagers
+                        if a.chaos is not None)
+        final_lease = parse_lease(self.hub.fetch_delta_meta(lease_id()))
+        return FleetResult(
+            spec=spec,
+            rounds_completed=sum(a.rounds_completed
+                                 for a in self.averagers),
+            final_base=self.active_averager().base,
+            quarantined_ever=quarantined,
+            truth_bad=self._truth_bad(),
+            kills=list(self.kill_log),
+            pm_fetched=pm_fetched,
+            partitions=list(self.partition_log),
+            declines_by_reason=dict(sorted(declines.items())),
+            poison_declines=poison_declines,
+            registry=merged.snapshot(),
+            chaos_faults=chaos_faults,
+            chaos_ops=chaos_ops,
+            takeovers=sum(1 for a in self.averagers
+                          if a.is_standby and a.active),
+            final_lease_epoch=(final_lease or {}).get("epoch", 0),
+            wire_samples=list(self.hub.round_samples),
+            sim_seconds=self.clock.now() - 1_600_000_000.0)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        for actor in (self.validators + self.averagers):
+            actor.close()
+        _LIVE_SIMS.discard(self)
+
+
+def simulate(spec: FleetSpec, *, sink=None) -> FleetResult:
+    """Run one fleet simulation start to finish and release its
+    machinery (the function tests and the CLI call)."""
+    sim = FleetSim(spec, sink=sink)
+    try:
+        return sim.run()
+    finally:
+        sim.close()
+
+
+# ---------------------------------------------------------------------------
+# Scorecard
+# ---------------------------------------------------------------------------
+
+# default gate thresholds (docs/fleetsim.md documents each; the CLI's
+# --gates JSON overrides individual keys)
+DEFAULT_GATES = {
+    "parity_rel_diff_max": 0.10,
+    "quarantine_precision_min": 0.90,
+    "quarantine_recall_min": 0.90,
+    "pm_coverage_min": 1.0,
+    "serve_min_load_points": 3,
+    "serve_ttft_p99_budget_ms": 400.0,   # at the LOWEST offered rate
+    # baseline-relative regression caps (only applied with --baseline)
+    "baseline_parity_ratio_max": 1.5,
+    "baseline_pr_drop_max": 0.05,
+    "baseline_ttft_p99_ratio_max": 1.25,
+    "baseline_bytes_ratio_max": 1.25,
+}
+
+
+def _rel_diff(a: dict, b: dict) -> float:
+    num = den = 0.0
+    for k in b:
+        x = np.asarray(a[k], np.float64)
+        y = np.asarray(b[k], np.float64)
+        num += float(np.sum((x - y) ** 2))
+        den += float(np.sum(y ** 2))
+    return math.sqrt(num) / max(math.sqrt(den), 1e-12)
+
+
+def _precision_recall(detected: Sequence[str],
+                      truth: Sequence[str]) -> tuple[float, float]:
+    det, tr = set(detected), set(truth)
+    tp = len(det & tr)
+    precision = tp / len(det) if det else 1.0
+    recall = tp / len(tr) if tr else 1.0
+    return precision, recall
+
+
+def chaos_schedule_digest(result: FleetResult) -> str:
+    """Content digest of everything the seed decided about the chaos
+    plan (kills, partitions, rates, seed) — the determinism tests
+    assert same-seed equality and cross-seed difference on this."""
+    body = {
+        "seed": result.spec.seed,
+        "rates": [result.spec.publish_error_rate,
+                  result.spec.fetch_error_rate],
+        "kills": [[k["round"], k["role"], k["hotkey"]]
+                  for k in result.kills],
+        "partitions": [[p["round"], p["hotkey"]]
+                       for p in result.partitions],
+    }
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def assemble_scorecard(result: FleetResult,
+                       control: FleetResult | None = None,
+                       load_points: Sequence[dict] | None = None,
+                       *, gates: dict | None = None) -> dict:
+    """One verdict artifact from a chaos run (+ optional churn-free
+    control and open-loop load points). Everything inside is derived
+    from the seeded region; the caller stamps the wall-clock ``t`` and
+    the content address AFTERWARDS (:func:`finalize_scorecard`), which
+    is what keeps same-seed scorecards byte-identical modulo that one
+    field."""
+    spec = result.spec
+    precision, recall = _precision_recall(result.quarantined_ever,
+                                          result.truth_bad)
+    per_round_bytes = 0.0
+    if result.wire_samples:
+        last = result.wire_samples[-1]
+        per_round_bytes = ((last["publish_bytes"] + last["fetch_bytes"])
+                           / max(1, last["round"]))
+    card: dict[str, Any] = {
+        "fleetsim": 1,
+        "spec": dataclasses.asdict(spec),
+        "actors": spec.total_actors,
+        "sim_seconds": result.sim_seconds,
+        "rounds": {
+            "target": spec.rounds,
+            "completed": result.rounds_completed,
+            "takeovers": result.takeovers,
+            "final_lease_epoch": result.final_lease_epoch,
+        },
+        "quarantine": {
+            "truth": result.truth_bad,
+            "detected": result.quarantined_ever,
+            "precision": round(precision, 4),
+            "recall": round(recall, 4),
+        },
+        "postmortem": {
+            "kills": result.kills,
+            "bundles_fetched": result.pm_fetched,
+            "coverage": (result.pm_fetched / len(result.kills)
+                         if result.kills else 1.0),
+        },
+        "hostile": {
+            "poison_miners": spec.poison_miners,
+            "poison_declines": result.poison_declines,
+            "declines_by_reason": result.declines_by_reason,
+        },
+        "wire": {
+            "samples": result.wire_samples,
+            "bytes_per_round": round(per_round_bytes, 1),
+        },
+        "chaos": {
+            "enabled": spec.chaos,
+            "faults": result.chaos_faults,
+            "ops": result.chaos_ops,
+            "partitions": result.partitions,
+            "schedule_digest": chaos_schedule_digest(result),
+        },
+        "registry": {k: round(float(v), 6)
+                     for k, v in sorted(result.registry.items())},
+    }
+    if control is not None:
+        card["parity"] = {
+            "control_rounds": control.rounds_completed,
+            "rel_diff": round(_rel_diff(result.final_base,
+                                        control.final_base), 6),
+        }
+    if load_points:
+        card["serving"] = {"load_points": list(load_points)}
+    card["gates"] = evaluate_gates(card, gates=gates)
+    card["ok"] = all(g["ok"] for g in card["gates"].values())
+    return card
+
+
+def evaluate_gates(card: dict, *, gates: dict | None = None,
+                   baseline: dict | None = None) -> dict:
+    """Gate verdicts for a scorecard: each returns ``{"ok": bool, ...}``
+    with the numbers that decided it. Sections absent from the run
+    (no control -> no parity gate; no kills -> vacuous coverage) gate
+    vacuously true — the CLI's default spec exercises all of them."""
+    g = dict(DEFAULT_GATES)
+    g.update(gates or {})
+    spec = card["spec"]
+    out: dict[str, dict] = {}
+
+    completed = card["rounds"]["completed"]
+    allowed_miss = (math.ceil(spec["failover_deadline_rounds"]) + 1
+                    if spec["kill_primary_round"] else 0)
+    if spec["chaos"]:
+        # a chaos fault on the lease read/renew legitimately stands the
+        # single writer down for that round (fail-safe by design) — the
+        # gate tolerates a small chaos-proportional number of those
+        allowed_miss += math.ceil(0.15 * spec["rounds"])
+    out["rounds"] = {
+        "ok": completed >= spec["rounds"] - allowed_miss,
+        "completed": completed, "target": spec["rounds"],
+        "allowed_missed": allowed_miss,
+    }
+    if spec["kill_primary_round"]:
+        out["failover"] = {
+            "ok": (card["rounds"]["takeovers"] >= 1
+                   and card["rounds"]["final_lease_epoch"]
+                   == card["rounds"]["takeovers"] + 1),
+            "takeovers": card["rounds"]["takeovers"],
+            "final_lease_epoch": card["rounds"]["final_lease_epoch"],
+        }
+    if "parity" in card:
+        rd = card["parity"]["rel_diff"]
+        out["parity"] = {"ok": rd <= g["parity_rel_diff_max"],
+                         "rel_diff": rd,
+                         "max": g["parity_rel_diff_max"]}
+    q = card["quarantine"]
+    if q["truth"]:
+        out["quarantine"] = {
+            "ok": (q["precision"] >= g["quarantine_precision_min"]
+                   and q["recall"] >= g["quarantine_recall_min"]),
+            "precision": q["precision"], "recall": q["recall"],
+            "precision_min": g["quarantine_precision_min"],
+            "recall_min": g["quarantine_recall_min"],
+        }
+    pm = card["postmortem"]
+    if pm["kills"]:
+        out["postmortem"] = {"ok": pm["coverage"] >= g["pm_coverage_min"],
+                             "coverage": pm["coverage"],
+                             "min": g["pm_coverage_min"]}
+    if spec["poison_miners"]:
+        out["hostile"] = {"ok": card["hostile"]["poison_declines"] > 0,
+                          "poison_declines":
+                              card["hostile"]["poison_declines"]}
+    if "serving" in card:
+        pts = card["serving"]["load_points"]
+        lowest = min(pts, key=lambda p: p["rate_rps"]) if pts else None
+        p99 = (lowest.get("ttft_ms", {}).get("p99", float("inf"))
+               if lowest else float("inf"))
+        out["serving"] = {
+            "ok": (len(pts) >= g["serve_min_load_points"]
+                   and p99 <= g["serve_ttft_p99_budget_ms"]
+                   and (lowest or {}).get("unfinished", 1) == 0),
+            "load_points": len(pts),
+            "min_load_points": g["serve_min_load_points"],
+            "lowest_rate_ttft_p99_ms": p99,
+            "budget_ms": g["serve_ttft_p99_budget_ms"],
+        }
+    if baseline is not None:
+        out["baseline"] = _baseline_gate(card, baseline, g)
+    return out
+
+
+def _baseline_gate(card: dict, baseline: dict, g: dict) -> dict:
+    """Regression vs a prior scorecard: parity may not blow up, P/R may
+    not drop past the slack, the lowest-rate ttft p99 and the per-round
+    wire bytes may not grow past their ratio caps."""
+    problems = []
+
+    def _ratio(cur, prev, cap, label):
+        if prev and prev > 0 and cur / prev > cap:
+            problems.append(f"{label} {cur:.4g} > {cap:g}x baseline "
+                            f"{prev:.4g}")
+
+    if "parity" in card and "parity" in baseline:
+        _ratio(card["parity"]["rel_diff"],
+               max(baseline["parity"]["rel_diff"], 1e-6),
+               g["baseline_parity_ratio_max"], "parity rel_diff")
+    for key in ("precision", "recall"):
+        cur = card["quarantine"][key]
+        prev = baseline.get("quarantine", {}).get(key)
+        if prev is not None and cur < prev - g["baseline_pr_drop_max"]:
+            problems.append(f"quarantine {key} {cur:.3f} < baseline "
+                            f"{prev:.3f} - {g['baseline_pr_drop_max']}")
+    cur_b = card["wire"]["bytes_per_round"]
+    prev_b = baseline.get("wire", {}).get("bytes_per_round")
+    if prev_b:
+        _ratio(cur_b, prev_b, g["baseline_bytes_ratio_max"],
+               "bytes_per_round")
+    cur_pts = {p["rate_rps"]: p
+               for p in card.get("serving", {}).get("load_points", ())}
+    for p in baseline.get("serving", {}).get("load_points", ()):
+        cp = cur_pts.get(p["rate_rps"])
+        if cp is None:
+            continue
+        _ratio(cp.get("ttft_ms", {}).get("p99", 0.0),
+               p.get("ttft_ms", {}).get("p99", 0.0),
+               g["baseline_ttft_p99_ratio_max"],
+               f"ttft p99 @ {p['rate_rps']} rps")
+    return {"ok": not problems, "problems": problems}
+
+
+def scorecard_id(card: dict) -> str:
+    """Content address over the canonical JSON of everything except the
+    wall-clock stamp and the id itself."""
+    body = {k: v for k, v in card.items()
+            if k not in ("t", "scorecard_id")}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True, default=float).encode()
+    ).hexdigest()[:16]
+
+
+def finalize_scorecard(card: dict, *, now: float) -> dict:
+    """Stamp the content address, then the timestamp — ``t`` is the ONE
+    field outside the seeded region, and it is excluded from the id, so
+    two same-seed scorecards differ in exactly that field."""
+    card = dict(card)
+    card.pop("t", None)
+    card["scorecard_id"] = scorecard_id(card)
+    card["t"] = float(now)
+    return card

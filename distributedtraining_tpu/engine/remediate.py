@@ -496,8 +496,10 @@ class StandbyAverager:
     Follows three live signals through the transport it already has: the
     lease token (epoch + renewal timestamp), the primary's
     ``__hb__.averager.<holder>`` heartbeat sequence, and the base
-    revision. Any change resets the stall clock; ``deadline_s`` of
-    silence triggers takeover — acquire the lease at the successor
+    revision. POSITIVE evidence of change (a signal read successfully,
+    with a new value) resets the stall clock — a read fault is "no
+    evidence", never "activity", so a flaky transport cannot starve the
+    takeover; ``deadline_s`` without such evidence triggers takeover — acquire the lease at the successor
     epoch, bootstrap the wrapped loop from the CURRENT published base
     (and, through the PR-5 ledger in its FleetMonitor, the fleet state),
     and run rounds actively. ``poll_once`` is the unit of progress so
@@ -517,7 +519,9 @@ class StandbyAverager:
         self.clock = clock or RealClock()
         self.active = False
         self.takeovers = 0
-        self._last_sig: tuple | None = None
+        # last successfully-read value PER SIGNAL (None until first
+        # read); _progressed mutates elements in place
+        self._last_sig: list | None = None
         self._last_change: float | None = None
 
     # -- observation ---------------------------------------------------------
@@ -551,15 +555,32 @@ class StandbyAverager:
             return 0.0
         return self.clock.now() - self._last_change
 
+    def _progressed(self, sig: tuple) -> bool:
+        """True when ``sig`` carries POSITIVE evidence the primary moved:
+        some element read successfully AND differs from its last
+        successfully-read value. A per-signal read fault degrades that
+        element to None — which is "no evidence", not "activity" — so a
+        flaky transport cannot keep resetting the stall clock and delay
+        a needed takeover indefinitely (the fleetsim chaos runs caught
+        exactly this: failover latency scaled with fetch error rate)."""
+        if self._last_sig is None:
+            self._last_sig = list(sig)
+            return True
+        moved = False
+        for i, v in enumerate(sig):
+            if v is not None and v != self._last_sig[i]:
+                self._last_sig[i] = v
+                moved = True
+        return moved
+
     # -- the state machine ---------------------------------------------------
     def poll_once(self) -> str:
         """One watch step; returns "active" | "following" | "takeover"."""
         if self.active:
             return "active"
         now = self.clock.now()
-        sig = self._signature()
-        if sig != self._last_sig or self._last_change is None:
-            self._last_sig = sig
+        if self._progressed(self._signature()) \
+                or self._last_change is None:
             self._last_change = now
             return "following"
         if now - self._last_change < self.deadline_s:

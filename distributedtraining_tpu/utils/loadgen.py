@@ -23,9 +23,24 @@ from typing import Any, Sequence
 import jax
 import numpy as np
 
-from .identity import Identity
+try:  # identity needs the optional `cryptography` package; the poison
+    # generators and the open-loop serving harness below do not — keep
+    # them importable on minimal containers (engine/fleetsim.py relies
+    # on this), and fail with the real reason only when identities are
+    # actually requested
+    from .identity import Identity
+except ImportError:  # pragma: no cover - environment-dependent
+    Identity = None
 
 logger = logging.getLogger(__name__)
+
+
+def _require_identity():
+    if Identity is None:
+        raise ImportError(
+            "utils.identity needs the optional `cryptography` package; "
+            "install the [identity] extra to generate signing identities")
+    return Identity
 
 POISON_MODES = ("nan", "shape", "huge", "garbage", "forged")
 
@@ -76,7 +91,8 @@ class LoadGenerator:
                  sign: bool = False):
         self.transport = transport
         self.template = template_params
-        self.identities = [Identity.generate() for _ in range(n_miners)]
+        ident = _require_identity()
+        self.identities = [ident.generate() for _ in range(n_miners)]
         self.scale = scale
         self.poison_fraction = poison_fraction
         self.rng = np.random.default_rng(seed)
@@ -169,3 +185,151 @@ class LoadGenerator:
         makes signatures mandatory for these hotkeys in SignedTransport)."""
         for ident in self.identities:
             address_store.store_pubkey(ident.hotkey, ident.public_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Open-loop serving load (the fleetsim observatory's latency harness)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class OpenLoopSpec:
+    """One load point against the serving plane.
+
+    OPEN loop: arrivals follow a seeded Poisson process that does NOT
+    wait for completions — a closed-loop generator (submit, wait,
+    repeat) self-throttles when the server saturates and therefore
+    HIDES queueing collapse; the open-loop curve is the one where p99
+    blows up when offered load crosses capacity (the Gemma-on-TPU
+    serving comparison in PAPERS.md makes exactly this point). Prompt
+    lengths are heavy-tailed (bounded Pareto), because uniform prompts
+    understate paged-KV pressure.
+
+    Latency is accounted in VIRTUAL milliseconds: every
+    ``GenerationEngine.step`` advances the harness clock by ``step_ms``
+    regardless of host speed, so the curve measures the SCHEDULER —
+    admission, continuous batching, page allocation, preemption,
+    queueing — deterministically (same seed, same spec => byte-equal
+    load points), not the CI host's CPU. The real decode path still
+    runs under it (real prefill/decode programs, real paged KV), which
+    is what makes the scheduler's decisions real.
+    """
+    rate_rps: float
+    duration_s: float = 8.0
+    seed: int = 0
+    min_prompt_tokens: int = 4
+    max_prompt_tokens: int = 40
+    tail_alpha: float = 1.6         # Pareto shape; smaller = heavier tail
+    max_new_tokens: int = 16
+    vocab: int = 128
+    step_ms: float = 4.0            # virtual service time per engine step
+    max_steps: int = 50_000         # collapse bound: stop, count unfinished
+
+    def __post_init__(self):
+        if self.rate_rps <= 0 or self.duration_s <= 0:
+            raise ValueError("rate_rps and duration_s must be > 0")
+        if not 1 <= self.min_prompt_tokens <= self.max_prompt_tokens:
+            raise ValueError("need 1 <= min_prompt <= max_prompt")
+        if self.tail_alpha <= 0 or self.step_ms <= 0:
+            raise ValueError("tail_alpha and step_ms must be > 0")
+
+
+def sample_arrivals(spec: OpenLoopSpec) -> list[tuple[float, list[int]]]:
+    """The seeded arrival schedule: (arrival_time_s, prompt_tokens)
+    pairs over ``duration_s``. Exponential inter-arrivals at
+    ``rate_rps``; lengths are bounded Pareto over
+    [min_prompt_tokens, max_prompt_tokens]."""
+    rng = np.random.default_rng(spec.seed)
+    out: list[tuple[float, list[int]]] = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / spec.rate_rps))
+        if t >= spec.duration_s:
+            return out
+        raw = spec.min_prompt_tokens * float(
+            (1.0 - rng.random()) ** (-1.0 / spec.tail_alpha))
+        n = int(min(max(raw, spec.min_prompt_tokens),
+                    spec.max_prompt_tokens))
+        prompt = rng.integers(1, spec.vocab, n).tolist()
+        out.append((t, [int(x) for x in prompt]))
+
+
+def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
+    """Drive one load point through a live GenerationEngine; returns the
+    load-point record the fleetsim scorecard embeds.
+
+    The loop submits every arrival whose (virtual) time has come —
+    whether or not the engine has capacity — then takes one scheduler
+    step and advances the virtual clock by ``step_ms``; when the engine
+    goes idle before the next arrival, the clock jumps to it. TTFT is
+    arrival -> first generated token, TPOT the gap between a request's
+    consecutive tokens, both in virtual ms; ``unfinished`` counts
+    requests still incomplete when the ``max_steps`` collapse bound
+    stops the run — a nonzero value IS the queueing-collapse signal,
+    alongside the exploding p99."""
+    from .obs import percentile
+
+    arrivals = sample_arrivals(spec)
+    now = 0.0
+    i = 0
+    steps = 0
+    tracked: list[dict] = []        # {req, arrival_s, seen, last_emit}
+    ttft_ms: list[float] = []
+    tpot_ms: list[float] = []
+
+    def _submit_due() -> None:
+        nonlocal i
+        while i < len(arrivals) and arrivals[i][0] <= now:
+            t_arr, prompt = arrivals[i]
+            i += 1
+            req = engine.submit(prompt, spec.max_new_tokens)
+            tracked.append({"req": req, "arrival_s": t_arr,
+                            "seen": 0, "last_emit": None})
+
+    def _account() -> None:
+        for rec in tracked:
+            n = len(rec["req"].tokens)
+            if n <= rec["seen"]:
+                continue
+            for _ in range(n - rec["seen"]):
+                if rec["last_emit"] is None:
+                    ttft_ms.append((now - rec["arrival_s"]) * 1e3)
+                else:
+                    tpot_ms.append((now - rec["last_emit"]) * 1e3)
+                rec["last_emit"] = now
+            rec["seen"] = n
+
+    while (i < len(arrivals) or not engine.idle) \
+            and steps < spec.max_steps:
+        if engine.idle and i < len(arrivals):
+            now = max(now, arrivals[i][0])   # park until the next arrival
+            _submit_due()
+            continue
+        _submit_due()
+        engine.step()
+        steps += 1
+        now += spec.step_ms / 1e3
+        _account()
+
+    completed = sum(1 for r in tracked if r["req"].done_evt.is_set())
+    unfinished = len(tracked) - completed
+
+    def _pcts(vals: list[float]) -> dict:
+        s = sorted(vals)
+        return {"p50": round(percentile(s, 50.0), 3),
+                "p95": round(percentile(s, 95.0), 3),
+                "p99": round(percentile(s, 99.0), 3)}
+
+    return {
+        "rate_rps": spec.rate_rps,
+        "duration_s": spec.duration_s,
+        "offered": len(arrivals),
+        "completed": completed,
+        "unfinished": unfinished,
+        "steps": steps,
+        "virtual_s": round(now, 4),
+        "tokens": int(sum(r["seen"] for r in tracked)),
+        "ttft_ms": _pcts(ttft_ms) if ttft_ms else
+        {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
+        "tpot_ms": _pcts(tpot_ms) if tpot_ms else
+        {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
+    }

@@ -91,6 +91,10 @@ class Counter:
     def value(self) -> float:
         return self._value
 
+    def merge_from(self, other: "Counter") -> None:
+        """Fold another counter's total into this one (Registry.merge)."""
+        self.inc(other.value)
+
 
 class Gauge:
     """Last-value-wins gauge (thread-safe) — point-in-time levels the
@@ -112,6 +116,12 @@ class Gauge:
     @property
     def value(self) -> float:
         return self._value
+
+    def merge_from(self, other: "Gauge") -> None:
+        """Last-merged-wins, matching the instrument's own semantics: the
+        most recently merged registry's level is the one that survives
+        (Registry.merge documents the ordering contract)."""
+        self.set(other.value)
 
 
 def percentile(sorted_vals, q: float) -> float:
@@ -176,6 +186,19 @@ class Histogram:
             out.update(self.percentiles())
         return out
 
+    def merge_from(self, other: "Histogram") -> None:
+        """Fold another histogram in: lifetime count/sum add, and the
+        other ring's observations extend this ring (still bounded by THIS
+        ring's capacity — merging many actors keeps the newest tail, the
+        same recency rule a single ring lives by)."""
+        with other._lock:
+            vals = list(other._ring)
+            count, total = other._count, other._total
+        with self._lock:
+            self._ring.extend(vals)
+            self._count += count
+            self._total += total
+
 
 class Registry:
     """Named counters + histograms; get-or-create, kind-checked.
@@ -183,22 +206,65 @@ class Registry:
     One name is ONE instrument: registering ``x`` as a counter after it
     exists as a histogram (or vice versa) raises — the duplicate-
     registration lint, so two call sites cannot silently split a metric
-    into two series."""
+    into two series.
 
-    def __init__(self):
+    ``max_names`` caps the metric-name CARDINALITY: once the registry
+    holds that many distinct names, a request for a NEW name logs one
+    warning, bumps ``dropped_names``, and returns a detached instrument
+    (fully usable, never snapshotted) — callers keep working, the
+    registry stays bounded. A 1000-actor fleet simulation
+    (engine/fleetsim.py) hands every actor its own capped Registry, so
+    one noisy actor cannot grow the process's metric vocabulary without
+    bound. None (the default) keeps the historical unbounded behavior.
+
+    ``merge(other)`` folds another registry in — counters add, gauges
+    are last-merged-wins, histogram rings concatenate (bounded by the
+    receiving ring's capacity) — which is how the fleet simulator
+    assembles one scorecard registry from hundreds of per-actor ones.
+    A kind mismatch between same-named instruments raises, the same
+    duplicate-registration lint as ``_get``."""
+
+    def __init__(self, *, max_names: int | None = None):
+        if max_names is not None and max_names < 1:
+            raise ValueError(f"max_names must be >= 1, got {max_names}")
         self._lock = threading.Lock()
         self._metrics: dict[str, Any] = {}
+        self.max_names = max_names
+        self.dropped_names = 0
+        self._warned_cap = False
 
     def _get(self, name: str, kind) -> Any:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
+                if self.max_names is not None \
+                        and len(self._metrics) >= self.max_names:
+                    self.dropped_names += 1
+                    if not self._warned_cap:
+                        self._warned_cap = True
+                        logger.warning(
+                            "registry at its %d-name cardinality cap; "
+                            "dropping new metric %r (and any further new "
+                            "names, counted in dropped_names)",
+                            self.max_names, name)
+                    return kind(name)  # detached: usable, never snapshotted
                 m = self._metrics[name] = kind(name)
             elif not isinstance(m, kind):
                 raise ValueError(
                     f"metric {name!r} already registered as "
                     f"{type(m).__name__}, not {kind.__name__}")
             return m
+
+    def merge(self, other: "Registry") -> "Registry":
+        """Fold ``other``'s instruments into this registry (see class
+        docstring for per-kind semantics); returns self so scorecard
+        assembly can chain ``reduce``-style. Names past this registry's
+        cap are dropped-and-counted like any other new name."""
+        with other._lock:
+            items = list(other._metrics.items())
+        for name, m in items:
+            self._get(name, type(m)).merge_from(m)
+        return self
 
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
@@ -212,6 +278,14 @@ class Registry:
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._metrics)
+
+    def peek(self, name: str) -> Any | None:
+        """The registered instrument under ``name`` (or None) WITHOUT
+        creating one — consumers that render a specific instrument's
+        richer view (the exporter's labeled quantile gauges) must never
+        mint empty series as a side effect of looking."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def digest(self) -> str:
         """Short stable digest of the registered metric VOCABULARY (names,

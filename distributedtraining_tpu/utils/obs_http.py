@@ -97,6 +97,16 @@ _FLEET_SERIES = (
 )
 
 
+# serving histograms additionally exported as ONE labeled gauge family
+# per name — dt_serve_ttft_ms{q="0.5|0.95|0.99"} — so a Prometheus/
+# Grafana latency panel selects quantiles by label instead of stitching
+# the flattened _p50/_p95/_p99 names (only heartbeat p95s were visible
+# that way before); the flattened spellings keep rendering for
+# compatibility with existing dashboards
+_QUANTILE_HISTS = ("serve.ttft_ms", "serve.tpot_ms")
+_QUANTILE_LABELS = ((50.0, "0.5"), (95.0, "0.95"), (99.0, "0.99"))
+
+
 def render(registry=None, fleet=None) -> str:
     """The exposition body — separable from the server for tests and for
     one-shot dumps."""
@@ -107,6 +117,18 @@ def render(registry=None, fleet=None) -> str:
         pn = prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {_prom_value(snap[name])}")
+    peek = getattr(reg, "peek", None)
+    for name in (_QUANTILE_HISTS if peek is not None else ()):
+        hist = peek(name)
+        if hist is None or not hasattr(hist, "percentiles") \
+                or not hist.count:
+            continue
+        ps = hist.percentiles(tuple(q for q, _ in _QUANTILE_LABELS))
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        for q, label in _QUANTILE_LABELS:
+            lines.append(
+                f'{pn}{{q="{label}"}} {_prom_value(ps[f"p{int(q)}"])}')
     if fleet is not None:
         try:
             ledger = fleet.ledger()

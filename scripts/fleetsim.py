@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Fleet-scale observatory CLI: run the deterministic fleet simulator
+plus the open-loop serving harness and emit one regression-gated SLO
+scorecard.
+
+Three phases, all seeded, all on one process and one CPU:
+
+1. **chaos run** — ``--actors`` miner/validator/sub-averager/server
+   actors over a shared hub with per-actor ChaosTransport fault rates,
+   transient partitions, preemption kills, and (by default) a primary-
+   averager kill that forces a standby failover
+   (engine/fleetsim.py);
+2. **control run** — the same spec with chaos/kills/partitions off
+   (injected *behaviors* kept), for the merged-base parity number;
+3. **open-loop load** — Poisson arrivals with heavy-tailed prompt
+   lengths against a real GenerationEngine at ``--rates`` offered
+   rates (utils/loadgen.run_open_loop), producing the
+   ttft/tpot-vs-rate curve.
+
+The scorecard (one JSON object, content-addressed modulo its wall-clock
+stamp) asserts: rounds completed, base parity vs control, quarantine
+precision/recall against the injected ground truth, postmortem-bundle
+coverage of every injected kill, bytes-on-wire per round, and the
+latency curve. Exit status is the verdict: 0 when every gate holds,
+1 when any gate (or the optional ``--baseline`` regression check)
+fails — CI can gate merges on fleet-scale behavior.
+
+Usage:
+    python scripts/fleetsim.py                        # 1000-actor default
+    python scripts/fleetsim.py --actors 24 --rounds 3 # smoke
+    python scripts/fleetsim.py --out FLEETSIM.json --baseline prev.json
+    python scripts/fleetsim.py --spec '{"miners": 64, "rounds": 6}'
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+DEFAULT_RATES = (8.0, 24.0, 72.0)
+
+
+def build_spec(args) -> "FleetSpec":
+    from distributedtraining_tpu.engine.fleetsim import FleetSpec
+
+    if args.spec:
+        spec = FleetSpec.from_json(args.spec)
+        if args.seed is not None:
+            spec = dataclasses.replace(spec, seed=args.seed)
+        return spec
+    # --actors N distributes roles the way a real fleet skews: almost
+    # everything is a miner; a handful of validators/servers/sub-
+    # averagers; one primary + one standby averager
+    n = args.actors
+    validators = max(1, n // 250)
+    servers = max(1, n // 125)
+    subs = max(0, n // 60) if n >= 120 else 0
+    miners = n - validators - servers - subs - 2
+    if miners < 1:
+        raise SystemExit(f"--actors {n} too small to field a fleet")
+    bad = max(0, miners // 40)       # 2.5% of miners per misbehavior
+    spec = FleetSpec(
+        miners=miners, validators=validators, servers=servers,
+        sub_averagers=subs, rounds=args.rounds,
+        seed=args.seed if args.seed is not None else 0,
+        stale_miners=bad, divergent_miners=bad, pushfail_miners=bad,
+        poison_miners=bad,
+        kills=max(0, miners // 80) if args.rounds >= 8 else 0,
+        kill_primary_round=(args.rounds // 2
+                            if args.failover and args.rounds >= 8 else 0),
+        partitions_per_round=max(0, miners // 250),
+        chaos=not args.no_chaos)
+    return spec
+
+
+def run_load_phase(rates, *, seed: int, duration_s: float) -> list[dict]:
+    """The open-loop latency curve: one real GenerationEngine per rate
+    (a fresh engine per point keeps the points independent — no warm
+    queue bleeding between rates)."""
+    import jax
+
+    from distributedtraining_tpu.engine.serve import GenerationEngine
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.utils import loadgen
+
+    cfg = gpt2.GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                          n_head=2, n_layer=2)
+    model, cfg = gpt2.make_model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    points = []
+    for rate in rates:
+        engine = GenerationEngine(model, params, max_slots=4, page_size=8)
+        try:
+            points.append(loadgen.run_open_loop(
+                engine, loadgen.OpenLoopSpec(rate_rps=float(rate),
+                                             duration_s=duration_s,
+                                             seed=seed)))
+        finally:
+            engine.close()
+        p = points[-1]
+        print(f"  load {rate:g} rps: offered {p['offered']} "
+              f"completed {p['completed']} unfinished {p['unfinished']} "
+              f"ttft p99 {p['ttft_ms']['p99']:.1f}ms", file=sys.stderr)
+    return points
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--actors", type=int, default=1000,
+                    help="total actor count (default 1000)")
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--spec", help="full FleetSpec JSON (overrides "
+                                   "--actors/--rounds role math)")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="run without transport chaos (behaviors kept)")
+    ap.add_argument("--no-control", action="store_true",
+                    help="skip the churn-free control run (no parity "
+                         "gate)")
+    ap.add_argument("--no-serve", action="store_true",
+                    help="skip the open-loop serving phase")
+    ap.add_argument("--no-failover", dest="failover",
+                    action="store_false",
+                    help="do not kill the primary averager")
+    ap.add_argument("--rates", default=",".join(str(r) for r in
+                                                DEFAULT_RATES),
+                    help="comma-separated offered request rates (rps)")
+    ap.add_argument("--load-duration", type=float, default=6.0,
+                    help="virtual seconds of arrivals per load point")
+    ap.add_argument("--out", default="FLEETSIM.json",
+                    help="scorecard output path")
+    ap.add_argument("--baseline",
+                    help="prior scorecard JSON for regression gating")
+    ap.add_argument("--gates", help="JSON overriding individual gate "
+                                    "thresholds (fleetsim.DEFAULT_GATES)")
+    ap.add_argument("--metrics", help="JSONL sink path for the obs "
+                                      "exhaust (spans, breaches, ledger)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO if args.verbose
+                        else logging.ERROR)
+
+    from distributedtraining_tpu.engine import fleetsim as fs
+    from distributedtraining_tpu.utils import obs
+    from distributedtraining_tpu.utils.metrics import JSONLSink
+
+    spec = build_spec(args)
+    gates = json.loads(args.gates) if args.gates else None
+    sink = JSONLSink(args.metrics) if args.metrics else None
+    if sink is not None:
+        obs.configure(sink, role="fleetsim")
+
+    try:
+        print(f"fleetsim: {spec.total_actors} actors "
+              f"({spec.miners} miners, {spec.validators} validators, "
+              f"{spec.sub_averagers} sub-averagers, {spec.servers} "
+              f"servers, {spec.averagers} averagers), "
+              f"{spec.rounds} rounds, seed {spec.seed}, "
+              f"chaos={'on' if spec.chaos else 'off'}", file=sys.stderr)
+        t0 = time.time()
+        result = fs.simulate(spec, sink=sink)
+        print(f"fleetsim: chaos run done in {time.time() - t0:.1f}s "
+              f"({result.rounds_completed}/{spec.rounds} rounds, "
+              f"{result.chaos_faults} injected faults)", file=sys.stderr)
+
+        control = None
+        if not args.no_control:
+            t1 = time.time()
+            control = fs.simulate(spec.control(), sink=sink)
+            print(f"fleetsim: control run done in {time.time() - t1:.1f}s",
+                  file=sys.stderr)
+
+        load_points = None
+        if not args.no_serve:
+            rates = [float(r) for r in args.rates.split(",") if r]
+            print(f"fleetsim: open-loop serving at {rates} rps",
+                  file=sys.stderr)
+            load_points = run_load_phase(rates, seed=spec.seed,
+                                         duration_s=args.load_duration)
+
+        card = fs.assemble_scorecard(result, control, load_points,
+                                     gates=gates)
+        if args.baseline:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+            card["gates"] = fs.evaluate_gates(card, gates=gates,
+                                              baseline=baseline)
+            card["ok"] = all(g["ok"] for g in card["gates"].values())
+            card["baseline_scorecard_id"] = baseline.get("scorecard_id")
+    finally:
+        obs.reset()
+
+    # the wall-clock stamp is the ONE field outside the seeded region
+    card = fs.finalize_scorecard(card, now=time.time())
+    with open(args.out, "w") as f:
+        json.dump(card, f, sort_keys=True, indent=1)
+        f.write("\n")
+
+    print(f"fleetsim: scorecard {card['scorecard_id']} -> {args.out}",
+          file=sys.stderr)
+    for name, g in sorted(card["gates"].items()):
+        detail = {k: v for k, v in g.items() if k != "ok"}
+        print(f"  gate {name:<12} {'PASS' if g['ok'] else 'FAIL'}  "
+              f"{json.dumps(detail, default=float)}", file=sys.stderr)
+    if not card["ok"]:
+        print("fleetsim: GATE FAILURE", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -251,6 +251,33 @@ def _no_leaked_flight_state():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _no_leaked_fleetsim():
+    """Fleet-simulator hygiene (engine/fleetsim.py): a FleetSim owns
+    FleetMonitors (ingest pools + ledgers) for every validator and
+    averager actor — process machinery the owning test must release via
+    FleetSim.close() (fleetsim.simulate() does it for you). The
+    simulator is deliberately thread-free (workers=1 pools run inline),
+    so the check is the live-instance registry plus a sweep for any
+    stray ``fleetsim-`` thread a future refactor might introduce.
+    Force-clean so one offender cannot cascade, then fail the module."""
+    import threading
+
+    yield
+    from distributedtraining_tpu.engine import fleetsim
+
+    live = fleetsim.live_sims()
+    for sim in live:
+        sim.close()
+    leaked_threads = [t for t in threading.enumerate()
+                      if t.is_alive() and t.name.startswith("fleetsim")]
+    assert not live, (
+        f"test module left fleet simulators open: {live}; call "
+        "FleetSim.close() (or use fleetsim.simulate()) in teardown")
+    assert not leaked_threads, (
+        f"test module left fleetsim threads alive: {leaked_threads}")
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _no_leaked_obs_state():
     """Observability hygiene (mirrors the thread-leak guard above): the
     span/metric layer (utils/obs.py) is PROCESS-WIDE state — a test that

@@ -1,0 +1,347 @@
+"""Fleet-scale observatory (engine/fleetsim.py + the open-loop serving
+harness in utils/loadgen.py).
+
+The simulator's whole value is that its verdicts are trustworthy at a
+scale CI cannot field for real, so the pins here are about the
+CONTRACTS: seed-determinism (byte-identical scorecards), injected
+ground truth vs detected quarantines, postmortem coverage of injected
+kills, lease-epoch monotonicity across a forced failover, hier-vs-flat
+parity, and the open-loop latency curve exposing queueing collapse that
+a closed loop would hide. The 1000-actor acceptance run itself is
+``-m slow``; tier-1 exercises the same machinery at ~24 actors.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from distributedtraining_tpu.engine import fleetsim as fs
+from distributedtraining_tpu.transport.chaos import ChaosError
+from distributedtraining_tpu.utils import loadgen
+
+
+def smoke_spec(**over) -> fs.FleetSpec:
+    """~24 actors, small rounds: the tier-1 scale."""
+    base = dict(miners=18, validators=2, servers=2, sub_averagers=0,
+                standby=True, rounds=4, seed=0, validator_cohort=8)
+    base.update(over)
+    return fs.FleetSpec(**base)
+
+
+# ---------------------------------------------------------------------------
+# The hub
+# ---------------------------------------------------------------------------
+
+def test_hub_counts_bytes_and_partitions_bidirectionally():
+    hub = fs.SimHub()
+    hub.publish_raw("m1", b"x" * 100)
+    hub.publish_delta_meta("__hb__.miner.m1", {"hb": 1})
+    assert hub.publishes == 2 and hub.publish_bytes > 100
+    assert hub.fetch_delta_bytes("m1") == b"x" * 100
+    assert hub.fetch_bytes == 100
+    hub.partition("m1")
+    # the node's own artifact id AND its reserved ids are unreachable
+    with pytest.raises(ChaosError):
+        hub.publish_raw("m1", b"y")
+    with pytest.raises(ChaosError):
+        hub.fetch_delta_meta("__hb__.miner.m1")
+    hub.heal("m1")
+    assert hub.fetch_delta_bytes("m1") == b"x" * 100
+    assert hub.partition_faults == 2
+
+
+def test_spec_validation_and_control_twin():
+    with pytest.raises(ValueError):
+        fs.FleetSpec(miners=4, stale_miners=5)
+    with pytest.raises(ValueError):
+        fs.FleetSpec.from_json('{"minerz": 3}')
+    spec = smoke_spec(kills=2, rounds=8, partitions_per_round=1,
+                      stale_miners=2)
+    ctrl = spec.control()
+    assert not ctrl.chaos and ctrl.kills == 0 \
+        and ctrl.partitions_per_round == 0
+    # behavioral injections survive into the control twin
+    assert ctrl.stale_miners == 2
+    rt = fs.FleetSpec.from_json(json.dumps(dataclasses.asdict(spec)))
+    assert rt == spec
+
+
+# ---------------------------------------------------------------------------
+# Smoke: the tier-1 scale run
+# ---------------------------------------------------------------------------
+
+def test_smoke_round_trip_and_scorecard_shape():
+    spec = smoke_spec(rounds=3)
+    res = fs.simulate(spec)
+    ctrl = fs.simulate(spec.control())
+    card = fs.assemble_scorecard(res, ctrl)
+    assert card["actors"] == spec.total_actors == 24
+    assert card["rounds"]["completed"] >= spec.rounds - 1
+    assert len(card["wire"]["samples"]) == spec.rounds
+    assert card["wire"]["bytes_per_round"] > 0
+    # merged per-actor registries reached the scorecard
+    assert card["registry"].get("sim.pushes", 0) > 0
+    assert card["registry"].get("sim.beats", 0) > 0
+    assert "parity" in card and card["parity"]["rel_diff"] >= 0.0
+    assert card["gates"]["rounds"]["ok"]
+    # finalize stamps the id and the ONE out-of-region field
+    final = fs.finalize_scorecard(card, now=123.0)
+    assert final["t"] == 123.0
+    assert final["scorecard_id"] == fs.scorecard_id(final)
+
+
+def test_simulate_leaves_no_live_sims_or_obs_state():
+    from distributedtraining_tpu.utils import obs
+
+    fs.simulate(smoke_spec(rounds=2))
+    assert fs.live_sims() == []
+    assert not obs.dirty()   # the sim never configures the global layer
+
+
+# ---------------------------------------------------------------------------
+# Determinism (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_same_seed_scorecards_byte_identical_modulo_timestamp():
+    spec = smoke_spec(rounds=4, stale_miners=1, poison_miners=1,
+                      kills=1, partitions_per_round=1, seed=7)
+    a = fs.finalize_scorecard(
+        fs.assemble_scorecard(fs.simulate(spec),
+                              fs.simulate(spec.control())), now=1.0)
+    b = fs.finalize_scorecard(
+        fs.assemble_scorecard(fs.simulate(spec),
+                              fs.simulate(spec.control())), now=2.0)
+    assert a["t"] != b["t"]
+    assert a["scorecard_id"] == b["scorecard_id"]
+    a.pop("t"), b.pop("t")
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_seed_changes_chaos_schedule():
+    spec = smoke_spec(rounds=4, kills=2, partitions_per_round=1, seed=1)
+    r1 = fs.simulate(spec)
+    r2 = fs.simulate(dataclasses.replace(spec, seed=2))
+    assert fs.chaos_schedule_digest(r1) != fs.chaos_schedule_digest(r2)
+    # different draws, different outcomes — not just a relabeled digest
+    assert (r1.kills, r1.partitions) != (r2.kills, r2.partitions)
+
+
+# ---------------------------------------------------------------------------
+# Quarantine precision / recall vs injected ground truth
+# ---------------------------------------------------------------------------
+
+def test_quarantine_detects_injected_misbehavior():
+    spec = smoke_spec(miners=20, rounds=9, stale_miners=2,
+                      divergent_miners=2, pushfail_miners=2,
+                      poison_miners=2)
+    res = fs.simulate(spec)
+    assert len(res.truth_bad) == 6   # poison is NOT quarantine truth
+    card = fs.assemble_scorecard(res)
+    q = card["gates"]["quarantine"]
+    assert q["ok"], q
+    assert q["precision"] >= 0.9 and q["recall"] >= 0.9
+    # hostile payloads were DECLINED by the admission screens instead
+    assert card["hostile"]["poison_declines"] > 0
+    assert card["gates"]["hostile"]["ok"]
+
+
+def test_transient_partition_is_not_quarantined():
+    """A 2-round partition of an honest miner heals before the stale
+    threshold (3 observation rounds): correct fleets do not quarantine
+    weather."""
+    spec = smoke_spec(miners=16, rounds=8, partitions_per_round=1,
+                      publish_error_rate=0.0, fetch_error_rate=0.0)
+    res = fs.simulate(spec)
+    assert res.partitions                 # the schedule actually fired
+    assert res.quarantined_ever == []     # and nobody got quarantined
+
+
+# ---------------------------------------------------------------------------
+# Kills: postmortem coverage + averager failover
+# ---------------------------------------------------------------------------
+
+def test_every_injected_kill_leaves_a_fetchable_bundle():
+    spec = smoke_spec(miners=20, rounds=9, kills=3)
+    res = fs.simulate(spec)
+    assert len(res.kills) == 3
+    assert res.pm_fetched == 3
+    card = fs.assemble_scorecard(res)
+    assert card["gates"]["postmortem"]["ok"]
+    assert card["postmortem"]["coverage"] == 1.0
+    # killed miners become quarantine ground truth (stale rule)
+    killed = {k["hotkey"] for k in res.kills if k["role"] == "miner"}
+    assert killed <= set(res.truth_bad)
+    assert killed <= set(res.quarantined_ever)
+
+
+def test_primary_kill_forces_standby_takeover_with_monotone_epoch():
+    spec = smoke_spec(miners=16, rounds=9, kill_primary_round=4)
+    res = fs.simulate(spec)
+    assert res.takeovers == 1
+    assert res.final_lease_epoch == 2     # epoch 1 primary, 2 standby
+    # the fleet kept merging: at most the failover window was lost
+    assert res.rounds_completed >= spec.rounds - 3
+    card = fs.assemble_scorecard(res)
+    assert card["gates"]["failover"]["ok"]
+    assert card["gates"]["rounds"]["ok"]
+    # the dead primary's own crash bundle is fetchable too
+    assert any(k["role"] == "averager" for k in res.kills)
+    assert card["postmortem"]["coverage"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Hierarchy
+# ---------------------------------------------------------------------------
+
+def test_hier_merge_matches_flat_within_tolerance():
+    spec = smoke_spec(miners=16, rounds=6, sub_averagers=4,
+                      publish_error_rate=0.0, fetch_error_rate=0.0,
+                      chaos=False)
+    flat = dataclasses.replace(spec, sub_averagers=0)
+    r_hier = fs.simulate(spec)
+    r_flat = fs.simulate(flat)
+    assert fs._rel_diff(r_hier.final_base, r_flat.final_base) < 1e-5
+    # the root staged __agg__ ids, not per-miner artifacts
+    card = fs.assemble_scorecard(r_hier)
+    assert card["rounds"]["completed"] == spec.rounds
+
+
+# ---------------------------------------------------------------------------
+# Gate evaluation + baseline regression
+# ---------------------------------------------------------------------------
+
+def test_gates_fail_on_regressed_numbers():
+    spec = smoke_spec(rounds=4, stale_miners=2)
+    card = fs.assemble_scorecard(fs.simulate(spec))
+    bad = json.loads(json.dumps(card))
+    bad["quarantine"]["precision"] = 0.5
+    gates = fs.evaluate_gates(bad)
+    assert not gates["quarantine"]["ok"]
+    bad2 = json.loads(json.dumps(card))
+    bad2["rounds"]["completed"] = 0
+    assert not fs.evaluate_gates(bad2)["rounds"]["ok"]
+
+
+def test_baseline_regression_gate():
+    spec = smoke_spec(rounds=4, stale_miners=2)
+    card = fs.assemble_scorecard(fs.simulate(spec),
+                                 fs.simulate(spec.control()))
+    # identical baseline: no regression
+    ok = fs.evaluate_gates(card, baseline=json.loads(json.dumps(card)))
+    assert ok["baseline"]["ok"], ok["baseline"]
+    # a much-better baseline makes the current numbers a regression
+    better = json.loads(json.dumps(card))
+    better["quarantine"]["precision"] = 1.0
+    better["quarantine"]["recall"] = 1.0
+    better["wire"]["bytes_per_round"] = \
+        card["wire"]["bytes_per_round"] / 10.0
+    gates = fs.evaluate_gates(card, baseline=better)
+    assert not gates["baseline"]["ok"]
+    assert any("bytes_per_round" in p
+               for p in gates["baseline"]["problems"])
+
+
+# ---------------------------------------------------------------------------
+# Open-loop serving harness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_engine():
+    import jax
+
+    from distributedtraining_tpu.engine.serve import GenerationEngine
+    from distributedtraining_tpu.models import gpt2
+
+    model, cfg = gpt2.make_model(gpt2.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_head=2, n_layer=2))
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = GenerationEngine(model, params, max_slots=4, page_size=8)
+    yield eng
+    eng.close()
+
+
+def test_open_loop_arrivals_are_poisson_and_heavy_tailed():
+    spec = loadgen.OpenLoopSpec(rate_rps=50.0, duration_s=20.0, seed=3)
+    arr = loadgen.sample_arrivals(spec)
+    times = [t for t, _ in arr]
+    assert times == sorted(times)
+    assert times[-1] < spec.duration_s
+    # rate is approximately honored over a long window
+    assert 0.6 * 50 * 20 < len(arr) < 1.4 * 50 * 20
+    lens = [len(p) for _, p in arr]
+    assert min(lens) >= spec.min_prompt_tokens
+    assert max(lens) <= spec.max_prompt_tokens
+    # heavy tail: the max dwarfs the median
+    assert max(lens) >= 2 * sorted(lens)[len(lens) // 2]
+    # seeded: same spec, same schedule
+    assert loadgen.sample_arrivals(spec) == arr
+
+
+def test_open_loop_exposes_queueing_collapse(serve_engine):
+    low = loadgen.run_open_loop(serve_engine, loadgen.OpenLoopSpec(
+        rate_rps=6.0, duration_s=2.0, seed=5, max_new_tokens=8))
+    high = loadgen.run_open_loop(serve_engine, loadgen.OpenLoopSpec(
+        rate_rps=120.0, duration_s=2.0, seed=5, max_new_tokens=8))
+    assert low["offered"] > 0 and low["unfinished"] == 0
+    # open-loop arrivals keep coming past capacity: p99 ttft blows up
+    assert high["ttft_ms"]["p99"] > 5 * low["ttft_ms"]["p99"]
+    # virtual-time accounting: deterministic on rerun, even on the warm
+    # engine (the scheduler's decisions, not the host's speed)
+    again = loadgen.run_open_loop(serve_engine, loadgen.OpenLoopSpec(
+        rate_rps=6.0, duration_s=2.0, seed=5, max_new_tokens=8))
+    assert json.dumps(again, sort_keys=True) == \
+        json.dumps(low, sort_keys=True)
+
+
+def test_serving_gate_reads_load_points(serve_engine):
+    pts = [loadgen.run_open_loop(serve_engine, loadgen.OpenLoopSpec(
+        rate_rps=r, duration_s=1.5, seed=9, max_new_tokens=8))
+        for r in (5.0, 15.0, 45.0)]
+    spec = smoke_spec(rounds=3)
+    card = fs.assemble_scorecard(fs.simulate(spec), load_points=pts)
+    g = card["gates"]["serving"]
+    assert g["load_points"] == 3
+    assert g["ok"], g
+    # losing a point fails the coverage requirement
+    card2 = fs.assemble_scorecard(fs.simulate(spec),
+                                  load_points=pts[:2])
+    assert not card2["gates"]["serving"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# The acceptance run (slow lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_thousand_actor_acceptance_run(serve_engine):
+    """ISSUE 11 acceptance: a 1000-actor, chaos-enabled run completes on
+    CPU in bounded wall time; the scorecard holds parity vs the
+    churn-free control, quarantine precision/recall >= 0.9 against the
+    injected truth, a postmortem bundle for 100% of injected kills, a
+    3-point open-loop latency curve — and a same-seed rerun reproduces
+    the scorecard byte-identically."""
+    spec = fs.FleetSpec(
+        miners=960, validators=4, servers=8, sub_averagers=16,
+        rounds=12, seed=0, stale_miners=24, divergent_miners=24,
+        pushfail_miners=24, poison_miners=24, kills=12,
+        kill_primary_round=5, partitions_per_round=4)
+    assert spec.total_actors == 990
+    pts = [loadgen.run_open_loop(serve_engine, loadgen.OpenLoopSpec(
+        rate_rps=r, duration_s=4.0, seed=spec.seed, max_new_tokens=8))
+        for r in (8.0, 24.0, 72.0)]
+    card = fs.assemble_scorecard(fs.simulate(spec),
+                                 fs.simulate(spec.control()), pts)
+    assert card["ok"], {k: v for k, v in card["gates"].items()
+                        if not v["ok"]}
+    assert card["quarantine"]["precision"] >= 0.9
+    assert card["quarantine"]["recall"] >= 0.9
+    assert card["postmortem"]["coverage"] == 1.0
+    assert card["parity"]["rel_diff"] <= 0.1
+    assert len(card["serving"]["load_points"]) == 3
+    # byte-identical rerun (load points are deterministic too, pinned
+    # above at tier-1 scale — reuse them rather than re-decoding)
+    card2 = fs.assemble_scorecard(fs.simulate(spec),
+                                  fs.simulate(spec.control()), pts)
+    assert json.dumps(card, sort_keys=True) == \
+        json.dumps(card2, sort_keys=True)

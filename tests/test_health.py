@@ -460,6 +460,35 @@ def test_render_is_parseable_with_empty_state():
     assert out.endswith("\n")
 
 
+def test_render_exports_serve_latency_quantile_gauges():
+    """serve.ttft_ms / serve.tpot_ms histograms additionally export as
+    ONE labeled gauge family each — dt_serve_ttft_ms{q="0.5|0.95|0.99"}
+    — so a Grafana latency panel selects quantiles by label; the
+    flattened _p50/_p95/_p99 names keep rendering for existing
+    dashboards."""
+    reg = obs.Registry()
+    for v in range(1, 101):
+        reg.histogram("serve.ttft_ms").observe(float(v))
+    reg.histogram("serve.tpot_ms").observe(7.0)
+    body = render(registry=reg, fleet=None)
+    assert 'dt_serve_ttft_ms{q="0.5"} 50.5' in body
+    assert 'dt_serve_ttft_ms{q="0.95"}' in body
+    assert 'dt_serve_ttft_ms{q="0.99"}' in body
+    assert 'dt_serve_tpot_ms{q="0.95"} 7.0' in body
+    # the flattened spellings survive alongside
+    assert "dt_serve_ttft_ms_p50 50.5" in body
+    for ln in body.splitlines():
+        if ln and not ln.startswith("#"):
+            assert _PROM_LINE.match(ln), ln
+    # an EMPTY serve histogram emits no labeled series (no NaN spam),
+    # and a counter under a quantile name is left alone
+    reg2 = obs.Registry()
+    reg2.histogram("serve.ttft_ms")
+    reg2.counter("serve.tpot_ms".replace("tpot", "other")).inc()
+    body2 = render(registry=reg2, fleet=None)
+    assert '{q=' not in body2
+
+
 # ---------------------------------------------------------------------------
 # The full localfs fleet round
 # ---------------------------------------------------------------------------

@@ -105,6 +105,62 @@ def test_module_helpers_noop_when_disabled():
     assert not obs.dirty()  # nothing recorded, nothing configured
 
 
+def test_registry_cardinality_cap_drops_new_names():
+    reg = Registry(max_names=3)
+    reg.counter("a").inc()
+    reg.histogram("b").observe(1.0)
+    reg.gauge("c").set(5.0)
+    # past the cap: fully-usable DETACHED instruments, never snapshotted
+    dropped = reg.counter("d")
+    dropped.inc(99)
+    reg.histogram("e").observe(1.0)
+    assert len(reg) == 3
+    assert reg.dropped_names == 2
+    assert set(reg.names()) == {"a", "b", "c"}
+    assert "d" not in reg.snapshot()
+    # existing names keep working at the cap
+    assert reg.counter("a") is reg.counter("a")
+    with pytest.raises(ValueError):
+        Registry(max_names=0)
+
+
+def test_registry_merge_folds_all_instrument_kinds():
+    a, b = Registry(), Registry()
+    a.counter("c").inc(2)
+    b.counter("c").inc(3)
+    b.counter("only_b").inc(1)
+    a.gauge("g").set(1.0)
+    b.gauge("g").set(7.0)
+    a.histogram("h").observe(1.0)
+    b.histogram("h").observe(3.0)
+    b.histogram("h").observe(5.0)
+    out = a.merge(b)
+    assert out is a
+    snap = a.snapshot()
+    assert snap["c"] == 5.0                  # counters add
+    assert snap["only_b"] == 1.0             # new names materialize
+    assert snap["g"] == 7.0                  # gauges: last-merged-wins
+    assert snap["h.count"] == 3.0 and snap["h.sum"] == 9.0
+    assert a.histogram("h").percentiles()["p50"] == 3.0
+    # kind mismatch is the usual duplicate-registration lint
+    c = Registry()
+    c.histogram("c")
+    with pytest.raises(ValueError):
+        c.merge(a)
+    # merging into a capped registry drops-and-counts past the cap
+    capped = Registry(max_names=1)
+    capped.merge(a)
+    assert len(capped) == 1 and capped.dropped_names >= 1
+
+
+def test_registry_peek_never_creates():
+    reg = Registry()
+    assert reg.peek("ghost") is None
+    assert len(reg) == 0
+    h = reg.histogram("h")
+    assert reg.peek("h") is h
+
+
 # ---------------------------------------------------------------------------
 # Spans
 # ---------------------------------------------------------------------------

@@ -431,6 +431,63 @@ def test_parse_lease_rejects_junk():
     assert got == {"lease": 1, "epoch": 2, "holder": "h", "t": 5.0}
 
 
+def test_standby_read_faults_do_not_reset_the_stall_clock():
+    """A watch-read fault is "no evidence", not "activity": the stall
+    clock keeps running through transport flaps and takeover still
+    fires on deadline. Before this rule a flaky transport reset the
+    clock on every value->None flap and could starve the failover
+    indefinitely — the fleetsim chaos runs (tests/test_fleetsim.py)
+    surfaced takeover latency scaling with the fetch error rate."""
+    class Flaky(InMemoryTransport):
+        broken = False
+
+        def fetch_delta_meta(self, miner_id):
+            if self.broken:
+                raise OSError("flap")
+            return super().fetch_delta_meta(miner_id)
+
+        def base_revision(self):
+            if self.broken:
+                raise OSError("flap")
+            return super().base_revision()
+
+    clock = FakeClock(0.0)
+    t = Flaky()
+    primary = LeaseManager(t, "primary", clock=clock)
+    assert primary.acquire() and primary.epoch == 1
+
+    class _Loop:
+        transport = t
+
+        def bootstrap(self):
+            pass
+
+    standby_lease = LeaseManager(t, "standby", clock=clock)
+    standby = StandbyAverager(_Loop(), standby_lease, deadline_s=100.0,
+                              poll_s=10.0, clock=clock)
+    assert standby.poll_once() == "following"     # baseline signature
+    clock.advance(60.0)
+    t.broken = True                               # every watch read flaps
+    assert standby.poll_once() == "following"
+    clock.advance(60.0)
+    t.broken = False
+    # 120s of NO positive evidence > deadline: the flap did not reset it
+    assert standby.poll_once() == "takeover"
+    assert standby.active and standby_lease.epoch == 2
+    # and genuine primary activity DOES reset: fresh standby, renewing
+    # primary
+    standby2 = StandbyAverager(_Loop(), LeaseManager(t, "s2", clock=clock),
+                               deadline_s=100.0, poll_s=10.0, clock=clock)
+    assert standby2.poll_once() == "following"
+    clock.advance(90.0)
+    standby_lease.stamp("rev-x")                  # holder activity
+    assert standby2.poll_once() == "following"
+    clock.advance(90.0)                           # 90 < 100 since activity
+    standby_lease.stamp("rev-y")
+    assert standby2.poll_once() == "following"
+    assert standby2.stalled_for() < 100.0
+
+
 # ---------------------------------------------------------------------------
 # Miner preemption-resume hardening (satellite; localfs regression)
 # ---------------------------------------------------------------------------
