@@ -61,6 +61,35 @@ def _peak_flops() -> float | None:
     return None
 
 
+def _bench_env() -> dict:
+    """Rig forensics embedded in EVERY bench record (including degraded
+    ones): device kind/counts, platform, and jax/jaxlib versions — the
+    four rounds of bare ``value: 0.0, tunnel wedged`` artifacts
+    (BENCH_r02–r05) were undiagnosable precisely because the record
+    said nothing about the environment that produced it. Each field is
+    probed independently so a wedged backend still yields the version
+    fields."""
+    out: dict = {}
+    try:
+        import jaxlib
+        out["jax_version"] = jax.__version__
+        out["jaxlib_version"] = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        out["platform"] = jax.default_backend()
+    except Exception:
+        pass
+    try:
+        devs = jax.devices()
+        out["device_kind"] = devs[0].device_kind
+        out["device_count"] = len(devs)
+        out["host_count"] = jax.process_count()
+    except Exception:
+        pass
+    return out
+
+
 def _time_train(model, cfg, *, iters: int = ITERS,
                 fused_loss: bool | str = False) -> float:
     """tokens/sec of the jitted train step (fwd+bwd+adamw) on one chip."""
@@ -965,6 +994,92 @@ def _time_metrics_overhead(*, steps: int = 100, trials: int = 2,
     }
 
 
+def _time_devprof_overhead(*, steps: int = 100, trials: int = 2,
+                           log_every: int = 5) -> dict:
+    """Device-observatory A/B (round-17 tentpole): the production
+    MinerLoop with the obs layer fully ON both sides (configured sink,
+    step histograms, periodic flush — the round-8 baseline), and the
+    contrast being exactly utils/devprof.py: per-program cost probes,
+    blocking exec timing (CPU), per-(program, bucket) histograms, and
+    the flush-time snapshot mirror. Interleaved off/on pairs
+    (scripts/measure.sh rule 4); acceptance floor:
+    devprof_overhead_frac < 0.02. The ON side's registry also yields
+    the per-program achieved-fraction summary every bench record
+    carries so ``--baseline`` gates utilization, not just the headline
+    tokens/sec (fractions exist only where the roofline knows the chip
+    — a TPU rig; CPU runs record the FLOPs/bytes attribution alone)."""
+    import os as _os
+    import tempfile
+
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.engine.train import MinerLoop
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+    from distributedtraining_tpu.utils import devprof, obs
+    from distributedtraining_tpu.utils.metrics import JSONLSink
+
+    model, cfg = gpt2.make_model("tiny")
+    seq = 64
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (BATCH, seq)), np.int32)}
+    observed: dict = {}
+
+    def run_once(instrumented: bool) -> float:
+        fd, tmp = tempfile.mkstemp(suffix=".jsonl")
+        _os.close(fd)
+        sink = JSONLSink(tmp)
+        try:
+            obs.configure(sink, role="bench")
+            if instrumented:
+                devprof.enable()
+            engine = TrainEngine(model, seq_len=seq)
+            loop = MinerLoop(
+                engine, InMemoryTransport(), "bench-devprof",
+                send_interval=1e9, check_update_interval=1e9,
+                log_every=log_every, metrics=sink)
+            loop.bootstrap(jax.random.PRNGKey(0))
+
+            def batches():
+                while True:
+                    yield batch
+
+            loop.run(batches(), max_steps=2)   # warm compiles off-timing
+            t0 = time.perf_counter()
+            loop.run(batches(), max_steps=steps)
+            dt = time.perf_counter() - t0      # exit loss fetch ends timing
+            assert loop.report.last_loss == loop.report.last_loss
+            if instrumented:
+                recs = devprof.records()
+                assert recs, "observatory recorded nothing"
+                observed["devprof_programs"] = len(recs)
+                observed["prog_achieved"] = devprof.achieved_fractions()
+                for r in recs:
+                    if r.prog == "train.step":
+                        observed["devprof_train_step_flops"] = r.flops
+                        observed["devprof_train_step_bytes"] = \
+                            r.bytes_accessed
+            return dt
+        finally:
+            devprof.reset()
+            obs.reset()
+            sink.close()
+            _os.unlink(tmp)
+
+    offs, ons = [], []
+    for _ in range(trials):
+        offs.append(run_once(False))
+        ons.append(run_once(True))
+    off, on = float(np.mean(offs)), float(np.mean(ons))
+    return {
+        "devprof_steps": steps,
+        "devprof_off_s": round(off, 4),
+        "devprof_on_s": round(on, 4),
+        "devprof_overhead_frac": round(max(0.0, on / off - 1.0), 4),
+        **observed,
+    }
+
+
 def _time_heartbeat_overhead(*, steps: int = 100, trials: int = 2,
                              interval: float = 0.02,
                              log_every: int = 5) -> dict:
@@ -1384,9 +1499,17 @@ def _require_backend(timeout_s: float = 180.0) -> tuple[str, str | None]:
         run_with_timeout(jax.devices, 60.0, name="cpu-backend")
         return "cpu_fallback", reason
     except Exception:
+        versions = {}
+        try:  # version forensics only: a backend probe here would wedge
+            import jaxlib
+            versions = {"jax_version": jax.__version__,
+                        "jaxlib_version": jaxlib.__version__}
+        except Exception:
+            pass
         print(json.dumps({
             "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
             "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": None,
+            **versions,
             "degraded_reason": reason + " AND the CPU fallback failed "
                                         "to initialize",
             "error": f"TPU backend unreachable after {timeout_s:.0f}s "
@@ -1396,10 +1519,66 @@ def _require_backend(timeout_s: float = 180.0) -> tuple[str, str | None]:
         sys.exit(0)
 
 
-def main() -> None:
+def _gate_baseline(record: dict, baseline_path: str,
+                   *, max_drop: float = 0.2) -> list[str]:
+    """Regression gate against a prior bench record (``--baseline``):
+    flags the headline tokens/sec AND every per-program roofline
+    achieved-fraction (``prog_achieved``, devprof) that dropped more
+    than ``max_drop`` relative — a step can keep its tokens/sec
+    headline while a constituent program's utilization collapses
+    (e.g. a regressed merge hidden behind a faster eval), and only the
+    per-program fractions catch that. Degraded records gate nothing
+    (an environment fact is not a regression)."""
+    import sys
+
+    try:
+        with open(baseline_path) as f:
+            base = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench: cannot read --baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return []
+    if record.get("degraded_cpu") or base.get("degraded_cpu"):
+        return []
+    regressions: list[str] = []
+    bv, nv = base.get("value"), record.get("value")
+    if isinstance(bv, (int, float)) and isinstance(nv, (int, float)) \
+            and bv > 0 and nv < (1.0 - max_drop) * bv:
+        regressions.append(
+            f"headline tokens/sec {nv:.1f} < {(1 - max_drop):.0%} of "
+            f"baseline {bv:.1f}")
+    base_prog = base.get("prog_achieved") or {}
+    now_prog = record.get("prog_achieved") or {}
+    for prog, bfrac in sorted(base_prog.items()):
+        nfrac = now_prog.get(prog)
+        if not isinstance(bfrac, (int, float)) or bfrac <= 0:
+            continue
+        if not isinstance(nfrac, (int, float)):
+            regressions.append(
+                f"program {prog}: achieved-fraction disappeared "
+                f"(baseline {bfrac:.4f})")
+        elif nfrac < (1.0 - max_drop) * bfrac:
+            regressions.append(
+                f"program {prog}: achieved fraction {nfrac:.4f} < "
+                f"{(1 - max_drop):.0%} of baseline {bfrac:.4f}")
+    return regressions
+
+
+def main(argv=None) -> None:
     global BATCH, SEQ, WARMUP, ITERS, MERGE_M, MERGE_ITERS
+    import argparse
 
     from distributedtraining_tpu.models import gpt2
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=None, metavar="BENCH_rNN.json",
+                    help="gate this run against a prior bench record: "
+                         "exit 1 when the headline tokens/sec OR any "
+                         "per-program roofline achieved-fraction "
+                         "(prog_achieved, utils/devprof.py) regresses "
+                         "more than 20%% relative — utilization "
+                         "regressions gate even when the headline holds")
+    args = ap.parse_args(argv)
 
     backend, degraded_reason = _require_backend()
     degraded = degraded_reason is not None
@@ -1419,7 +1598,7 @@ def main() -> None:
     base_burst(WARMUP)                     # the headline and every A/B pair
     tokens_per_sec = base_burst(ITERS)
 
-    extras = {"backend": backend}
+    extras = {"backend": backend, **_bench_env()}
     if degraded:
         extras["degraded_cpu"] = True
         extras["degraded_reason"] = degraded_reason
@@ -1523,6 +1702,15 @@ def main() -> None:
         extras.update(_time_metrics_overhead())
     except Exception as e:
         extras["metrics_overhead_error"] = repr(e)
+
+    try:
+        # device-observatory cost: obs fully on both sides, contrast =
+        # utils/devprof.py (round-17 tentpole; acceptance < 2%). Also
+        # the source of the per-program achieved-fraction summary the
+        # --baseline gate reads.
+        extras.update(_time_devprof_overhead())
+    except Exception as e:
+        extras["devprof_overhead_error"] = repr(e)
 
     try:
         # concurrent + cached averager ingest vs serial gather over
@@ -1635,7 +1823,7 @@ def main() -> None:
         except Exception as e:
             extras["batch16_error"] = repr(e)
 
-    print(json.dumps({
+    record = {
         "metric": "miner_train_tokens_per_sec_per_chip_gpt2_124m",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
@@ -1645,7 +1833,19 @@ def main() -> None:
                         else round(tokens_per_sec / BASELINE_TOKENS_PER_SEC,
                                    3)),
         **extras,
-    }))
+    }
+    regressions: list[str] = []
+    if args.baseline:
+        regressions = _gate_baseline(record, args.baseline)
+        if regressions:
+            record["utilization_regressions"] = regressions
+    print(json.dumps(record))
+    if regressions:
+        import sys
+        for r in regressions:
+            print(f"bench: REGRESSION vs {args.baseline}: {r}",
+                  file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -245,6 +245,14 @@ class RunConfig:
     # remediation action / crash, published through this role's
     # transport. Value = ring capacity in events; 0 disables the plane.
     flight_events: int = 512
+    # device performance observatory (utils/devprof.py): per-program XLA
+    # cost attribution (FLOPs/bytes), compile + execution histograms,
+    # and roofline achieved-fraction gauges for every registered hot
+    # path; exposed via obs_http dt_prog_* series, heartbeat anat.*
+    # fields, and the {"devprof": ...} JSONL record perf_report joins.
+    # On by default wherever a metrics sink is configured (measured
+    # < 2% overhead, bench._time_devprof_overhead).
+    devprof: bool = True
     mlflow_uri: Optional[str] = None
     profile_dir: Optional[str] = None        # jax.profiler trace capture
     profile_steps: int = 5                   # train steps per capture
@@ -795,6 +803,12 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                         "fleet ledger) on 127.0.0.1:<port>/metrics, plus "
                         "the /debug/dump, /debug/profile?ms=N and "
                         "/debug/stacks postmortem endpoints; 0 disables")
+    g.add_argument("--no-devprof", dest="devprof", action="store_false",
+                   default=d.devprof,
+                   help="disable the device performance observatory "
+                        "(utils/devprof.py): per-program FLOPs/bytes "
+                        "cost attribution, exec histograms, and roofline "
+                        "achieved-fraction gauges")
     g.add_argument("--flight-events", dest="flight_events", type=int,
                    default=d.flight_events,
                    help="flight-recorder ring capacity (utils/flight.py): "

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .utils import obs
+from .utils import devprof, obs
 
 Params = Any  # a pytree of arrays
 
@@ -91,7 +91,7 @@ def tree_finite(tree: Params) -> jax.Array:
     return jnp.logical_not(jnp.any(jnp.stack(flags)))
 
 
-_tree_finite_jit = jax.jit(tree_finite)
+_tree_finite_jit = devprof.wrap("delta.finite", jax.jit(tree_finite))
 
 
 def has_nonfinite(tree: Params) -> bool:
@@ -183,7 +183,9 @@ def _cohort_screen_stats(*deltas: Params) -> tuple[jax.Array, jax.Array]:
     return jnp.stack(fins), jnp.stack(maxs)
 
 
-_cohort_screen_stats_jit = jax.jit(_cohort_screen_stats)
+_cohort_screen_stats_jit = devprof.wrap(
+    "delta.screen", jax.jit(_cohort_screen_stats),
+    bucket=lambda a, kw: len(a))  # screen arity (bucket-padded chunk)
 
 # device memory per screen dispatch is bounded at SCREEN_CHUNK x params
 # (the chunked_weighted_merge discipline — an averager may gather ~100
@@ -464,7 +466,11 @@ def weighted_merge(base: Params, stacked_deltas: Params, weights: jax.Array) -> 
 
 # jitted once at module level: per-call jax.jit(weighted_merge) creates a
 # fresh function identity each time and retraces/recompiles every round
-weighted_merge_jit = jax.jit(weighted_merge)
+weighted_merge_jit = devprof.wrap(
+    "delta.merge", jax.jit(weighted_merge),
+    # (base, stacked, weights) -> miner-axis size, the compiled variant
+    # the executable cache keys this merge on
+    bucket=lambda a, kw: jax.tree_util.tree_leaves(a[1])[0].shape[0])
 
 
 def weighted_merge_flat(base: Params, stacked_deltas: Params,
@@ -964,14 +970,18 @@ def densify_packed_v2(packed: Params, template: Params) -> Params:
     ``densify_sparse_delta``; accepts int8 AND f32 kept values)."""
     if not is_packed_v2(packed):
         return None
-    try:
-        fields = _packed_tree_fields(packed["leaves"], template,
-                                     q_dtypes=_PACKED_Q_DTYPES)
-    except (TypeError, ValueError, KeyError):
-        return None
-    if fields is None:
-        return None
-    return _densify_fields(fields, template)
+    # host phase in the device observatory: full-tensor writes per
+    # contribution — the measured cost the ROADMAP's fused
+    # dequant-scatter-add kernel is meant to delete
+    with devprof.track("delta.densify"):
+        try:
+            fields = _packed_tree_fields(packed["leaves"], template,
+                                         q_dtypes=_PACKED_Q_DTYPES)
+        except (TypeError, ValueError, KeyError):
+            return None
+        if fields is None:
+            return None
+        return _densify_fields(fields, template)
 
 
 def packed_layer_entries(packed: Params) -> dict[str, dict]:
@@ -1052,7 +1062,8 @@ def _accum_packed(acc_leaves, entries, w):
     return out
 
 
-_accum_packed_jit = jax.jit(_accum_packed)
+_accum_packed_jit = devprof.wrap(
+    "delta.accumulate", jax.jit(_accum_packed), bucket="packed")
 
 
 def _accum_dense(acc, d, w):
@@ -1060,7 +1071,8 @@ def _accum_dense(acc, d, w):
         lambda a, x: a + w * x.astype(a.dtype), acc, d)
 
 
-_accum_dense_jit = jax.jit(_accum_dense)
+_accum_dense_jit = devprof.wrap(
+    "delta.accumulate", jax.jit(_accum_dense), bucket="dense")
 
 
 def accumulate_delta(acc: Params, delta: Params, weight) -> Params:
@@ -1138,7 +1150,9 @@ def _packed_screen_stats(*packed_leaves) -> tuple[jax.Array, jax.Array]:
     return jnp.stack(fins), jnp.stack(maxs)
 
 
-_packed_screen_stats_jit = jax.jit(_packed_screen_stats)
+_packed_screen_stats_jit = devprof.wrap(
+    "delta.screen_packed", jax.jit(_packed_screen_stats),
+    bucket=lambda a, kw: len(a))  # screen arity (bucket-padded chunk)
 
 
 def sparse_delta_from_bytes(data: bytes, template: Params,

@@ -59,7 +59,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import delta as delta_lib
-from ..utils import obs
+from ..utils import devprof, obs
+
+# (base, stacked, batch) -> the stacked candidate axis: the bucket the
+# jit executable cache keys this dispatch's compiled variant on
+_cohort_bucket = lambda a, kw: jax.tree_util.tree_leaves(a[1])[0].shape[0]
 
 logger = logging.getLogger(__name__)
 
@@ -199,7 +203,8 @@ class BatchedCohortEvaluator:
         def eval_k(base, stacked, batch):
             return vmapped(stacked, base, batch)
 
-        return jax.jit(eval_k)
+        return devprof.wrap("eval.cohort", jax.jit(eval_k),
+                            bucket=_cohort_bucket)
 
     def _build_mesh(self, mesh) -> Callable:
         from jax.sharding import PartitionSpec as P
@@ -230,7 +235,8 @@ class BatchedCohortEvaluator:
             fn = _shard_map(local_eval, check_rep=False, **specs)
         except TypeError:  # pragma: no cover — newer jax spelling
             fn = _shard_map(local_eval, check_vma=False, **specs)
-        return jax.jit(fn)
+        return devprof.wrap("eval.cohort", jax.jit(fn),
+                            bucket=_cohort_bucket)
 
     # -- cohort assembly ----------------------------------------------------
     def _zeros_delta_host(self) -> Params:
@@ -289,7 +295,8 @@ class BatchedCohortEvaluator:
 
                 return jax.tree_util.tree_map(leaf, *real)
 
-            assemble = self._stack_cache[key] = jax.jit(assemble)
+            assemble = self._stack_cache[key] = devprof.wrap(
+                "eval.stack", jax.jit(assemble), bucket=k_pad)
             return _timed_compile(assemble, *deltas), k_real
         return assemble(*deltas), k_real
 
@@ -326,8 +333,10 @@ class BatchedCohortEvaluator:
         if k_stack != k_pad:
             pad = self._stack_cache.get(("pad", k_pad))
             if pad is None:  # one program, not one concat dispatch per leaf
-                pad = self._stack_cache[("pad", k_pad)] = jax.jit(
-                    lambda s: delta_lib.pad_stack(s, k_pad))
+                pad = self._stack_cache[("pad", k_pad)] = devprof.wrap(
+                    "eval.pad",
+                    jax.jit(lambda s: delta_lib.pad_stack(s, k_pad)),
+                    bucket=k_pad)
                 stacked = _timed_compile(pad, stacked)
             else:
                 stacked = pad(stacked)

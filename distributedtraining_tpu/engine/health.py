@@ -216,6 +216,16 @@ class Vitals:
                 body["base_revision"] = rev[:_MAX_STR]
         body["registry_digest"] = obs.registry_digest()
         body.update(device_memory_watermarks())
+        try:
+            # step-time anatomy (utils/devprof.py): host-blocked vs
+            # device vs data-wait averages, derived from the device
+            # observatory's per-program registry — numeric ``anat.*``
+            # extras, so older consumers just show what they know
+            from ..utils import devprof
+            body.update(devprof.anatomy())
+        except Exception:
+            logger.debug("heartbeat anatomy collection failed",
+                         exc_info=True)
         return body
 
 

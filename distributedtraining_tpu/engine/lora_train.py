@@ -25,7 +25,9 @@ import optax
 
 from .. import delta as delta_lib
 from ..models import lora as lora_lib
-from .train import MinerLoop, TrainEngine, TrainState, accumulated_grads
+from ..utils import devprof
+from .train import (MinerLoop, TrainEngine, TrainState, accumulated_grads,
+                    _devprof_batch_bucket)
 
 logger = logging.getLogger(__name__)
 
@@ -80,8 +82,15 @@ class LoRAEngine(TrainEngine):
             l, count = loss(lora_params, base, batch)
             return l * count, count
 
-        self.train_step = jax.jit(train_step, donate_argnums=(0,))
-        self.eval_step = jax.jit(eval_step)
+        # same observatory names as the full-param engine (a process
+        # runs one engine; the LoRA step IS its train.step) — batch is
+        # the THIRD arg here (state, base, batch)
+        self.train_step = devprof.wrap(
+            "train.step", jax.jit(train_step, donate_argnums=(0,)),
+            bucket=lambda a, kw: _devprof_batch_bucket(a[2]))
+        self.eval_step = devprof.wrap(
+            "train.eval", jax.jit(eval_step),
+            bucket=lambda a, kw: _devprof_batch_bucket(a[2]))
 
     # -- adapter placement (replicated; base placement is inherited) --------
     def _replicated(self):

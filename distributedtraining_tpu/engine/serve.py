@@ -68,7 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import flight, obs
+from ..utils import devprof, flight, obs
 from .batched_eval import _timed_compile
 
 logger = logging.getLogger(__name__)
@@ -204,7 +204,7 @@ def reference_generate(model, params, prompt: Sequence[int],
             return jnp.argmax(
                 logits[0, cur - 1, :cfg.vocab_size]).astype(jnp.int32)
 
-        prog = _REF_PROGS[key] = jax.jit(fwd)
+        prog = _REF_PROGS[key] = jax.jit(fwd)  # devprof: exempt (bench reference path, not a production program)
     buf = np.zeros((1, t_pad), np.int32)
     buf[0, :len(toks)] = toks
     cur = len(toks)
@@ -533,8 +533,11 @@ class GenerationEngine:
             nxt = jnp.argmax(logits[0, prompt_len - 1, :vocab])
             return nxt.astype(jnp.int32), k_pages, v_pages
 
-        prog = jax.jit(prefill,
-                       donate_argnums=(3, 4) if self._donate else ())
+        prog = devprof.wrap(
+            "serve.prefill",
+            jax.jit(prefill,
+                    donate_argnums=(3, 4) if self._donate else ()),
+            bucket=t_bucket)
         self._prefill_progs[t_bucket] = prog
         return prog
 
@@ -571,7 +574,10 @@ class GenerationEngine:
             nxt = jnp.argmax(logits[:, -1, :vocab], axis=-1)
             return nxt.astype(jnp.int32), k_pages, v_pages
 
-        prog = jax.jit(step, donate_argnums=(1, 2) if self._donate else ())
+        prog = devprof.wrap(
+            "serve.decode",
+            jax.jit(step, donate_argnums=(1, 2) if self._donate else ()),
+            bucket=f"{n_slots}x{n_pages}")
         self._decode_progs[(n_slots, n_pages)] = prog
         return prog
 

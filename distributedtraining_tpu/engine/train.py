@@ -32,7 +32,7 @@ from flax import struct
 from .. import delta as delta_lib
 from ..ops.losses import causal_lm_loss
 from ..parallel.sharding import batch_sharding, mesh_shardings, opt_state_shardings
-from ..utils import obs
+from ..utils import devprof, obs
 from ..utils.metrics import device_metrics
 from .scheduler import Clock, PeriodicAction, RealClock
 
@@ -105,6 +105,17 @@ def accumulated_grads(loss_fn, params, batch, accum_steps: int):
     grads = jax.tree_util.tree_map(
         lambda g: (g / denom).astype(g.dtype), g_sum)
     return loss_sum / denom, tok_sum, grads
+
+
+def _devprof_batch_bucket(batch) -> str:
+    """BxT bucket label of a token batch — the shape family XLA keys its
+    compiled variants on, so the observatory's bucket matches 1:1 the
+    executable actually dispatched."""
+    ids = batch.get("input_ids") if isinstance(batch, dict) else None
+    shape = getattr(ids, "shape", None)
+    if shape is None or len(shape) < 2:
+        return "-"
+    return f"{shape[0]}x{shape[1]}"
 
 
 def _default_lm_loss(model, params, batch):
@@ -290,8 +301,16 @@ class TrainEngine:
             loss, tokens = loss_fn(params, batch)
             return loss * tokens, tokens  # weighted for exact aggregation
 
-        self.train_step = jax.jit(train_step, donate_argnums=(0,))
-        self.eval_step = jax.jit(eval_step)
+        # device observatory (utils/devprof.py): per-(program, BxT-bucket)
+        # cost attribution + exec histograms; single-branch pass-through
+        # until devprof.enable()
+        batch_bucket = _devprof_batch_bucket
+        self.train_step = devprof.wrap(
+            "train.step", jax.jit(train_step, donate_argnums=(0,)),
+            bucket=lambda a, kw: batch_bucket(a[1]))
+        self.eval_step = devprof.wrap(
+            "train.eval", jax.jit(eval_step),
+            bucket=lambda a, kw: batch_bucket(a[1]))
 
     # -- state management ---------------------------------------------------
     def init_state(self, rng: jax.Array | None = None,
@@ -304,8 +323,9 @@ class TrainEngine:
         # validator bases) or those arrays get deleted underneath them
         params = jax.tree_util.tree_map(lambda x: x.copy(),
                                         self.place_params(params))
-        opt_state = jax.jit(self.tx.init)(params) if self.mesh is None \
-            else self._sharded_opt_init(params)
+        opt_state = (jax.jit(self.tx.init)(params)  # devprof: exempt (cold init)
+                     if self.mesh is None
+                     else self._sharded_opt_init(params))
         return TrainState(step=self.place_step(0), params=params,
                           opt_state=opt_state)
 
@@ -350,7 +370,7 @@ class TrainEngine:
         abstract = jax.eval_shape(self.tx.init, params)
         shardings = opt_state_shardings(abstract, self._param_shardings,
                                         self.mesh)
-        return jax.jit(self.tx.init, out_shardings=shardings)(params)
+        return jax.jit(self.tx.init, out_shardings=shardings)(params)  # devprof: exempt (cold init)
 
     def abstract_params(self) -> Params:
         """Shape/dtype skeleton of the MODEL param tree (with this engine's
@@ -1061,7 +1081,7 @@ class MinerLoop:
     # optimizer moments — moments can overflow a step before params do);
     # the eager two-tree has_nonfinite spelling cost two dispatches and two
     # host round-trips per save
-    _state_finite = staticmethod(jax.jit(
+    _state_finite = staticmethod(jax.jit(  # devprof: exempt (per-save guard, not a step program)
         lambda params, opt_state: jnp.logical_and(
             delta_lib.tree_finite(params), delta_lib.tree_finite(opt_state))))
 
@@ -1282,7 +1302,8 @@ class MinerLoop:
 
     def _push_program(self):
         if self._push_program_cache is None:
-            self._push_program_cache = jax.jit(self._build_push_snapshot())
+            self._push_program_cache = devprof.wrap(
+                "push.snapshot", jax.jit(self._build_push_snapshot()))
         return self._push_program_cache
 
     def _wire_residual_zeros(self):
@@ -1362,8 +1383,20 @@ class MinerLoop:
             self.heartbeat.start()   # idempotent across run() calls
         start_steps = self.report.steps  # max_steps bounds *this* call
         import time as _time
+        batch_iter = iter(batches)
         try:
-            for batch in batches:
+            while True:
+                # data-wait attribution: host time blocked on the input
+                # pipeline pulling the NEXT batch — the third leg of the
+                # step-time anatomy (host-blocked vs device vs data-wait)
+                # heartbeats and fleet_report render via devprof.anatomy()
+                tw = _time.perf_counter()
+                try:
+                    batch = next(batch_iter)
+                except StopIteration:
+                    break
+                obs.observe("miner.data_wait_ms",
+                            (_time.perf_counter() - tw) * 1e3)
                 if max_steps is not None and self.report.steps - start_steps >= max_steps:
                     break
                 self._pull_action.poll()

@@ -22,7 +22,7 @@ try:  # jax >= 0.8 top-level API, experimental path as fallback
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from ..utils import obs
+from ..utils import devprof, obs
 
 Params = Any
 
@@ -235,8 +235,14 @@ def sharded_cohort_merge(base: Params, stacked: Params, weights,
                 return b + jax.lax.psum(partial, axis)
             return jax.tree_util.tree_map(leaf, b_tree, d_tree)
 
-        program = jax.jit(_shard_map(local_merge, mesh=mesh,
-                                     in_specs=in_specs, out_specs=P()))
+        program = devprof.wrap(
+            "merge.sharded",
+            jax.jit(_shard_map(local_merge, mesh=mesh,
+                               in_specs=in_specs, out_specs=P())),
+            # (base, stacked, weights) -> padded miner-axis size, the
+            # bucket the executable cache keys this merge variant on
+            bucket=lambda a, kw: jax.tree_util.tree_leaves(
+                a[1])[0].shape[0])
         _MERGE_PROGRAMS[pkey] = program
 
     bkey = (mesh, axis, m_pad)

@@ -338,6 +338,10 @@ class _ObsState:
         # event ring. Held HERE (not imported) so obs stays import-light
         # and flight -> obs stays the only dependency direction.
         self.flight = None
+        # attached device-observatory flush hook (utils/devprof.py) or
+        # None: flush() mirrors the per-program device registry into the
+        # same sink. Same held-not-imported rule as flight.
+        self.devprof = None
 
 
 _STATE = _ObsState()
@@ -372,6 +376,14 @@ def attach_flight(recorder) -> None:
     its event ring. reset() drops the attachment with the rest of the
     process-wide state."""
     _STATE.flight = recorder
+
+
+def attach_devprof(hook) -> None:
+    """Attach (or detach, with None) the device observatory's flush hook
+    (utils/devprof.on_flush): every flush() then mirrors the per-program
+    device registry through the same sink as a ``{"devprof": ...}``
+    record. devprof.enable() attaches itself; reset() drops it."""
+    _STATE.devprof = hook
 
 
 def reset() -> None:
@@ -436,6 +448,12 @@ def flush(sink=None, *, step: int | None = None) -> dict[str, float]:
             fl.on_flush(snap)
         except Exception:
             logger.exception("flight flush hook failed")
+    dp = _STATE.devprof
+    if dp is not None:
+        try:
+            dp(sink, _STATE.role)
+        except Exception:
+            logger.exception("devprof flush hook failed")
     return snap
 
 

@@ -40,7 +40,7 @@ import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
-from . import obs
+from . import devprof, obs
 
 logger = logging.getLogger(__name__)
 
@@ -129,6 +129,16 @@ def render(registry=None, fleet=None) -> str:
         for q, label in _QUANTILE_LABELS:
             lines.append(
                 f'{pn}{{q="{label}"}} {_prom_value(ps[f"p{int(q)}"])}')
+    try:
+        # device observatory (utils/devprof.py): dt_prog_*{prog,bucket}
+        # per-program cost/exec/roofline series + the labeled
+        # dt_compile_ms{prog,bucket} compile histogram riding next to
+        # the unlabeled dt_compile_ms_* registry aggregate. Cardinality
+        # is bounded by devprof's own max_programs cap (the PR-11
+        # Registry(max_names=) discipline); empty when disabled.
+        lines.extend(devprof.prom_lines())
+    except Exception:  # a broken observatory must not 500 the registry
+        logger.exception("obs_http: devprof render failed")
     if fleet is not None:
         try:
             ledger = fleet.ledger()

@@ -109,9 +109,10 @@ def _run_sub_averager(cfg: RunConfig, c, plane) -> int:
     finally:
         plane.close()
         sub.close()
-        from distributedtraining_tpu.utils import flight, obs
+        from distributedtraining_tpu.utils import devprof, flight, obs
         flight.shutdown()
         obs.reset()
+        devprof.reset()
     logging.info("sub-averager %s done: rounds=%d accepted=%d pushes=%d",
                  node, sub.report.rounds, sub.report.last_accepted,
                  sub.report.pushes)
@@ -222,8 +223,9 @@ def main(argv=None) -> int:
         loop.close()   # drain the ingest pool's worker threads
         # see neurons/miner.py: crash bundle, then global obs state reset
         flight.shutdown()
-        from distributedtraining_tpu.utils import obs
+        from distributedtraining_tpu.utils import devprof, obs
         obs.reset()
+        devprof.reset()
     logging.info("averager done: rounds=%d accepted=%d rejected=%d loss=%.4f",
                  loop.report.rounds, loop.report.last_accepted,
                  loop.report.last_rejected, loop.report.last_loss)

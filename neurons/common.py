@@ -485,6 +485,13 @@ def build(cfg: RunConfig) -> Components:
         # it on exit so sequential in-process role runs (e2e) stay clean.
         from distributedtraining_tpu.utils import obs
         obs.configure(metrics, role=cfg.role)
+        if cfg.devprof:
+            # device observatory (utils/devprof.py): per-program cost
+            # attribution + roofline gauges on every registered hot
+            # path; rides the same sink via the obs.flush hook. Role
+            # mains reset it alongside obs on exit.
+            from distributedtraining_tpu.utils import devprof
+            devprof.enable()
     if cfg.flight_events > 0:
         # flight recorder (utils/flight.py): the bounded forensic ring
         # every role keeps, frozen into a transport-published __pm__

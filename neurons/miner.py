@@ -177,8 +177,9 @@ def main(argv=None) -> int:
         # (scripts/e2e_round.py, tests) must not bleed this role's
         # recorder/registry/sink into the next
         flight.shutdown()
-        from distributedtraining_tpu.utils import obs
+        from distributedtraining_tpu.utils import devprof, obs
         obs.reset()
+        devprof.reset()
     logging.info("miner done: steps=%d pushes=%d (failed=%d superseded=%d) "
                  "base_pulls=%d loss=%.4f",
                  report.steps, report.pushes, report.pushes_failed,

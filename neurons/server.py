@@ -130,7 +130,7 @@ def main(argv=None) -> int:
                                      tokenizer=c.tokenizer)
         frontend.start()
     loop = ServeLoop(engine).start()
-    from distributedtraining_tpu.utils import obs
+    from distributedtraining_tpu.utils import devprof, obs
     try:
         idle_since = None
         last_flush = time.monotonic()
@@ -173,6 +173,7 @@ def main(argv=None) -> int:
         # crash bundle (exceptional exits), then global obs state reset
         flight.shutdown()
         obs.reset()
+        devprof.reset()
     logger.info("server done: steps=%d tokens=%d revision=%s",
                 engine.steps, engine.tokens_emitted, engine.revision)
     return 0

@@ -103,8 +103,9 @@ def main(argv=None) -> int:
         validator.close()   # drain the ingest pool's worker threads
         # see neurons/miner.py: crash bundle, then global obs state reset
         flight.shutdown()
-        from distributedtraining_tpu.utils import obs
+        from distributedtraining_tpu.utils import devprof, obs
         obs.reset()
+        devprof.reset()
     return 0 if ok else 1
 
 

@@ -160,11 +160,33 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
         assert not missing, f"trace {cid} missing phases {missing}"
     print(obs_report.format_table(obs_rep))
 
+    # device observatory (utils/devprof.py): the where-the-time-goes
+    # table + the acceptance gate that attributed device programs cover
+    # >= 90% of the miner's measured step wall-clock, and the
+    # Perfetto-loadable cid-joined round trace next to the JSONLs
+    import perf_report
+    jsonls = [metrics_path, val_metrics, avg_metrics]
+    perf_rep = perf_report.build_report(jsonls)
+    assert perf_rep["programs"], "no devprof records in the role JSONLs"
+    print(perf_report.format_table(perf_rep))
+    cov = perf_rep["coverage"].get("miner")
+    assert cov is not None, "no miner step-time coverage computed"
+    assert cov["coverage_frac"] >= 0.90, \
+        f"attributed device programs cover only " \
+        f"{cov['coverage_frac']:.1%} of miner step wall-clock"
+    trace_path = os.path.join(work_dir, "round.trace.json")
+    trace = perf_report.write_trace(jsonls, trace_path)
+    assert any(ev.get("ph") == "X" for ev in trace["traceEvents"]), \
+        "Perfetto trace has no span events"
+
     summary = {
         "protocol": "miner->delta->validator->averager, "
                     f"{model} from a pretrained-format checkpoint",
         "obs_traces": {cid: tr["phases_ms"]
                        for cid, tr in obs_rep["deltas"].items()},
+        "devprof_coverage": cov,
+        "devprof_programs": len(perf_rep["programs"]),
+        "perf_trace": trace_path,
         "corpus": corpus, "tokenizer": tok_desc,
         "fused_loss": fused_loss,
         "tokenizer_vocab": tok_vocab,

@@ -47,6 +47,7 @@ def build_report(paths: list[str]) -> dict:
     records = obs_report.load_records(paths)
     nodes: dict[str, dict] = {}
     registry: dict[str, dict] = {}
+    devprof: dict[str, dict] = {}
     breaches: list[dict] = []
     remediations: list[dict] = []
     pruned: list[dict] = []
@@ -94,6 +95,13 @@ def build_report(paths: list[str]) -> dict:
             registry[role] = {k: v for k, v in rec.items()
                               if isinstance(v, (int, float))
                               and k not in ("ts", "step")}
+            continue
+        dp = rec.get("devprof")
+        if isinstance(dp, dict) and isinstance(rec.get("role"), str):
+            # device observatory snapshot (utils/devprof.py), mirrored
+            # through obs.flush — the LAST one per role wins, like the
+            # registry section
+            devprof[rec["role"]] = dp
     # registry-digest drift: nodes whose instrumentation vocabulary
     # differs from the fleet majority (usually a version skew)
     digests = {}
@@ -115,6 +123,7 @@ def build_report(paths: list[str]) -> dict:
         "remediations": remediations,
         "pruned": pruned,
         "registry": registry,
+        "devprof": devprof,
         "registry_digest_majority": majority,
     }
 
@@ -200,8 +209,39 @@ def format_table(rep: dict) -> str:
     for pr in rep.get("pruned", []):
         lines.append(f"  pruned: {pr.get('role')}/{pr.get('hotkey')} "
                      f"(left the registry after {pr.get('beats')} beats)")
+    # step-time anatomy (utils/devprof.py via heartbeat anat.* extras):
+    # where a node's step actually goes — host-blocked vs device vs
+    # data-wait — next to the throughput the table above shows
+    anat_rows = [(key, node) for key, node in rep["nodes"].items()
+                 if isinstance(node.get("anat.step_ms"), (int, float))]
+    if anat_rows:
+        lines.append("step-time anatomy (avg ms):")
+        for key, node in anat_rows:
+            frac = node.get("anat.device_frac")
+            wait = node.get("anat.data_wait_ms")
+            lines.append(
+                f"  {key}: step={node['anat.step_ms']:.2f}"
+                f"  device={node.get('anat.device_ms', 0.0):.2f}"
+                + (f" ({frac * 100:.0f}%)" if frac is not None else "")
+                + f"  host={node.get('anat.host_ms', 0.0):.2f}"
+                + (f"  data_wait={wait:.2f}" if wait is not None else ""))
+    for role, dp in sorted((rep.get("devprof") or {}).items()):
+        progs = dp.get("programs") or []
+        rl = dp.get("roofline") or {}
+        top = sorted(progs, key=lambda p: -(p.get("exec_ms") or {})
+                     .get("sum", 0.0))[:5]
+        if top:
+            lines.append(
+                f"devprof[{role}] ({rl.get('device_kind', '?')}): " +
+                "  ".join(
+                    f"{p['prog']}[{p['bucket']}]"
+                    f"={((p.get('exec_ms') or {}).get('p50') or 0.0):.2f}ms"
+                    + (f"@{p['achieved_flops_frac'] * 100:.1f}%peak"
+                       if p.get("achieved_flops_frac") is not None else "")
+                    for p in top))
     reg = rep.get("registry") or {}
-    interesting = ("miner.step_ms.p50", "compile.ms.count", "compile.ms.p95",
+    interesting = ("miner.step_ms.p50", "miner.data_wait_ms.p50",
+                   "compile.ms.count", "compile.ms.p95",
                    "ingest.cache_hits", "ingest.cache_misses",
                    "health.beats", "fleet.heartbeats",
                    "device.mem_peak_bytes",

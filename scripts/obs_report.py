@@ -89,6 +89,49 @@ def load_records(paths: list[str]) -> list[dict]:
     return records
 
 
+def chrome_trace(entries: list[dict]) -> dict:
+    """Timeline entries -> a Chrome-trace/Perfetto JSON object (the
+    ``chrome://tracing`` "JSON Array Format", which Perfetto loads
+    directly): ONE TRACK PER ROLE (each distinct ``source`` becomes a
+    pid with a process_name metadata record), span entries (``dur_ms``)
+    as complete "X" events, everything else as instant "i" events.
+    Correlation/join keys (cid, round, revision, cids) ride in ``args``
+    so a Perfetto query can join one artifact's life across tracks.
+
+    Entries are dicts with ``t`` (unix seconds), ``source`` (track
+    name, e.g. "miner/m0"), ``kind``, optional ``name``/``dur_ms``, and
+    arbitrary extra fields (JSON-able; kept in ``args``)."""
+    sources = sorted({str(e.get("source", "?")) for e in entries})
+    pid_of = {src: i + 1 for i, src in enumerate(sources)}
+    t0 = min((float(e["t"]) for e in entries
+              if isinstance(e.get("t"), (int, float))), default=0.0)
+    events: list[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"name": src}}
+        for src, pid in pid_of.items()]
+    for e in entries:
+        t = e.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        args = {k: v for k, v in e.items()
+                if k not in ("t", "source", "kind", "name", "dur_ms")
+                and v is not None and isinstance(v, (str, int, float,
+                                                     bool, list))}
+        ev = {"name": str(e.get("name") or e.get("kind", "event")),
+              "cat": str(e.get("kind", "event")),
+              "pid": pid_of[str(e.get("source", "?"))], "tid": 0,
+              "ts": round((float(t) - t0) * 1e6, 3), "args": args}
+        dur = e.get("dur_ms")
+        if isinstance(dur, (int, float)):
+            ev["ph"] = "X"
+            ev["dur"] = round(float(dur) * 1e3, 3)
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 def build_traces(records: list[dict]) -> dict[str, list[dict]]:
     """cid -> span records (a ``cids`` list fans the record out to every
     member, annotated with the sharing count)."""

@@ -1,0 +1,250 @@
+#!/usr/bin/env python
+"""Where the time goes: join the device observatory with obs timings.
+
+Each role's JSONL sink carries (next to spans and registry snapshots) a
+``{"devprof": ...}`` record per flush — the device performance
+observatory's per-program registry (utils/devprof.py): lowered XLA
+cost-analysis FLOPs/bytes, compile time, execution histograms, and
+roofline achieved-fraction per (program, bucket). This script is the
+offline half: it joins the LAST devprof snapshot per role with the obs
+registry's step histograms and prints
+
+- a per-(role, program, bucket) "where the time goes" table — calls,
+  exec p50, total attributed seconds, FLOPs/bytes per call, arithmetic
+  intensity, achieved fraction of the chip's roofline peak;
+- per-role COVERAGE: how much of the measured step wall-clock
+  (miner.step_ms / serve.step_ms) the attributed device programs
+  account for — the honesty check that the observatory sees the hot
+  loop, not a sample of it (acceptance: >= 90% on an e2e round);
+- with ``--trace out.json``, the cid-joined round timeline (every span
+  record across every input role) as a Chrome-trace file loadable in
+  Perfetto — one track per role, correlation ids in args.
+
+Usage:
+    python scripts/perf_report.py miner.jsonl validator.jsonl ...
+    python scripts/perf_report.py --work-dir ./run     # globs *.jsonl
+    python scripts/perf_report.py ... --trace round.trace.json
+    python scripts/perf_report.py ... --json           # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import obs_report  # noqa: E402 — same directory; shares record loading
+
+# step histogram -> device programs attributed to it (the
+# devprof._ANATOMY join, restated here so scripts stay import-free of
+# the package): coverage = (exec sums + compile) / step histogram sum
+STEP_PROGRAMS = {
+    "miner.step_ms": ("train.step",),
+    "serve.step_ms": ("serve.decode", "serve.prefill"),
+}
+
+
+def build_report(paths: list[str]) -> dict:
+    records = obs_report.load_records(paths)
+    devprof: dict[str, dict] = {}
+    registry: dict[str, dict] = {}
+    spans = 0
+    for rec in records:
+        dp = rec.get("devprof")
+        if isinstance(dp, dict) and isinstance(rec.get("role"), str):
+            devprof[rec["role"]] = dp      # last snapshot per role wins
+            continue
+        role = rec.get("obs_registry")
+        if isinstance(role, str):
+            registry[role] = {k: v for k, v in rec.items()
+                              if isinstance(v, (int, float))}
+            continue
+        if isinstance(rec.get("span"), str):
+            spans += 1
+
+    rows: list[dict] = []
+    for role, dp in sorted(devprof.items()):
+        for p in dp.get("programs") or []:
+            ex = p.get("exec_ms") or {}
+            total_ms = float(ex.get("sum") or 0.0) \
+                + float(p.get("compile_ms") or 0.0)
+            rows.append({
+                "role": role,
+                "prog": p.get("prog"), "bucket": p.get("bucket"),
+                "host": bool(p.get("host")),
+                "calls": p.get("calls"),
+                "compile_ms": p.get("compile_ms"),
+                "exec_p50_ms": ex.get("p50"),
+                "total_s": round(total_ms / 1e3, 4),
+                "flops": p.get("flops"),
+                "bytes_accessed": p.get("bytes_accessed"),
+                "arith_intensity": p.get("arith_intensity"),
+                "achieved_flops_frac": p.get("achieved_flops_frac"),
+                "achieved_bw_frac": p.get("achieved_bw_frac"),
+            })
+    rows.sort(key=lambda r: -r["total_s"])
+
+    coverage: dict[str, dict] = {}
+    for role, snap in registry.items():
+        for step_name, progs in STEP_PROGRAMS.items():
+            step_sum = snap.get(f"{step_name}.sum")
+            if not isinstance(step_sum, (int, float)) or step_sum <= 0:
+                continue
+            attributed = sum(r["total_s"] * 1e3 for r in rows
+                             if r["role"] == role and r["prog"] in progs
+                             and not r["host"])
+            coverage[role] = {
+                "step_histogram": step_name,
+                "step_wallclock_s": round(step_sum / 1e3, 4),
+                "attributed_s": round(attributed / 1e3, 4),
+                "coverage_frac": round(min(1.0, attributed / step_sum), 4),
+            }
+    return {
+        "files": paths,
+        "records": len(records),
+        "span_records": spans,
+        "rooflines": {role: dp.get("roofline")
+                      for role, dp in devprof.items()},
+        "programs": rows,
+        "coverage": coverage,
+        "dropped_programs": {role: dp.get("dropped_programs", 0)
+                             for role, dp in devprof.items()},
+    }
+
+
+def write_trace(paths: list[str], out_path: str) -> dict:
+    """The cid-joined round timeline (every span record across every
+    input role) as a Chrome-trace object, written to ``out_path`` —
+    one track per role, cid/round/revision join keys in args."""
+    entries = []
+    for rec in obs_report.load_records(paths):
+        if not isinstance(rec.get("span"), str):
+            continue
+        entries.append({"t": rec.get("t0", rec.get("ts", 0.0)),
+                        "source": f"{rec.get('role', '?')}/-",
+                        "kind": "span",
+                        "name": rec["span"],
+                        "dur_ms": rec.get("dur_ms"),
+                        "cid": rec.get("cid"),
+                        "cids": rec.get("cids"),
+                        "round": rec.get("round"),
+                        "revision": rec.get("revision"),
+                        "depth": rec.get("depth")})
+    trace = obs_report.chrome_trace(entries)
+    with open(out_path, "w") as f:
+        json.dump(trace, f, default=float)
+    return trace
+
+
+def _fmt_num(v, scale=1.0, suffix="") -> str:
+    if v is None:
+        return "-"
+    return f"{float(v) * scale:.4g}{suffix}"
+
+
+def format_table(rep: dict) -> str:
+    header = ["role", "prog", "bucket", "calls", "p50_ms", "total_s",
+              "gflop", "mb", "ai", "ach_flops", "ach_bw"]
+    rows = []
+    for r in rep["programs"]:
+        rows.append([
+            r["role"],
+            r["prog"] + ("(host)" if r["host"] else ""),
+            str(r["bucket"]),
+            str(r["calls"]),
+            _fmt_num(r["exec_p50_ms"]),
+            _fmt_num(r["total_s"]),
+            _fmt_num(r["flops"], 1e-9),
+            _fmt_num(r["bytes_accessed"], 1.0 / (1 << 20)),
+            _fmt_num(r["arith_intensity"]),
+            _fmt_num(r["achieved_flops_frac"], 100.0, "%"),
+            _fmt_num(r["achieved_bw_frac"], 100.0, "%"),
+        ])
+    widths = [max(len(r[i]) for r in [header] + rows) if rows
+              else len(header[i]) for i in range(len(header))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    lines.append("")
+    for role, rl in sorted((rep.get("rooflines") or {}).items()):
+        if not isinstance(rl, dict):
+            continue
+        if rl.get("known"):
+            lines.append(
+                f"roofline[{role}]: {rl['device_kind']} — peak "
+                f"{rl['peak_flops'] / 1e12:.0f} TFLOP/s bf16, "
+                f"{rl['hbm_bytes_per_s'] / 1e9:.0f} GB/s HBM")
+        else:
+            lines.append(
+                f"roofline[{role}]: {rl.get('device_kind', '?')} — "
+                "unknown chip (achieved fractions omitted)")
+    for role, cov in sorted((rep.get("coverage") or {}).items()):
+        lines.append(
+            f"coverage[{role}]: attributed device programs cover "
+            f"{cov['coverage_frac'] * 100:.1f}% of measured "
+            f"{cov['step_histogram']} wall-clock "
+            f"({cov['attributed_s']:.2f}s of "
+            f"{cov['step_wallclock_s']:.2f}s)")
+    dropped = {r: n for r, n in (rep.get("dropped_programs") or {}).items()
+               if n}
+    if dropped:
+        lines.append(f"WARNING: program records dropped at the "
+                     f"cardinality cap: {dropped}")
+    lines.append("")
+    lines.append("gflop/mb = per-call XLA cost analysis; ai = FLOPs/byte "
+                 "arithmetic intensity; ach_* = achieved fraction of the "
+                 "roofline peak at the exec p50")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*", help="per-role JSONL metric files")
+    p.add_argument("--work-dir", default=None,
+                   help="glob <work-dir>/*.jsonl instead of listing files")
+    p.add_argument("--json", dest="json_out", action="store_true",
+                   help="print the full report as JSON (machine-readable)")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON report to this path")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="write the cid-joined round timeline (every span "
+                        "across every role) as a Chrome-trace file "
+                        "loadable in Perfetto: one track per role, "
+                        "cid/round/revision join keys in args")
+    a = p.parse_args(argv)
+    paths = list(a.files)
+    if a.work_dir:
+        paths += sorted(glob.glob(os.path.join(a.work_dir, "*.jsonl")))
+    if not paths:
+        p.error("no input files (pass JSONL paths or --work-dir)")
+    rep = build_report(paths)
+    if not rep["programs"]:
+        print(f"no devprof records found in {len(paths)} file(s) "
+              f"({rep['records']} records total — are the roles running "
+              "with --metrics-path and without --no-devprof?)")
+        return 1
+    if a.json_out:
+        print(json.dumps(rep, indent=1, default=float))
+    else:
+        print(format_table(rep))
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(rep, f, indent=1, default=float)
+    if a.trace:
+        trace = write_trace(paths, a.trace)
+        print(f"wrote Perfetto/Chrome trace "
+              f"({len(trace['traceEvents'])} events) to {a.trace}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head et al. closing stdout is not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

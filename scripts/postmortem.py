@@ -282,6 +282,12 @@ def main(argv=None) -> int:
                    help="print the full report as JSON (machine-readable)")
     p.add_argument("--out", default=None,
                    help="also write the JSON report to this path")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="also write the causal timeline as a Chrome-trace "
+                        "file loadable in Perfetto (ui.perfetto.dev) or "
+                        "chrome://tracing: one track per role, spans as "
+                        "complete events, cid/round/revision join keys "
+                        "in args")
     a = p.parse_args(argv)
     paths = list(a.files)
     if a.work_dir:
@@ -304,6 +310,13 @@ def main(argv=None) -> int:
     if a.out:
         with open(a.out, "w") as f:
             json.dump(rep, f, indent=1, default=float)
+    if a.trace:
+        trace = obs_report.chrome_trace(rep["timeline"])
+        with open(a.trace, "w") as f:
+            json.dump(trace, f, default=float)
+        print(f"wrote Perfetto/Chrome trace "
+              f"({len(trace['traceEvents'])} events) to {a.trace}",
+              file=sys.stderr)
     return 0
 
 

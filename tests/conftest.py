@@ -297,10 +297,21 @@ def _no_leaked_obs_state():
     was_dirty = obs.dirty()
     leftover = obs.registry().names() if was_dirty else []
     obs.reset()
+    # the device observatory (utils/devprof.py) is the same kind of
+    # process-wide state: an enabled registry left behind would keep
+    # wrapping every later module's hot paths with blocking timings
+    from distributedtraining_tpu.utils import devprof
+    devprof_dirty = devprof.dirty()
+    devprof_left = ([f"{r.prog}[{r.bucket}]" for r in devprof.records()]
+                    if devprof_dirty else [])
+    devprof.reset()
     assert not live, f"test module left a running TraceCapture: {live}"
     assert not was_dirty, (
         "test module left global obs state behind (configured sink or "
         f"registry metrics {leftover}); call obs.reset() in teardown")
+    assert not devprof_dirty, (
+        "test module left the device observatory enabled or populated "
+        f"(programs {devprof_left}); call devprof.reset() in teardown")
 
 
 @pytest.fixture(scope="session")
