@@ -1346,6 +1346,113 @@ def _time_flight_overhead(*, steps: int = 100, trials: int = 2,
     }
 
 
+def _time_lineage_overhead(*, miners: int = 8, rounds: int = 8,
+                           trials: int = 3) -> dict:
+    """Lineage-plane A/B (round-18 tentpole): the production
+    AveragerLoop at soak cadence — every round stages ``miners`` fresh
+    submissions, merges (WeightedAverage), evaluates, and publishes —
+    with the contrast being exactly the provenance plane
+    (engine/lineage.py): per-publish record build + content address +
+    transport publish, plus the EWMA/CUSUM drift update. Records are
+    KBs of JSON next to a full-model base publish, so the measured
+    fraction bounds the real fleet's cost from far above (the bench
+    merges a tiny model; production bases are 1000x the bytes).
+    Interleaved off/on pairs; acceptance floor:
+    lineage_overhead_frac < 0.02."""
+    from types import SimpleNamespace
+
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.engine.average import (AveragerLoop,
+                                                        WeightedAverage)
+    from distributedtraining_tpu.engine.lineage import LineagePlane
+    from distributedtraining_tpu.engine.train import host_wire_template
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+
+    model, cfg = gpt2.make_model("tiny")
+    seq = 32
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": np.asarray(
+        rng.integers(0, cfg.vocab_size, (4, seq)), np.int32)}
+    hotkeys = [f"m{i}" for i in range(miners)]
+
+    class _Chain:
+        my_hotkey = "bench-averager"
+
+        def sync(self):
+            return SimpleNamespace(hotkeys=hotkeys + [self.my_hotkey])
+
+        def consensus_scores(self):
+            return {h: float(i + 1) for i, h in enumerate(hotkeys)}
+
+    def eval_batches():
+        yield batch
+
+    records_published = 0
+
+    def run_once(instrumented: bool) -> float:
+        nonlocal records_published
+        engine = TrainEngine(model, seq_len=seq)
+        transport = InMemoryTransport()
+        template = host_wire_template(engine)
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        lineage = LineagePlane(transport, node="bench-averager") \
+            if instrumented else None
+        loop = AveragerLoop(engine, transport, _Chain(),
+                            WeightedAverage(),
+                            val_batches=eval_batches,
+                            publish_policy="always", ingest_workers=1,
+                            lineage=lineage)
+
+        def push(round_seed: int) -> None:
+            key = jax.random.PRNGKey(round_seed)
+            for hk in hotkeys:
+                key, k = jax.random.split(key)
+                ks = jax.random.split(k, len(leaves))
+                transport.publish_delta(
+                    hk, jax.tree_util.tree_unflatten(
+                        treedef,
+                        [1e-3 * np.asarray(jax.random.normal(s, l.shape),
+                                           l.dtype)
+                         for s, l in zip(ks, leaves)]))
+
+        try:
+            loop.bootstrap(rng=jax.random.PRNGKey(0))
+            push(0)
+            loop.run_round()               # warm: compiles off-timing
+            t0 = time.perf_counter()
+            for r in range(1, rounds + 1):
+                push(r)                    # fresh revisions each round
+                loop.run_round()
+            dt = (time.perf_counter() - t0) / rounds
+            if lineage is not None:
+                assert lineage.records >= rounds, \
+                    "lineage plane recorded fewer merges than rounds"
+                records_published += lineage.records
+            return dt
+        finally:
+            loop.close()
+
+    offs, ons = [], []
+    for _ in range(trials):
+        offs.append(run_once(False))
+        ons.append(run_once(True))
+    # MEDIAN, not mean: a full averager round is ~130 ms on the tiny
+    # preset, so one stray GC/compile hiccup (hundreds of ms) anywhere
+    # in an interleaved pair would swamp the few-ms contrast being
+    # measured; the median pins the typical round both sides actually
+    # pay
+    off, on = float(np.median(offs)), float(np.median(ons))
+    return {
+        "lineage_rounds": rounds,
+        "lineage_miners": miners,
+        "lineage_records_published": records_published,
+        "lineage_off_s": round(off, 4),
+        "lineage_on_s": round(on, 4),
+        "lineage_overhead_frac": round(max(0.0, on / off - 1.0), 4),
+    }
+
+
 def _param_count(model) -> int:
     abstract = jax.eval_shape(
         lambda: model.init_params(jax.random.PRNGKey(0)))
@@ -1767,6 +1874,14 @@ def main(argv=None) -> None:
         extras.update(_time_flight_overhead())
     except Exception as e:
         extras["flight_overhead_error"] = repr(e)
+
+    try:
+        # lineage-plane cost: production averager rounds with the
+        # provenance record + drift detector per publish vs without
+        # (round-18 tentpole; acceptance < 2%)
+        extras.update(_time_lineage_overhead())
+    except Exception as e:
+        extras["lineage_overhead_error"] = repr(e)
 
     if not degraded:
         try:

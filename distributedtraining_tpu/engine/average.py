@@ -69,6 +69,12 @@ class WeightedAverage:
     # runs instead of materializing a full device stack
     host_list_ingest = True
 
+    def lineage_weights(self, weights):
+        """The merge is linear in these exact normalized weights, so the
+        lineage record is replayable (engine/lineage.py): ``new_base =
+        base + sum_i w_i d_i`` re-derives bit-for-bit from the record."""
+        return weights
+
     def __init__(self, *, uniform: bool = False, chunk_size: int = 8):
         self.uniform = uniform
         self.chunk_size = chunk_size
@@ -149,6 +155,12 @@ class OuterOptMerge:
         """Forward the inner strategy's ingestion preference (the outer
         step itself never touches the stack)."""
         return getattr(self.inner, "host_list_ingest", False)
+
+    def lineage_weights(self, weights):
+        """None: the outer velocity step makes the published base a
+        NON-linear function of this round's deltas (momentum carries
+        prior rounds), so the lineage record is attribution-only."""
+        return None
 
     def __init__(self, inner, *, outer_lr: float = 0.7,
                  momentum: float = 0.9, nesterov: bool = True,
@@ -270,6 +282,17 @@ class ParameterizedMerge:
         # function identity and retrace+recompile the full model fwd+bwd
         # every averaging round
         self._step_cache: dict[int, tuple] = {}
+
+    def lineage_weights(self, weights):
+        """Scalar-per-miner mode mixes linearly in softmax(w) (or w
+        itself when softmax is off), so the record is replayable;
+        per-tensor mode learns one weight per PARAMETER TENSOR — not a
+        scalar mix — and resolves to attribution-only."""
+        if self.per_tensor:
+            return None
+        if self.softmax_weights:
+            return jax.nn.softmax(jnp.asarray(weights))
+        return weights
 
     def _build_step(self, m_pad: int):
         """``base``/``stacked`` flow through every jitted function as
@@ -394,6 +417,11 @@ class GeneticMerge:
         # exist to avoid (they keep the sequential tiers).
         self.batched = batched
         self._pop_evaluator: tuple | None = None  # (engine, evaluator)
+
+    def lineage_weights(self, weights):
+        """The winning vector IS the linear mix applied by merge_fn
+        (``base + sum_i w_i d_i``), so the record is replayable."""
+        return weights
 
     def merge(self, engine, base: Params, stacked: Params, miner_ids: list[str],
               *, val_batches: Callable[[], Iterable[dict]],
@@ -544,7 +572,8 @@ class AveragerLoop:
                  fleet=None,
                  remediation=None,
                  lease=None,
-                 hierarchy: Sequence[str] | None = None):
+                 hierarchy: Sequence[str] | None = None,
+                 lineage=None):
         self.engine = engine
         # fleet health plane (engine/health.py FleetMonitor): polled at
         # the round cadence, fed the EXACT staging outcomes each gather
@@ -564,6 +593,13 @@ class AveragerLoop:
         # tree aggregation (engine/hier_average.py): the configured sub
         # node ids this root gathers aggregates from; None = flat mode
         self.hierarchy = list(hierarchy) if hierarchy else None
+        # provenance plane (engine/lineage.py LineagePlane): every base
+        # publish freezes a content-addressed lineage record — parent
+        # revision, the exact (hotkey, cid, weight, bytes, verdict,
+        # score) set that entered the merge — and feeds the merged
+        # held-out loss to the quality-drift detector. None = no
+        # provenance (the reference posture).
+        self.lineage = lineage
         # agg artifact id -> declared weight sum (meta rider), per round
         self._round_agg_weights: dict[str, float] = {}
         self.transport = transport
@@ -629,6 +665,10 @@ class AveragerLoop:
         # submissions gathered THIS round — the merge span records exactly
         # which artifacts entered each merge (utils/obs.py)
         self._round_cids: dict[str, str] = {}
+        # hotkey -> full StagedDelta of the submissions ACCEPTED this
+        # round (revision/wire_bytes/verdict) — what the lineage record
+        # freezes; matches the merge inputs by construction
+        self._round_staged: dict = {}
 
     # -- multi-host (the averager can span a pod too) -----------------------
     def _multi(self) -> bool:
@@ -674,6 +714,15 @@ class AveragerLoop:
             # (averaging_logic.py:549-568); coordinator-gated on a pod
             self._base_revision = self.transport.publish_base(
                 wire_out(self.engine, template))
+            if self.lineage is not None and self._base_revision:
+                # the DAG root: a genesis record with no parent and no
+                # contributions, so every later revision's chain
+                # terminates at the seed checkpoint instead of dangling
+                self.lineage.on_publish(
+                    kind="base", revision=self._base_revision,
+                    parent=None, round_no=self.report.rounds,
+                    contributions=[], strategy="genesis",
+                    replayable=False, weights_kind="none")
         self.base_params = self.engine.place_params(self.base_params)
         self._base_loss = None   # new base: guard re-evaluates lazily
 
@@ -719,6 +768,7 @@ class AveragerLoop:
         self._round_cids.clear()
         self._round_revisions.clear()
         self._round_agg_weights.clear()
+        self._round_staged.clear()
         if self.hierarchy is not None:
             # root of a tree aggregation: the cohort is the CONFIGURED
             # sub-averager node list (never the metagraph — __agg__.* is
@@ -773,6 +823,7 @@ class AveragerLoop:
                     rejected += 1
                 continue
             ids.append(s.hotkey)
+            self._round_staged[s.hotkey] = s
             deltas.append(wire_in(self.engine, s.delta))
         # only the cids of ACCEPTED deltas annotate the merge records
         self._round_cids = {h: c for h, c in self._round_cids.items()
@@ -799,6 +850,27 @@ class AveragerLoop:
                     return None
             out.append((h, rev))
         return frozenset(out)
+
+    def _record_lineage(self, ids: list[str], weights, consensus,
+                        parent: str | None, loss: float) -> None:
+        """Freeze the just-published revision's provenance record
+        (engine/lineage.py). Isolated: lineage failures degrade
+        provenance, never the round."""
+        try:
+            from . import lineage as lineage_lib
+            w, wkind = lineage_lib.resolve_weights(self.strategy, weights,
+                                                   len(ids))
+            contribs = lineage_lib.contributions_from_staging(
+                ids, w, self._round_staged, consensus=consensus,
+                cids=self._round_cids)
+            self.lineage.on_publish(
+                kind="base", revision=self._base_revision, parent=parent,
+                round_no=self.report.rounds, contributions=contribs,
+                strategy=type(self.strategy).__name__,
+                replayable=w is not None, weights_kind=wkind,
+                loss=loss, parent_loss=self._base_loss)
+        except Exception:
+            logger.exception("averager: lineage record failed")
 
     def _fleet_round_end(self) -> None:
         """SLO evaluation + remediation + ledger flush at the round
@@ -962,22 +1034,31 @@ class AveragerLoop:
                 self.report.rounds += 1
                 return True
         self.report.last_loss = loss
-        if self.metrics:
-            self.metrics.log({"merged_loss": loss, "merged_ppl": ppl,
-                              "accepted": len(ids), "published": 1,
-                              "lease_epoch": (self.lease.epoch
-                                              if self.lease else None),
-                              "merge_delta_ids": dict(self._round_cids)},
-                             step=self.report.rounds)
+        parent_revision = self._base_revision
         from .train import wire_out
         with obs.span("avg.publish", cids=cids):
             self._base_revision = self.transport.publish_base(
                 wire_out(self.engine, merged))
+        if self.metrics:
+            self.metrics.log({"merged_loss": loss, "merged_ppl": ppl,
+                              "accepted": len(ids), "published": 1,
+                              "base_revision": self._base_revision,
+                              "lease_epoch": (self.lease.epoch
+                                              if self.lease else None),
+                              "merge_delta_ids": dict(self._round_cids)},
+                             step=self.report.rounds)
         if self.lease is not None:
             # the publication carries the epoch: the token now names the
             # revision just published under the held epoch
             self.lease.stamp(self._base_revision)
             obs.gauge("avg.lease_epoch", float(self.lease.epoch))
+        if self.lineage is not None:
+            # provenance record for the revision that just landed —
+            # AFTER the lease stamp (single-writer confirmed), BEFORE
+            # the strategy commit; at this point self._base_loss still
+            # holds the PARENT base's eval (None under publish "always")
+            self._record_lineage(ids, weights, consensus,
+                                 parent_revision, loss)
         # round-spanning strategy state (e.g. OuterOptMerge velocity) commits
         # only once the new base is actually out
         commit = getattr(self.strategy, "commit", None)

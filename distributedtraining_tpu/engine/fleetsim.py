@@ -80,6 +80,8 @@ from ..transport.memory import InMemoryTransport
 from ..utils import loadgen, obs
 from ..utils.flight import FlightRecorder, fetch_bundle
 from .health import FleetMonitor, build_heartbeat
+from .lineage import (LineageError, QualityDriftDetector, build_record,
+                      fetch_record, publish_record)
 from .remediate import (LeaseManager, RemediationEngine, StandbyAverager,
                         parse_lease)
 
@@ -773,6 +775,17 @@ class AveragerActor(Actor):
                                              metrics=sim.sink)
         self.quarantine_actions: list[dict] = []
         self._seen_rev: dict[str, str | None] = {}
+        # provenance plane at sim scale: every landed base publish
+        # freezes a REAL lineage record (engine/lineage.py wire bytes,
+        # chaos-gated like everything else) and feeds the held-out
+        # quality signal — mean squared distance to the shared target,
+        # the simulator's oracle for "did the merged model get better"
+        # — to the EWMA/CUSUM drift detector the quality gate reads
+        self.drift = QualityDriftDetector()
+        self.lineage_revisions: list[str] = []
+        self.lineage_publish_failures = 0
+        self.drift_breaches = 0
+        self.quality_trace: list[float] = []
         self.standby_machine = StandbyAverager(
             self, self.lease,
             deadline_s=spec.failover_deadline_rounds * spec.round_s,
@@ -838,20 +851,60 @@ class AveragerActor(Actor):
             staged = self._gather_flat()
             trees = [s.delta for s in staged if s.delta is not None]
             weights = [1.0] * len(trees)
+        parent_rev = self.sim.hub.base_revision()
         if trees:
             total = sum(weights)
             merged = {k: sum(w * t[k] for w, t in zip(weights, trees))
                       / total for k in trees[0]}
             self.base = {k: (self.base[k] + merged[k]).astype(np.float32)
                          for k in self.base}
+        rev = None
         try:
             rev = self.transport.publish_base(self.base)
             self.lease.stamp(rev)
             self.count("sim.base_publishes")
         except OSError:
             self.count("sim.base_publish_faults")
+        if rev is not None:
+            self._record_lineage(rev, parent_rev, staged, weights)
         self.fleet.record_staging(staged)
         self.rounds_completed += 1
+
+    def _record_lineage(self, rev: str, parent_rev: str | None,
+                        staged: list, weights: list[float]) -> None:
+        """The real provenance path at sim weight: a content-addressed
+        record for the landed revision, published through the actor's
+        chaos-gated transport (small-finite retry like the dying-breath
+        postmortem), plus the quality-drift observation."""
+        accepted = [s for s in staged if s.delta is not None]
+        total = sum(weights) or 1.0
+        contribs = [{"hotkey": s.hotkey, "rev": s.revision,
+                     "weight": w / total, "wire_bytes": s.wire_bytes,
+                     "verdict": s.reason}
+                    for s, w in zip(accepted, weights)]
+        record = build_record(
+            kind="base", node=self.hotkey, revision=rev,
+            parent=parent_rev, round_no=self.rounds_completed,
+            contributions=contribs, strategy="weighted",
+            replayable=True, weights_kind="merge",
+            now=self.clock.now())
+        for _ in range(3):
+            if publish_record(self.transport, record):
+                self.count("sim.lineage_publishes")
+                break
+        else:
+            self.lineage_publish_failures += 1
+            self.count("sim.lineage_publish_faults")
+        self.lineage_revisions.append(rev)
+        quality = float(np.mean([
+            np.mean((self.base[k] - self.sim.target[k]) ** 2)
+            for k in self.base]))
+        self.quality_trace.append(quality)
+        breach = self.drift.update(quality)
+        if breach is not None:
+            self.drift_breaches += 1
+            self.count("sim.quality_drift_breaches")
+            self.flight.record("lineage.drift", revision=rev, **breach)
 
     def _observe_fleet(self) -> None:
         try:
@@ -913,6 +966,12 @@ class FleetResult:
     final_lease_epoch: int
     wire_samples: list[dict]
     sim_seconds: float
+    # lineage/quality plane (engine/lineage.py at sim scale)
+    lineage_published: list[str] = dataclasses.field(default_factory=list)
+    lineage_fetchable: int = 0
+    lineage_tampered: int = 0
+    drift_breaches: int = 0
+    quality_trace: list[float] = dataclasses.field(default_factory=list)
 
 
 class FleetSim:
@@ -1127,6 +1186,22 @@ class FleetSim:
                         + self.subs + self.averagers
                         if a.chaos is not None)
         final_lease = parse_lease(self.hub.fetch_delta_meta(lease_id()))
+        # lineage coverage: every UNIQUE revision an averager landed must
+        # have a fetchable record whose content address verifies (the
+        # same survivor-reads-the-store posture as pm coverage)
+        published: list[str] = []
+        for avg in self.averagers:
+            published += avg.lineage_revisions
+        fetchable = tampered = 0
+        for rev in sorted(set(published)):
+            try:
+                if fetch_record(self.hub, rev) is not None:
+                    fetchable += 1
+            except LineageError:
+                tampered += 1
+        quality: list[float] = []
+        for avg in self.averagers:
+            quality += avg.quality_trace
         return FleetResult(
             spec=spec,
             rounds_completed=sum(a.rounds_completed
@@ -1146,7 +1221,12 @@ class FleetSim:
                           if a.is_standby and a.active),
             final_lease_epoch=(final_lease or {}).get("epoch", 0),
             wire_samples=list(self.hub.round_samples),
-            sim_seconds=self.clock.now() - 1_600_000_000.0)
+            sim_seconds=self.clock.now() - 1_600_000_000.0,
+            lineage_published=published,
+            lineage_fetchable=fetchable,
+            lineage_tampered=tampered,
+            drift_breaches=sum(a.drift_breaches for a in self.averagers),
+            quality_trace=quality)
 
     def close(self) -> None:
         if self.closed:
@@ -1178,6 +1258,12 @@ DEFAULT_GATES = {
     "quarantine_precision_min": 0.90,
     "quarantine_recall_min": 0.90,
     "pm_coverage_min": 1.0,
+    # lineage/quality plane (engine/lineage.py): every landed revision
+    # must carry a fetchable, integrity-verified provenance record, and
+    # the merged model's held-out quality may neither CUSUM-drift nor
+    # end the run worse than it started
+    "lineage_coverage_min": 1.0,
+    "quality_drift_breaches_max": 0,
     "serve_min_load_points": 3,
     "serve_ttft_p99_budget_ms": 400.0,   # at the LOWEST offered rate
     # baseline-relative regression caps (only applied with --baseline)
@@ -1283,6 +1369,20 @@ def assemble_scorecard(result: FleetResult,
         },
         "registry": {k: round(float(v), 6)
                      for k, v in sorted(result.registry.items())},
+        "lineage": {
+            "published": len(result.lineage_published),
+            "revisions": len(set(result.lineage_published)),
+            "fetchable": result.lineage_fetchable,
+            "tampered": result.lineage_tampered,
+            "coverage": (result.lineage_fetchable
+                         / len(set(result.lineage_published))
+                         if result.lineage_published else 1.0),
+            "drift_breaches": result.drift_breaches,
+            "quality_first": (round(result.quality_trace[0], 6)
+                              if result.quality_trace else None),
+            "quality_last": (round(result.quality_trace[-1], 6)
+                             if result.quality_trace else None),
+        },
     }
     if control is not None:
         card["parity"] = {
@@ -1348,6 +1448,25 @@ def evaluate_gates(card: dict, *, gates: dict | None = None,
         out["postmortem"] = {"ok": pm["coverage"] >= g["pm_coverage_min"],
                              "coverage": pm["coverage"],
                              "min": g["pm_coverage_min"]}
+    lin = card.get("lineage")
+    if lin and lin["published"]:
+        out["lineage"] = {
+            "ok": (lin["coverage"] >= g["lineage_coverage_min"]
+                   and lin["tampered"] == 0),
+            "coverage": lin["coverage"], "tampered": lin["tampered"],
+            "min": g["lineage_coverage_min"],
+        }
+        improved = (lin["quality_first"] is None
+                    or lin["quality_last"] is None
+                    or lin["quality_last"] <= lin["quality_first"])
+        out["quality"] = {
+            "ok": (lin["drift_breaches"]
+                   <= g["quality_drift_breaches_max"] and improved),
+            "drift_breaches": lin["drift_breaches"],
+            "max_breaches": g["quality_drift_breaches_max"],
+            "quality_first": lin["quality_first"],
+            "quality_last": lin["quality_last"],
+        }
     if spec["poison_miners"]:
         out["hostile"] = {"ok": card["hostile"]["poison_declines"] > 0,
                           "poison_declines":

@@ -431,6 +431,10 @@ class NodeHealth:
     score: float = float("nan")
     score_history: deque = dataclasses.field(
         default_factory=lambda: deque(maxlen=32))
+    # accumulated leave-one-out improvement credit across base revisions
+    # (engine/lineage.py CreditLedger via FleetMonitor.record_credit) —
+    # the attribution twin of ``score`` (which is per-round)
+    credit: float = 0.0
     breaches: list = dataclasses.field(default_factory=list)
     # -- remediation state (engine/remediate.py owns the transitions) --------
     quarantined: bool = False           # dropped from the ingest hotkey set
@@ -454,6 +458,7 @@ class NodeHealth:
             "declined": self.declined, "last_reason": self.last_reason,
             "stale_rounds": self.stale_rounds,
             "wire_bytes": self.wire_bytes, "score": self.score,
+            "credit": round(self.credit, 8),
             "breaches": list(self.breaches),
             # numeric so the exporter can serve dt_fleet_quarantined
             "quarantined": int(self.quarantined),
@@ -780,6 +785,17 @@ class FleetMonitor:
                 node = self.node("miner", hotkey)
                 node.score = float(score)
                 node.score_history.append(float(score))
+
+    def record_credit(self, credits: dict[str, float]) -> None:
+        """Fold the credit ledger's accumulated per-hotkey totals
+        (engine/lineage.py CreditLedger.totals) into the contribution
+        ledger. Same active-node rule as :meth:`record_scores`: a
+        never-seen hotkey with zero credit gets no row."""
+        with self._lock:
+            for hotkey, credit in credits.items():
+                if ("miner", hotkey) not in self.nodes and not credit:
+                    continue
+                self.node("miner", hotkey).credit = float(credit)
 
     def clear_fired(self, role: str, hotkey: str,
                     rule: str | None = None) -> None:

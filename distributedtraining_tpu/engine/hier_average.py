@@ -150,6 +150,7 @@ class SubAverager:
                  wire_spec: dict | None = None,
                  lease=None, metrics=None, fleet=None,
                  retry_policy=None, publish_retry=None, meta_retry=None,
+                 lineage=None,
                  clock: Clock | None = None):
         self.transport = transport
         self.node_id = node_id
@@ -175,6 +176,11 @@ class SubAverager:
         self.retry_policy = retry_policy       # ingest probes/fetches
         self.publish_retry = publish_retry     # aggregate publishes
         self.meta_retry = meta_retry
+        # provenance plane (engine/lineage.py): each published aggregate
+        # freezes an "agg" lineage record — the (hotkey, rev, weight)
+        # slice that entered this fold — so the root's "base" record and
+        # the subs' "agg" records together form the full DAG level
+        self.lineage = lineage
         self.clock = clock or RealClock()
         self.report = SubAveragerReport()
         self._ingestor = None
@@ -260,6 +266,7 @@ class SubAverager:
                                       base_revision=base_revision) \
             if assigned else []
         ids, deltas = [], []
+        staged_by_hotkey = {}
         rejected = 0
         for s in staged:
             if s.delta is None:
@@ -267,6 +274,7 @@ class SubAverager:
                     rejected += 1
                 continue
             ids.append(s.hotkey)
+            staged_by_hotkey[s.hotkey] = s
             deltas.append(s.delta)
         self.report.last_accepted = len(ids)
         self.report.last_rejected = rejected
@@ -321,6 +329,9 @@ class SubAverager:
             obs.count("hier.agg_publishes")
             if self.lease is not None:
                 self.lease.stamp(base_revision)
+            if self.lineage is not None:
+                self._record_lineage(ids, w, staged_by_hotkey,
+                                     base_revision)
         if self.metrics:
             try:
                 self.metrics.log({"subavg_node": self.node_id,
@@ -335,6 +346,39 @@ class SubAverager:
                                  self.node_id)
         self.report.rounds += 1
         return True
+
+    def _record_lineage(self, ids: list[str], w, staged: dict,
+                        base_revision: str | None) -> None:
+        """Freeze the just-published aggregate's "agg" lineage record.
+        The record's revision is the AGGREGATE artifact's revision
+        (probed after the publish — the content address the root will
+        stage), its parent is the base the fold ran against, and its
+        weights are the exact normalized subtree vector, so any
+        validator can re-derive the aggregate (lineage_report --replay).
+        Isolated: lineage failures never fail the round."""
+        try:
+            from . import lineage as lineage_lib
+            try:
+                rev = self.transport.delta_revision(self.artifact_id)
+            except Exception:
+                logger.warning("subavg %s: aggregate revision probe "
+                               "failed; lineage record skipped",
+                               self.node_id, exc_info=True)
+                return
+            if rev is None:
+                return
+            weights = [float(x) for x in np.asarray(w).reshape(-1)]
+            contribs = lineage_lib.contributions_from_staging(
+                ids, weights, staged, consensus=self.consensus())
+            self.lineage.on_publish(
+                kind="agg", revision=rev, parent=base_revision,
+                round_no=self.report.rounds, contributions=contribs,
+                strategy="weighted", replayable=not self.wire_spec
+                or self.wire_spec.get("quant", "none") == "none",
+                weights_kind="merge", artifact=self.artifact_id)
+        except Exception:
+            logger.exception("subavg %s: lineage record failed",
+                             self.node_id)
 
     def run_periodic(self, *, interval: float = 1200.0,
                      rounds: int | None = None) -> int:

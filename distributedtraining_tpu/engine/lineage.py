@@ -1,0 +1,815 @@
+"""Model lineage & contribution attribution: the provenance plane.
+
+The fleet can observe its own health (engine/health.py), bytes
+(docs/wire.md), crashes (utils/flight.py), and FLOPs (utils/devprof.py)
+— but not the one thing the protocol exists to produce: WHICH deltas,
+at WHICH mixing weights, made WHICH base revision, and did the model
+actually get better. The paper's incentive mechanism scores miners by
+measured improvement and the averager's weights decide whose work
+enters the shared base; without a frozen record of those decisions the
+claim "this base came from these contributions" is unauditable, which
+is exactly the surface an adversarial miner exploits (PAPERS.md,
+2606.15870). This module closes the gap with three pieces:
+
+- **lineage records**: on every merge the averager (and each
+  ``__agg__`` sub-averager, engine/hier_average.py) freezes a
+  content-addressed JSON record — parent base revision, the exact
+  ``(hotkey, cid, delta revision, normalized merge weight, wire bytes,
+  screen verdict, validator score)`` set that entered the merge, and
+  the resulting revision — published through the role's existing
+  Transport under the reserved per-revision ``__lineage__.<revision>``
+  id (transport/base.py: signed/chaos/pod-gated like ``__pm__``, but
+  keyed on the RESULT so records are never overwritten). Records chain
+  on ``parent``, forming a provenance DAG rooted at the seed
+  checkpoint; every record also mirrors into the role's metrics JSONL
+  as ``{"lineage": ...}`` so rotated streams keep the full history.
+- **replay audit**: :func:`replay_record` re-derives a revision from
+  its record via the existing ingest + merge programs
+  (engine/ingest.DeltaIngestor staging, delta.aggregate_deltas
+  scatter-add — dense v1 and packed v2 alike) and asserts parity
+  against the published artifact. "Trust the averager" becomes a
+  checkable claim any validator can run: a tampered record, a torn
+  record, a drifted contribution, or a mismatched republished base all
+  fail LOUDLY (``scripts/lineage_report.py --replay`` exits nonzero).
+- **credit attribution + quality drift**: :class:`CreditLedger` folds
+  the batched cohort evals the validator already computes into
+  leave-one-out improvement estimates per revision (under the linear
+  mixing the merge actually performs, ``merged_improvement ≈
+  sum_i w_i * (base_loss - loss_i)`` — each candidate IS base+delta_i,
+  so ``base_loss - loss_i`` is delta_i's measured marginal), exposed
+  as ``dt_lineage_credit{hotkey}`` and fleet_report's ``credit``
+  column. :class:`QualityDriftDetector` runs EWMA+CUSUM over the
+  per-revision held-out loss and arms AnomalyMonitor/FlightRecorder
+  (the closed-vocabulary ``lineage.drift`` event kind) when merged
+  quality regresses — and feeds the fleetsim quality gate
+  (engine/fleetsim.py) so a drift fails the scorecard, not just a
+  human eyeball.
+
+Registry metrics (docs/observability.md): ``lineage.records`` /
+``lineage.publish_failures`` / ``lineage.fetch_errors`` /
+``lineage.tampered`` / ``lineage.replays`` /
+``lineage.replay_failures`` / ``lineage.drift_breaches`` counters,
+``lineage.loss_ewma`` / ``lineage.cusum`` gauges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import math
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..transport import base as tbase
+from ..utils import flight, obs
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+LINEAGE_VERSION = 1
+
+# producer-side serialized-record cap; transport/base.LINEAGE_MAX_BYTES
+# is the consumer-side twin (same number, one contract)
+LINEAGE_MAX_BYTES = tbase.LINEAGE_MAX_BYTES
+
+_MAX_STR = 200
+_MAX_CONTRIBS = 4096
+
+# record kinds: a "base" record's revision is a published base model
+# (replay = parent + sum w_i d_i); an "agg" record's revision is a
+# sub-averager's partial-aggregate delta artifact (replay = sum w_i d_i,
+# no parent add — the parent field records the base CONTEXT the fold
+# ran against, for the DAG join)
+RECORD_KINDS = ("base", "agg")
+
+
+class LineageError(Exception):
+    """A lineage invariant failed loudly (tampered/torn record, drifted
+    contribution, parity mismatch) — the replay audit's failure type."""
+
+
+def record_digest(record: dict) -> str:
+    """Content address of a record: sha256 over the canonical JSON of
+    everything but the id itself and the wall-clock stamp — the same
+    out-of-region rule as fleetsim's scorecard_id, so two records of the
+    same merge differ in exactly ``t``."""
+    body = {k: v for k, v in record.items() if k not in ("record_id", "t")}
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True, default=float).encode()
+    ).hexdigest()[:16]
+
+
+def build_record(*, kind: str, node: str, revision: str,
+                 parent: str | None, round_no: int,
+                 contributions: Sequence[dict],
+                 strategy: str = "weighted",
+                 replayable: bool = True,
+                 weights_kind: str = "merge",
+                 loss: float | None = None,
+                 parent_loss: float | None = None,
+                 artifact: str | None = None,
+                 now: float | None = None) -> dict:
+    """Freeze one merge's provenance. ``contributions`` entries carry
+    ``hotkey``/``rev`` (the staged artifact revision — what replay
+    re-fetches and verifies) plus the audit fields (``cid``, ``weight``,
+    ``wire_bytes``, ``verdict``, ``score``). ``replayable`` declares
+    whether ``weight`` is the EXACT linear mixing weight the merge used
+    (WeightedAverage/GeneticMerge — replay re-derives the revision) or
+    an attribution-only estimate (``weights_kind="consensus"`` for
+    opaque strategies like OuterOptMerge's momentum step)."""
+    if kind not in RECORD_KINDS:
+        raise ValueError(f"kind must be one of {RECORD_KINDS}, got {kind!r}")
+    contribs = []
+    for c in list(contributions)[:_MAX_CONTRIBS]:
+        entry = {"hotkey": str(c["hotkey"])[:_MAX_STR]}
+        for key in ("cid", "rev"):
+            v = c.get(key)
+            if isinstance(v, str) and v:
+                entry[key] = v[:_MAX_STR]
+        w = c.get("weight")
+        entry["weight"] = (round(float(w), 10)
+                           if isinstance(w, (int, float))
+                           and math.isfinite(float(w)) else None)
+        wb = c.get("wire_bytes")
+        if isinstance(wb, (int, float)):
+            entry["wire_bytes"] = int(wb)
+        for key in ("verdict", "tier"):
+            v = c.get(key)
+            if isinstance(v, str) and v:
+                entry[key] = v[:_MAX_STR]
+        s = c.get("score")
+        if isinstance(s, (int, float)) and math.isfinite(float(s)):
+            entry["score"] = round(float(s), 8)
+        contribs.append(entry)
+    record: dict[str, Any] = {
+        "lineage": LINEAGE_VERSION,
+        "kind": kind,
+        "node": str(node)[:_MAX_STR],
+        "revision": str(revision)[:_MAX_STR],
+        "parent": (str(parent)[:_MAX_STR] if parent else None),
+        "round": int(round_no),
+        "strategy": str(strategy)[:_MAX_STR],
+        "replayable": bool(replayable),
+        "weights_kind": str(weights_kind)[:_MAX_STR],
+        "contributions": contribs,
+    }
+    if artifact:
+        # the wire artifact id the revision was probed from ("agg"
+        # records: the __agg__.<node> slot the root stages) — what the
+        # replay audit re-fetches; "base" records need none (the base
+        # slot is singular)
+        record["artifact"] = str(artifact)[:_MAX_STR]
+    if loss is not None and math.isfinite(float(loss)):
+        record["loss"] = float(loss)
+    if parent_loss is not None and math.isfinite(float(parent_loss)):
+        record["parent_loss"] = float(parent_loss)
+    record["record_id"] = record_digest(record)
+    record["t"] = float(now if now is not None else time.time())
+    return record
+
+
+def parse_record(data) -> dict | None:
+    """Defensive consumer read of a PEER-CONTROLLED record (bytes or an
+    already-decoded dict): size-capped, versioned, kind/revision
+    validated, contributions re-screened field by field. Returns a
+    normalized dict or None; never raises — integrity (the content
+    address) is :func:`fetch_record`'s job, shape is this one's."""
+    if isinstance(data, (bytes, bytearray)):
+        if len(data) > LINEAGE_MAX_BYTES:
+            return None
+        try:
+            data = json.loads(data)
+        except (ValueError, UnicodeDecodeError):
+            return None
+    if not isinstance(data, dict):
+        return None
+    v = data.get("lineage")
+    if not isinstance(v, (int, float)) or int(v) < 1:
+        return None
+    if data.get("kind") not in RECORD_KINDS:
+        return None
+    rev = data.get("revision")
+    if not (isinstance(rev, str) and 0 < len(rev) <= _MAX_STR):
+        return None
+    parent = data.get("parent")
+    if parent is not None and not (isinstance(parent, str)
+                                   and 0 < len(parent) <= _MAX_STR):
+        return None
+    out: dict[str, Any] = {
+        "lineage": int(v), "kind": data["kind"],
+        "node": str(data.get("node", ""))[:_MAX_STR],
+        "revision": rev, "parent": parent,
+        "round": int(data["round"]) if isinstance(data.get("round"),
+                                                  (int, float)) else 0,
+        "strategy": str(data.get("strategy", ""))[:_MAX_STR],
+        "replayable": bool(data.get("replayable")),
+        "weights_kind": str(data.get("weights_kind", ""))[:_MAX_STR],
+    }
+    art = data.get("artifact")
+    if isinstance(art, str) and 0 < len(art) <= _MAX_STR:
+        out["artifact"] = art
+    contribs = []
+    raw = data.get("contributions")
+    if not isinstance(raw, list):
+        return None
+    for c in raw[:_MAX_CONTRIBS]:
+        if not (isinstance(c, dict) and isinstance(c.get("hotkey"), str)
+                and c["hotkey"]):
+            return None   # a record with malformed contributions is torn
+        entry: dict[str, Any] = {"hotkey": c["hotkey"][:_MAX_STR]}
+        for key in ("cid", "rev", "verdict", "tier"):
+            cv = c.get(key)
+            if isinstance(cv, str) and cv:
+                entry[key] = cv[:_MAX_STR]
+        w = c.get("weight")
+        entry["weight"] = (float(w) if isinstance(w, (int, float))
+                           and math.isfinite(float(w)) else None)
+        wb = c.get("wire_bytes")
+        if isinstance(wb, (int, float)) and math.isfinite(float(wb)):
+            # kept an INT so the canonical JSON (and therefore the
+            # content address) round-trips through parse unchanged
+            entry["wire_bytes"] = int(wb)
+        sc = c.get("score")
+        if isinstance(sc, (int, float)) and math.isfinite(float(sc)):
+            entry["score"] = float(sc)
+        contribs.append(entry)
+    out["contributions"] = contribs
+    for key in ("loss", "parent_loss", "t"):
+        cv = data.get(key)
+        if isinstance(cv, (int, float)) and math.isfinite(float(cv)):
+            out[key] = float(cv)
+    if data.get("truncated") is True:
+        # participates in the content address (publish_record re-stamps
+        # after truncation), so parse must round-trip it
+        out["truncated"] = True
+    rid = data.get("record_id")
+    if isinstance(rid, str) and 0 < len(rid) <= 64:
+        out["record_id"] = rid
+    return out
+
+
+def publish_record(transport, record: dict) -> bool:
+    """Ship one record through the Transport (reserved per-revision
+    ``__lineage__`` id) and the metrics sink. Never raises — provenance
+    must degrade, not take the merge down with it. Oversized records
+    truncate their contribution TAIL to fit (weights of the head are
+    the audit-critical part; a >4096-miner merge is already summarized
+    by the wire/ledger planes)."""
+    sink = obs.current_sink()
+    if sink is not None:
+        try:
+            sink.log({"lineage": record})
+        except Exception:
+            logger.exception("lineage: record sink emit failed")
+    if transport is None:
+        return False
+    data = json.dumps(record, default=float).encode()
+    while len(data) > LINEAGE_MAX_BYTES and record["contributions"]:
+        drop = max(1, len(record["contributions"]) // 4)
+        record = dict(record,
+                      contributions=record["contributions"][:-drop],
+                      truncated=True)
+        record["record_id"] = record_digest(record)
+        data = json.dumps(record, default=float).encode()
+    try:
+        tbase.publish_lineage(transport, record["revision"], data)
+        obs.count("lineage.records")
+        logger.info("lineage: published record %s for revision %s "
+                    "(%d contributions)", record["record_id"],
+                    record["revision"], len(record["contributions"]))
+        return True
+    except Exception:
+        obs.count("lineage.publish_failures")
+        logger.warning("lineage: record publish failed for revision %s; "
+                       "the record survives in the metrics sink",
+                       record.get("revision"), exc_info=True)
+        return False
+
+
+def fetch_record(transport, revision: str, *, verify: bool = True) -> dict | None:
+    """Fetch + validate one revision's lineage record. Returns None when
+    absent or unparseable; raises :class:`LineageError` when ``verify``
+    and the record's content address does not match its body — a
+    tampered record must fail LOUDLY at the audit boundary, never read
+    as merely absent."""
+    from .. import signing
+    try:
+        data = tbase.fetch_lineage_bytes(transport, revision)
+    except Exception:
+        obs.count("lineage.fetch_errors")
+        logger.warning("lineage: record fetch failed for %s", revision,
+                       exc_info=True)
+        return None
+    if data is None:
+        return None
+    rec = parse_record(signing.strip_envelope(data))
+    if rec is None:
+        if verify:
+            obs.count("lineage.tampered")
+            raise LineageError(
+                f"lineage record for {revision!r} is present but torn "
+                "or unparseable")
+        return None
+    if verify:
+        if rec.get("record_id") != record_digest(rec):
+            obs.count("lineage.tampered")
+            raise LineageError(
+                f"lineage record for {revision!r} fails its content "
+                f"address ({rec.get('record_id')} != "
+                f"{record_digest(rec)}) — tampered or corrupt")
+        if rec["revision"] != revision:
+            obs.count("lineage.tampered")
+            raise LineageError(
+                f"lineage record under {revision!r} names revision "
+                f"{rec['revision']!r} — misfiled or tampered")
+    return rec
+
+
+def walk_chain(transport, revision: str, *, max_depth: int = 256
+               ) -> list[dict]:
+    """Follow ``parent`` links from ``revision`` toward the seed
+    checkpoint, newest first, stopping at the first absent record (older
+    history lives in the JSONL mirrors). Tampered links raise — a DAG
+    walk is an audit, not a best-effort render."""
+    out: list[dict] = []
+    seen: set[str] = set()
+    rev: str | None = revision
+    while rev is not None and len(out) < max_depth and rev not in seen:
+        seen.add(rev)
+        rec = fetch_record(transport, rev)
+        if rec is None:
+            break
+        out.append(rec)
+        rev = rec.get("parent")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Merge-weight resolution (what makes a record replayable)
+# ---------------------------------------------------------------------------
+
+def resolve_weights(strategy, weights, m: int
+                    ) -> tuple[list[float] | None, str]:
+    """(per-miner linear mixing weights, weights_kind) for a strategy's
+    ``merge()`` return. Strategies that mix linearly declare it via a
+    ``lineage_weights(weights)`` method (engine/average.py); anything
+    else — per-tensor meta-learned weights, the outer-momentum step —
+    resolves to (None, "opaque") and the record is attribution-only."""
+    fn = getattr(strategy, "lineage_weights", None)
+    if fn is None:
+        return None, "opaque"
+    try:
+        w = fn(weights)
+    except Exception:
+        logger.exception("lineage: strategy weight resolution failed")
+        return None, "opaque"
+    if w is None:
+        return None, "opaque"
+    arr = np.asarray(w, np.float64).reshape(-1)
+    if arr.shape[0] != m or not np.all(np.isfinite(arr)):
+        return None, "opaque"
+    return [float(x) for x in arr], "merge"
+
+
+def contributions_from_staging(ids: Sequence[str], weights, staged: dict,
+                               consensus: dict | None = None,
+                               cids: dict | None = None) -> list[dict]:
+    """Build the record's contribution list from a round's accepted ids,
+    the resolved (or None) weight vector, and the per-hotkey StagedDelta
+    map the ingest produced — the merge's inputs, by construction."""
+    out = []
+    for i, h in enumerate(ids):
+        s = staged.get(h)
+        entry: dict[str, Any] = {
+            "hotkey": h,
+            "weight": (weights[i] if weights is not None
+                       and i < len(weights) else None),
+            "verdict": getattr(s, "reason", None) or "ok",
+        }
+        rev = getattr(s, "revision", None)
+        if rev:
+            entry["rev"] = rev
+        cid = (cids or {}).get(h) or getattr(s, "cid", None)
+        if cid:
+            entry["cid"] = cid
+        wb = getattr(s, "wire_bytes", None)
+        if wb is not None:
+            entry["wire_bytes"] = int(wb)
+        if consensus and h in consensus:
+            entry["score"] = float(consensus[h])
+        aw = getattr(s, "agg_weight", None)
+        if aw is not None:
+            entry["tier"] = "agg"
+        out.append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Quality-drift detection (EWMA + CUSUM over per-revision held-out loss)
+# ---------------------------------------------------------------------------
+
+class QualityDriftDetector:
+    """One-sided CUSUM over the deviation of each published revision's
+    held-out loss from its own EWMA: ``cusum += max(0, loss - ewma -
+    slack)``, breach when the accumulation exceeds ``threshold``. The
+    EWMA absorbs the slow convergence trend; the slack absorbs eval
+    noise; a genuine regression (a poisoned merge that slipped the
+    screens, a bad outer step) accumulates round over round and fires
+    within a few revisions — the statistical twin of the publish guard,
+    catching the drifts a per-round <= check cannot (many small
+    worsenings under the epsilon, or a guard running in "always"
+    mode)."""
+
+    def __init__(self, *, alpha: float = 0.25, slack: float = 0.02,
+                 threshold: float = 0.25, warmup: int = 2):
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {threshold}")
+        self.alpha = alpha
+        self.slack = slack
+        self.threshold = threshold
+        self.warmup = max(0, int(warmup))
+        self.ewma: float | None = None
+        self.cusum = 0.0
+        self.observed = 0
+        self.breaches = 0
+
+    def update(self, loss: float) -> dict | None:
+        """Fold one published revision's held-out loss; returns a breach
+        dict (reason + the numbers that decided it) or None. A
+        non-finite loss breaches immediately — NaN is never noise."""
+        loss = float(loss)
+        self.observed += 1
+        if not math.isfinite(loss):
+            self.breaches += 1
+            return {"reason": "nonfinite_loss", "loss": loss,
+                    "observed": self.observed}
+        if self.ewma is None:
+            self.ewma = loss
+            return None
+        dev = loss - self.ewma - self.slack
+        self.cusum = max(0.0, self.cusum + dev)
+        # the EWMA updates AFTER the deviation is measured, so a step
+        # regression cannot immediately pull its own reference up
+        self.ewma += self.alpha * (loss - self.ewma)
+        obs.gauge("lineage.loss_ewma", self.ewma)
+        obs.gauge("lineage.cusum", self.cusum)
+        if self.observed <= self.warmup:
+            return None
+        if self.cusum > self.threshold:
+            self.breaches += 1
+            fired = {"reason": "quality_drift", "loss": loss,
+                     "ewma": round(self.ewma, 6),
+                     "cusum": round(self.cusum, 6),
+                     "threshold": self.threshold,
+                     "observed": self.observed}
+            self.cusum = 0.0   # re-arm: a persisting drift re-fires
+            return fired
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Credit attribution (leave-one-out improvement per revision)
+# ---------------------------------------------------------------------------
+
+def loo_credits(base_loss: float, scored: Sequence) -> dict[str, float]:
+    """Per-miner leave-one-out improvement estimates from one validation
+    round's cohort evals. Each candidate the batched evaluator scored IS
+    ``base + delta_i``, so ``base_loss - loss_i`` is delta_i's measured
+    marginal improvement in isolation; under the linear mixing the merge
+    performs, removing miner i from the merge forfeits ``w_i *
+    marginal_i``, with ``w_i`` the same clamped-normalized score weights
+    the averager's consensus merge uses (delta.normalized_merge_weights'
+    rule). ``scored`` entries need ``hotkey``/``loss``/``score``
+    attributes (engine/validate.MinerScore)."""
+    if base_loss is None or not math.isfinite(float(base_loss)):
+        return {}
+    rows = [(s.hotkey, float(s.loss), max(float(s.score), 0.0))
+            for s in scored
+            if s.loss is not None and math.isfinite(float(s.loss))]
+    if not rows:
+        return {}
+    total = sum(w for _, _, w in rows)
+    m = len(rows)
+    return {h: ((w / total) if total > 0 else 1.0 / m)
+            * (float(base_loss) - loss)
+            for h, loss, w in rows}
+
+
+class CreditLedger:
+    """Accumulates per-revision LOO credit into a per-miner total: ONE
+    estimate per (revision, hotkey) — re-validating the same base
+    revision REPLACES that revision's contribution instead of
+    double-counting it, so a long-lived base polled every round does not
+    inflate anyone's credit. History is bounded (``max_revisions``);
+    evicted revisions' contributions stay in the totals (the ledger is
+    cumulative, the per-revision detail is what ages out)."""
+
+    def __init__(self, *, max_revisions: int = 64):
+        self.max_revisions = max(1, int(max_revisions))
+        self._by_rev: dict[str, dict[str, float]] = {}
+        self._order: list[str] = []
+        self._settled: dict[str, float] = {}   # evicted revisions' mass
+
+    def update(self, revision: str | None, base_loss: float | None,
+               scored: Sequence) -> dict[str, float]:
+        """Fold one validation round; returns the per-miner credits
+        attributed to ``revision`` this round."""
+        credits = loo_credits(base_loss, scored)
+        if not credits:
+            return {}
+        rev = revision or "?"
+        if rev not in self._by_rev:
+            self._order.append(rev)
+            while len(self._order) > self.max_revisions:
+                old = self._order.pop(0)
+                for h, c in self._by_rev.pop(old, {}).items():
+                    self._settled[h] = self._settled.get(h, 0.0) + c
+        self._by_rev[rev] = dict(credits)
+        return credits
+
+    def totals(self) -> dict[str, float]:
+        out = dict(self._settled)
+        for per_rev in self._by_rev.values():
+            for h, c in per_rev.items():
+                out[h] = out.get(h, 0.0) + c
+        return out
+
+    def revisions(self) -> list[str]:
+        return list(self._order)
+
+
+# ---------------------------------------------------------------------------
+# The plane (what the averager/sub-averager loops hold)
+# ---------------------------------------------------------------------------
+
+class LineagePlane:
+    """Bundles record publication + drift detection + forensics arming
+    for one merge-publishing role. Every entry point is isolated: a
+    lineage failure degrades provenance, never the round."""
+
+    def __init__(self, transport, *, node: str = "averager",
+                 drift: QualityDriftDetector | None = None,
+                 anomaly=None, clock: Callable[[], float] = time.time):
+        self.transport = transport
+        self.node = node
+        self.drift = drift if drift is not None else QualityDriftDetector()
+        self.anomaly = anomaly
+        self.clock = clock
+        self.records = 0
+        self.drift_breaches = 0
+        self.last_record: dict | None = None
+
+    def on_publish(self, *, kind: str, revision: str, parent: str | None,
+                   round_no: int, contributions: Sequence[dict],
+                   strategy: str = "weighted", replayable: bool = True,
+                   weights_kind: str = "merge",
+                   loss: float | None = None,
+                   parent_loss: float | None = None,
+                   artifact: str | None = None) -> dict | None:
+        """Freeze + publish the record for one landed merge, feed the
+        drift detector, and arm the forensics planes on a breach.
+        Returns the record (published or sink-only) or None on total
+        failure; never raises."""
+        try:
+            record = build_record(
+                kind=kind, node=self.node, revision=revision,
+                parent=parent, round_no=round_no,
+                contributions=contributions, strategy=strategy,
+                replayable=replayable, weights_kind=weights_kind,
+                loss=loss, parent_loss=parent_loss, artifact=artifact,
+                now=self.clock())
+            publish_record(self.transport, record)
+            self.records += 1
+            self.last_record = record
+            flight.record("lineage.record", revision=revision,
+                          parent=parent, record_id=record["record_id"],
+                          miners=float(len(record["contributions"])),
+                          round=float(round_no))
+            if loss is not None and kind == "base":
+                self._observe_quality(revision, loss)
+            return record
+        except Exception:
+            logger.exception("lineage: on_publish failed for revision %s",
+                             revision)
+            return None
+
+    def _observe_quality(self, revision: str, loss: float) -> None:
+        breach = self.drift.update(loss)
+        if breach is None:
+            return
+        self.drift_breaches += 1
+        obs.count("lineage.drift_breaches")
+        flight.record("lineage.drift", revision=revision, **breach)
+        logger.warning("lineage: merged-model quality drift on %s: %s",
+                       revision, breach)
+        if self.anomaly is not None:
+            try:
+                self.anomaly.trigger_external("lineage_drift",
+                                              revision=revision, **breach)
+            except Exception:
+                logger.exception("lineage: anomaly arm failed")
+        # the breach is a forensic moment: freeze the ring so the
+        # revisions/weights that led into the drift are retrievable
+        # even if the role dies before anyone looks
+        flight.freeze_and_publish("lineage_drift")
+
+
+# ---------------------------------------------------------------------------
+# Replay audit
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ReplayResult:
+    """One replay audit's verdict."""
+    revision: str
+    ok: bool
+    reason: str                      # "parity" when ok
+    max_abs_diff: float = float("nan")
+    problems: list = dataclasses.field(default_factory=list)
+    contributions: int = 0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _tree_max_abs_diff(a, b) -> float:
+    import jax
+    worst = 0.0
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        raise LineageError(f"replay structure mismatch: {len(la)} vs "
+                           f"{len(lb)} leaves")
+    for x, y in zip(la, lb):
+        x = np.asarray(jax.device_get(x), np.float64)
+        y = np.asarray(jax.device_get(y), np.float64)
+        if x.shape != y.shape:
+            raise LineageError(f"replay shape mismatch: {x.shape} vs "
+                               f"{y.shape}")
+        if x.size:
+            worst = max(worst, float(np.max(np.abs(x - y))))
+    return worst
+
+
+def replay_record(transport, record: dict, template, *,
+                  parent: Params | None = None,
+                  target: Params | None = None,
+                  tol: float = 1e-6,
+                  ingest_workers: int = 1) -> ReplayResult:
+    """Re-derive ``record``'s revision from its contributions via the
+    existing ingest + merge programs and assert parity against the
+    published artifact.
+
+    - integrity: the record must match its content address (callers
+      using :func:`fetch_record` already verified; a hand-loaded record
+      re-verifies here);
+    - contributions: each ``(hotkey, rev)`` is re-staged through
+      :class:`~.ingest.DeltaIngestor` (same decode, same screens, v1
+      dense and v2 packed alike, packed kept packed) and must still be
+      the EXACT artifact the record named — a drifted or missing
+      contribution fails the audit;
+    - merge: ``delta.aggregate_deltas`` folds the staged set at the
+      recorded weights (one accumulator, record order); a "base" record
+      adds the fold onto ``parent`` (required), an "agg" record IS the
+      fold;
+    - parity: max |replayed - target| must be <= ``tol``. ``target``
+      defaults to the transport's CURRENT artifact for the recorded id
+      — and the transport must still NAME that revision, so a
+      republished (mismatched) base fails loudly instead of silently
+      comparing against someone else's bytes.
+
+    Raises :class:`LineageError` on any audit failure (loud by
+    contract); returns a :class:`ReplayResult` with the parity verdict.
+    """
+    from .. import delta as delta_lib
+    from .ingest import DeltaIngestor
+
+    obs.count("lineage.replays")
+    try:
+        rec = parse_record(record)
+        if rec is None:
+            raise LineageError("record is torn or unparseable")
+        if rec.get("record_id") != record_digest(rec):
+            obs.count("lineage.tampered")
+            raise LineageError(
+                f"record {rec.get('record_id')} fails its content "
+                f"address ({record_digest(rec)}) — tampered or corrupt")
+        if not rec["replayable"] or rec["weights_kind"] != "merge":
+            raise LineageError(
+                f"record for {rec['revision']} is not replayable "
+                f"(strategy {rec['strategy']!r}, weights "
+                f"{rec['weights_kind']!r}) — attribution only")
+        contribs = rec["contributions"]
+        if not contribs:
+            raise LineageError("record has no contributions to replay "
+                               "(genesis records are roots, not merges)")
+        problems: list[str] = []
+        for c in contribs:
+            if not c.get("rev"):
+                problems.append(f"{c['hotkey']}: no recorded revision")
+            if c.get("weight") is None:
+                problems.append(f"{c['hotkey']}: no recorded weight")
+        if problems:
+            raise LineageError("record is incomplete: "
+                               + "; ".join(problems))
+
+        ing = DeltaIngestor(transport, template, workers=ingest_workers,
+                            max_delta_abs=None, stale_deltas="accept",
+                            span_prefix="replay", densify=False)
+        try:
+            staged = {s.hotkey: s
+                      for s in ing.stage([c["hotkey"] for c in contribs])}
+        finally:
+            ing.close()
+        deltas, weights = [], []
+        for c in contribs:
+            s = staged.get(c["hotkey"])
+            if s is None or s.delta is None:
+                problems.append(
+                    f"{c['hotkey']}: contribution not stageable "
+                    f"({getattr(s, 'reason', 'missing')})")
+                continue
+            if s.revision != c["rev"]:
+                problems.append(
+                    f"{c['hotkey']}: artifact drifted "
+                    f"({s.revision} != recorded {c['rev']})")
+                continue
+            deltas.append(s.delta)
+            weights.append(float(c["weight"]))
+        if problems:
+            raise LineageError("contribution audit failed: "
+                               + "; ".join(problems))
+
+        agg = delta_lib.aggregate_deltas(template, deltas,
+                                         np.asarray(weights, np.float32))
+        if rec["kind"] == "base":
+            if parent is None:
+                raise LineageError(
+                    "replaying a base record needs the parent base "
+                    f"params (revision {rec['parent']}) — pass --parent")
+            import jax
+            derived = jax.tree_util.tree_map(
+                lambda b, a: np.asarray(b)
+                + np.asarray(jax.device_get(a)).astype(
+                    np.asarray(b).dtype), parent, agg)
+            if target is None:
+                current = transport.base_revision()
+                if current != rec["revision"]:
+                    raise LineageError(
+                        f"published base is {current}, record names "
+                        f"{rec['revision']} — republished or superseded; "
+                        "pass --target to audit an archived artifact")
+                got = transport.fetch_base(template)
+                if got is None:
+                    raise LineageError("published base unreadable")
+                target = got[0]
+        else:
+            derived = agg
+            artifact_id = rec.get("artifact") or rec["node"]
+            if target is None:
+                current = transport.delta_revision(artifact_id)
+                if current != rec["revision"]:
+                    raise LineageError(
+                        f"aggregate {artifact_id} is {current}, record "
+                        f"names {rec['revision']} — superseded; pass "
+                        "--target to audit an archived artifact")
+                # through the ingest front-end: a v2 shard-manifest
+                # aggregate (wire_spec=True) decodes the same way the
+                # root would decode it
+                ing = DeltaIngestor(transport, template,
+                                    workers=ingest_workers,
+                                    max_delta_abs=None,
+                                    stale_deltas="accept",
+                                    span_prefix="replay")
+                try:
+                    got = ing.stage([artifact_id])[0]
+                finally:
+                    ing.close()
+                if got.delta is None:
+                    raise LineageError(
+                        f"aggregate {artifact_id} unreadable "
+                        f"({got.reason})")
+                target = got.delta
+        import jax
+        if delta_lib.is_packed_v2(derived):
+            derived = delta_lib.densify_packed_v2(
+                jax.device_get(derived), template)
+        diff = _tree_max_abs_diff(derived, target)
+        if not (diff <= tol):
+            raise LineageError(
+                f"replay parity FAILED for {rec['revision']}: "
+                f"max |replayed - published| = {diff:.3e} > {tol:g} — "
+                "the published artifact is not the recorded merge")
+        return ReplayResult(revision=rec["revision"], ok=True,
+                            reason="parity", max_abs_diff=diff,
+                            contributions=len(contribs))
+    except LineageError:
+        obs.count("lineage.replay_failures")
+        raise

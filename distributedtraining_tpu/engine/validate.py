@@ -129,6 +129,14 @@ class Validator:
         self._base_revision = None
         self.base_loss: float | None = None
         self.base_ppl: float | None = None
+        # per-miner contribution credit (engine/lineage.py CreditLedger):
+        # each round's cohort evals fold into leave-one-out improvement
+        # estimates per base revision — ONE estimate per (revision,
+        # hotkey), re-validation of an unchanged base replaces rather
+        # than double-counts — surfaced as dt_lineage_credit{hotkey}
+        # (utils/obs_http.py) and fleet_report's credit column
+        from .lineage import CreditLedger
+        self.credit = CreditLedger()
         self._warned_no_permit = False
         # hotkey -> correlation id of the artifact staged THIS round (from
         # the delta's meta rider, utils/obs.py) — written by the staging
@@ -429,6 +437,15 @@ class Validator:
         else:
             results = [self.score_miner(h) for h in others]
         scored = {s.hotkey: s.score for s in results}
+        # leave-one-out credit attribution for THIS base revision, from
+        # the cohort evals just computed (engine/lineage.py); isolated —
+        # attribution must never fail a scoring round
+        round_credits: dict[str, float] = {}
+        try:
+            round_credits = self.credit.update(self._base_revision,
+                                               self.base_loss, results)
+        except Exception:
+            logger.exception("validator: credit attribution failed")
         if self.remediation is not None:
             # quarantined miners' scores decay toward zero instead of the
             # chain EMA holding their pre-breach weight (the "scores
@@ -437,6 +454,7 @@ class Validator:
         if self.fleet is not None:
             try:
                 self.fleet.record_scores(scored)
+                self.fleet.record_credit(self.credit.totals())
                 breaches = self.fleet.evaluate_slos()
                 if self.remediation is not None:
                     self.remediation.observe_round(breaches)
@@ -472,7 +490,8 @@ class Validator:
                 "round_scores": {
                     s.hotkey: {"score": s.score, "loss": s.loss,
                                "reason": s.reason,
-                               "cid": self._round_cids.get(s.hotkey)}
+                               "cid": self._round_cids.get(s.hotkey),
+                               "credit": round_credits.get(s.hotkey)}
                     for s in results},
             }, step=self._round)
             # periodic registry flush (span histograms, stage/eval timing,

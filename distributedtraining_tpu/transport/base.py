@@ -93,6 +93,27 @@ PM_PREFIX = "__pm__"
 # is the producer-side truncation bound; same number, one contract)
 PM_MAX_BYTES = 1 << 20
 
+# Lineage records (engine/lineage.py): every time the averager (or a
+# sub-averager) lands a merge, it freezes a content-addressed JSON
+# record — parent base revision, the exact (hotkey, cid, weight,
+# wire bytes, verdict, score) set that entered the merge, and the
+# resulting revision — published under a reserved PER-REVISION id
+# through the SAME byte surface deltas use (publish_delta_raw when the
+# transport offers it, so a signed fleet's provenance is attributable;
+# chaos-gated; coordinator-gated on pods). Unlike the __pm__/__hb__
+# slots, the id is keyed on the RESULTING revision, so records are
+# never overwritten: together they form the provenance DAG rooted at
+# the seed checkpoint, and any validator can fetch a revision's record
+# and re-derive the merge (`scripts/lineage_report.py --replay`).
+# Records are tiny (KBs) — the storage bound is the record cap below,
+# not the overwrite rule.
+LINEAGE_PREFIX = "__lineage__"
+
+# consumer-side size cap for one lineage record read (the producer
+# truncates contributions to fit; same number, one contract —
+# engine/lineage.LINEAGE_MAX_BYTES mirrors it)
+LINEAGE_MAX_BYTES = 1 << 18
+
 
 def heartbeat_id(role: str, node_id: str) -> str:
     """The reserved per-node artifact id heartbeats publish under.
@@ -159,6 +180,28 @@ def is_pm_id(artifact_id: str) -> bool:
         artifact_id.startswith(PM_PREFIX + ".")
 
 
+def lineage_slug(revision: str) -> str:
+    """Filename/id-safe spelling of an opaque revision string, injective
+    by the same percent-escape rule as :func:`shard_layer_slug` (a
+    revision from a commit-SHA or content-hash transport is already
+    safe; the escape covers exotic backends)."""
+    return (str(revision).replace("%", "%25").replace(".", "%2E")
+            .replace("/", "%2F"))
+
+
+def lineage_id(revision: str) -> str:
+    """The reserved artifact id the lineage record for ``revision``
+    publishes under. Keyed on the RESULTING revision (never overwritten
+    — each merge's record is a new DAG node), unlike the per-node
+    heartbeat/postmortem slots."""
+    return f"{LINEAGE_PREFIX}.{lineage_slug(revision)}"
+
+
+def is_lineage_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(LINEAGE_PREFIX + ".")
+
+
 def is_reserved_id(artifact_id: str) -> bool:
     """True for any id in the reserved control-plane/shard/aggregate/
     postmortem namespace (heartbeats, leases, wire-v2 shards, partial
@@ -171,7 +214,8 @@ def is_reserved_id(artifact_id: str) -> bool:
         or artifact_id.startswith(LEASE_PREFIX + ".")
         or artifact_id.startswith(SHARD_PREFIX + ".")
         or artifact_id.startswith(AGG_PREFIX + ".")
-        or artifact_id.startswith(PM_PREFIX + "."))
+        or artifact_id.startswith(PM_PREFIX + ".")
+        or artifact_id.startswith(LINEAGE_PREFIX + "."))
 
 
 def publish_postmortem(transport, role: str, node_id: str,
@@ -194,6 +238,30 @@ def fetch_postmortem_bytes(transport, role: str,
     utils/flight.fetch_bundle, the same split as delta reads."""
     data = transport.fetch_delta_bytes(pm_id(role, node_id))
     if data is not None and len(data) > PM_MAX_BYTES:
+        return None
+    return data
+
+
+def publish_lineage(transport, revision: str, data: bytes) -> None:
+    """Publish one lineage record's bytes under the reserved per-revision
+    id. Prefers ``publish_delta_raw`` (SignedTransport envelopes it under
+    the delta context — a signed fleet's provenance is attributable),
+    falling back to ``publish_raw`` on plain transports — the exact
+    split :func:`publish_postmortem` uses."""
+    pdr = getattr(transport, "publish_delta_raw", None)
+    if pdr is not None:
+        pdr(lineage_id(revision), data)
+        return
+    transport.publish_raw(lineage_id(revision), data)
+
+
+def fetch_lineage_bytes(transport, revision: str) -> bytes | None:
+    """Raw (possibly enveloped, size-capped) lineage record bytes for one
+    revision, or None — validation, envelope-stripping, and the content-
+    address check live in engine/lineage.fetch_record, the same split as
+    postmortem reads."""
+    data = transport.fetch_delta_bytes(lineage_id(revision))
+    if data is not None and len(data) > LINEAGE_MAX_BYTES:
         return None
     return data
 

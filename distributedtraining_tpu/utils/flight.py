@@ -92,6 +92,10 @@ EVENT_KINDS: dict[str, str] = {
     "heartbeat": "heartbeat sent (own) or fresh beat observed (fleet)",
     "remediation": "quarantine / probation / readmission action",
     "crash": "unhandled exception or process-exit capture",
+    "lineage.record": "a merge's provenance record frozen/published "
+                      "(engine/lineage.py)",
+    "lineage.drift": "merged-model quality drift detected by the "
+                     "EWMA/CUSUM detector (engine/lineage.py)",
     "note": "free-form operator/debug annotation",
 }
 

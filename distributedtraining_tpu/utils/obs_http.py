@@ -88,6 +88,9 @@ _FLEET_SERIES = (
     ("stale_rounds", "fleet_stale_rounds",
      "rounds since the delta revision changed"),
     ("score", "fleet_score", "latest validator score"),
+    ("credit", "lineage_credit",
+     "accumulated leave-one-out improvement credit across base "
+     "revisions (engine/lineage.py)"),
     ("mem_peak_bytes", "fleet_mem_peak_bytes",
      "node device-memory high-water mark"),
     ("quarantined", "fleet_quarantined",

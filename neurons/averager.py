@@ -88,6 +88,10 @@ def _run_sub_averager(cfg: RunConfig, c, plane) -> int:
         from distributedtraining_tpu.engine.remediate import LeaseManager
         lease = LeaseManager(c.transport, cfg.hotkey,
                              role=f"subavg.{node}")
+    lineage = None
+    if cfg.lineage:
+        from distributedtraining_tpu.engine.lineage import LineagePlane
+        lineage = LineagePlane(c.transport, node=f"subavg.{node}")
     sub = SubAverager(
         c.transport, node, lambda: host_wire_template(c.engine), assigned,
         consensus=lambda: getattr(c.chain, "consensus_scores",
@@ -100,7 +104,8 @@ def _run_sub_averager(cfg: RunConfig, c, plane) -> int:
         ingest_workers=cfg.ingest_workers,
         ingest_cache_mb=cfg.ingest_cache_mb,
         wire_spec=True if cfg.hier_wire_v2 else None,
-        lease=lease, metrics=c.metrics, fleet=plane.fleet)
+        lease=lease, metrics=c.metrics, fleet=plane.fleet,
+        lineage=lineage)
     try:
         merged = sub.run_periodic(interval=cfg.averaging_interval,
                                   rounds=cfg.rounds)
@@ -134,8 +139,9 @@ def main(argv=None) -> int:
     # no train loop here to tick a profiler capture).
     from distributedtraining_tpu.engine.health import report_vitals
     from distributedtraining_tpu.utils.obs import AnomalyMonitor
+    anomaly = AnomalyMonitor()
     plane = build_health_plane(cfg, c, monitor=True,
-                               anomaly=AnomalyMonitor(),
+                               anomaly=anomaly,
                                start_heartbeat=False)
     if cfg.hier == "sub":
         # the sub-averager role shares the build + health plane but runs
@@ -167,6 +173,15 @@ def main(argv=None) -> int:
     if cfg.remediate or cfg.standby:
         from distributedtraining_tpu.engine.remediate import LeaseManager
         lease = LeaseManager(c.transport, cfg.hotkey)
+    # provenance plane (engine/lineage.py): a content-addressed
+    # __lineage__ record per landed merge + the merged-quality
+    # EWMA/CUSUM drift detector, sharing the fleet's AnomalyMonitor
+    # one-shot so a quality drift arms the same forensics a breach does
+    lineage = None
+    if cfg.lineage:
+        from distributedtraining_tpu.engine.lineage import LineagePlane
+        lineage = LineagePlane(c.transport, node=cfg.hotkey,
+                               anomaly=anomaly)
     loop = AveragerLoop(c.engine, c.transport, c.chain,
                         make_strategy(cfg, c.model),
                         val_batches=c.eval_batches(),
@@ -182,7 +197,8 @@ def main(argv=None) -> int:
                         fleet=plane.fleet,
                         remediation=plane.remediation,
                         lease=lease,
-                        hierarchy=hierarchy)
+                        hierarchy=hierarchy,
+                        lineage=lineage)
     if plane.heartbeat is not None:
         plane.heartbeat.vitals = report_vitals(
             loop.report, base_revision=lambda: loop._base_revision)

@@ -179,6 +179,36 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
     assert any(ev.get("ph") == "X" for ev in trace["traceEvents"]), \
         "Perfetto trace has no span events"
 
+    # lineage plane (engine/lineage.py): EVERY base revision the
+    # averager published during the round must have a fetchable,
+    # integrity-verified lineage record whose contributions cover every
+    # cid that entered the merge — the provenance DAG is complete, not
+    # best-effort. fetch_record raises LOUDLY on a tampered record.
+    from distributedtraining_tpu.engine import lineage as lineage_lib
+    from distributedtraining_tpu.transport.localfs import LocalFSTransport
+    store = LocalFSTransport(os.path.join(work_dir, "artifacts"))
+    published_revs: dict[str, list] = {}
+    for rec in obs_report.load_records([avg_metrics]):
+        if rec.get("published") == 1 \
+                and isinstance(rec.get("base_revision"), str):
+            published_revs[rec["base_revision"]] = sorted(
+                (rec.get("merge_delta_ids") or {}).values())
+    assert published_revs, \
+        "averager metrics carry no published base revisions"
+    lineage_rounds = 0
+    for rev, cids in published_revs.items():
+        lrec = lineage_lib.fetch_record(store, rev)
+        assert lrec is not None, f"no lineage record for revision {rev}"
+        assert lrec["parent"], f"lineage record {rev} has no parent link"
+        rec_cids = {c.get("cid") for c in lrec["contributions"]}
+        missing = set(cids) - rec_cids
+        assert not missing, \
+            f"lineage record for {rev} missing merged cids {missing}"
+        lineage_rounds += 1
+    head = store.base_revision()
+    assert head in published_revs, \
+        "current base was not published by this round's averager"
+
     summary = {
         "protocol": "miner->delta->validator->averager, "
                     f"{model} from a pretrained-format checkpoint",
@@ -186,6 +216,8 @@ def run(work_dir: str, *, steps: int = 30, model: str = "gpt2-124m",
                        for cid, tr in obs_rep["deltas"].items()},
         "devprof_coverage": cov,
         "devprof_programs": len(perf_rep["programs"]),
+        "lineage_records": lineage_rounds,
+        "lineage_coverage": 1.0,   # asserted above: every published rev
         "perf_trace": trace_path,
         "corpus": corpus, "tokenizer": tok_desc,
         "fused_loss": fused_loss,

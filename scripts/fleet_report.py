@@ -40,7 +40,7 @@ import obs_report  # noqa: E402 — same directory; shares record loading
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
            "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "published",
            "accepted", "declined", "stale_rounds", "wire_b", "score",
-           "quar", "slo")
+           "credit", "quar", "slo")
 
 
 def build_report(paths: list[str]) -> dict:
@@ -167,6 +167,13 @@ def _cell(node: dict, col: str) -> str:
             if v >= div:
                 return f"{v / div:.1f}{unit}"
         return str(int(v))
+    if col == "credit":
+        # accumulated leave-one-out improvement credit (engine/lineage
+        # CreditLedger via the ledger's credit field) — who actually
+        # moved the base, not just who scored this round
+        v = node.get("credit")
+        return "-" if not isinstance(v, (int, float)) or v == 0 \
+            else f"{v:+.4f}"
     if col == "quar":
         if node.get("quarantined"):
             return "Q"

@@ -143,6 +143,12 @@ def main(argv=None) -> int:
                                     "thresholds (fleetsim.DEFAULT_GATES)")
     ap.add_argument("--metrics", help="JSONL sink path for the obs "
                                       "exhaust (spans, breaches, ledger)")
+    ap.add_argument("--finalize-ts", type=float, default=None,
+                    help="inject the finalize wall-clock stamp (the ONE "
+                         "field outside the seeded region, excluded "
+                         "from the content hash) — same-seed reruns "
+                         "with the same value produce byte-identical "
+                         "scorecard files; default: time.time()")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -199,8 +205,12 @@ def main(argv=None) -> int:
     finally:
         obs.reset()
 
-    # the wall-clock stamp is the ONE field outside the seeded region
-    card = fs.finalize_scorecard(card, now=time.time())
+    # the wall-clock stamp is the ONE field outside the seeded region;
+    # --finalize-ts injects it so same-seed reruns are byte-identical
+    # ARTIFACTS, not merely identical modulo this field
+    card = fs.finalize_scorecard(
+        card, now=args.finalize_ts if args.finalize_ts is not None
+        else time.time())
     with open(args.out, "w") as f:
         json.dump(card, f, sort_keys=True, indent=1)
         f.write("\n")

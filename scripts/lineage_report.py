@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Render the model provenance DAG and replay-audit merged revisions.
+
+The averager (and every ``__agg__`` sub-averager) freezes a
+content-addressed lineage record per landed merge (engine/lineage.py):
+parent base revision, the exact (hotkey, cid, delta revision, merge
+weight, wire bytes, verdict, score) set that entered the merge, and the
+resulting revision — published under the reserved per-revision
+``__lineage__.<revision>`` id and mirrored into the role's metrics
+JSONL as ``{"lineage": ...}``. This script is the audit half:
+
+- **report** (default): walk the DAG from the store's current base
+  revision (plus every record found in the JSONL mirrors) and print
+  one row per revision — parent link, contributing miners, weights,
+  held-out loss — with a per-miner attribution rollup (appearances,
+  total weight, wire bytes).
+- **--replay <revision>**: re-derive that revision from its record via
+  the existing ingest + merge programs (engine/ingest staging, the
+  delta.aggregate_deltas scatter-add — dense v1 and packed v2 alike)
+  and assert parity against the published artifact. Exit 0 on parity;
+  exit 2 LOUDLY on a tampered/torn record, a drifted contribution, or
+  a mismatched republished base — "trust the averager" becomes a
+  command any validator can run.
+
+Usage:
+    python scripts/lineage_report.py --work-dir ./run
+    python scripts/lineage_report.py --store ./run/artifacts avg.jsonl
+    python scripts/lineage_report.py --store ./run/artifacts \
+        --replay <revision> --parent parent_base.msgpack
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_jsonl_records(paths: list[str]) -> list[dict]:
+    import obs_report
+    out = []
+    for rec in obs_report.load_records(paths):
+        lin = rec.get("lineage")
+        if isinstance(lin, dict):
+            out.append(lin)
+    return out
+
+
+def _open_store(store: str):
+    from distributedtraining_tpu.transport.localfs import LocalFSTransport
+    return LocalFSTransport(store)
+
+
+def _zeros_like(tree):
+    import jax
+    import numpy as np
+    return jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), np.asarray(x).dtype), tree)
+
+
+def _load_params(path: str):
+    """Template-free msgpack restore (flax keeps names+shapes in the
+    container) — the parent checkpoint defines the replay template."""
+    from distributedtraining_tpu import serialization as ser
+    with open(path, "rb") as f:
+        return ser.from_msgpack(f.read())
+
+
+def build_report(transport, jsonl_records: list[dict]) -> dict:
+    """DAG rows keyed on revision: transport records win (they carry the
+    verified content address), JSONL mirrors fill in history the store
+    no longer serves."""
+    from distributedtraining_tpu.engine import lineage as lin
+
+    rows: dict[str, dict] = {}
+    problems: list[str] = []
+    for raw in jsonl_records:
+        rec = lin.parse_record(raw)
+        if rec is not None:
+            rows.setdefault(rec["revision"], dict(rec, source="jsonl"))
+    if transport is not None:
+        head = None
+        try:
+            head = transport.base_revision()
+        except Exception:
+            problems.append("base revision probe failed")
+        if head is not None:
+            try:
+                for rec in lin.walk_chain(transport, head):
+                    rows[rec["revision"]] = dict(rec, source="store")
+            except lin.LineageError as e:
+                problems.append(str(e))
+        # JSONL mirrors name revisions (and parents) the head walk may
+        # not reach — forks, agg records, history past the current
+        # base. Chase every known revision AND its parent links against
+        # the store to closure, preferring verified store copies.
+        frontier = list(rows)
+        seen: set[str] = set()
+        while frontier:
+            rev = frontier.pop()
+            if rev in seen:
+                continue
+            seen.add(rev)
+            if rows.get(rev, {}).get("source") != "store":
+                try:
+                    rec = lin.fetch_record(transport, rev)
+                except lin.LineageError as e:
+                    problems.append(str(e))
+                    rec = None
+                if rec is not None:
+                    rows[rev] = dict(rec, source="store")
+            parent = rows.get(rev, {}).get("parent")
+            if parent and parent not in seen:
+                frontier.append(parent)
+    miners: dict[str, dict] = {}
+    for rec in rows.values():
+        for c in rec["contributions"]:
+            m = miners.setdefault(c["hotkey"],
+                                  {"merges": 0, "weight": 0.0,
+                                   "wire_bytes": 0})
+            m["merges"] += 1
+            if c.get("weight") is not None:
+                m["weight"] += float(c["weight"])
+            m["wire_bytes"] += int(c.get("wire_bytes") or 0)
+    ordered = sorted(rows.values(),
+                     key=lambda r: (r.get("round", 0), r.get("t", 0.0)))
+    return {"revisions": ordered, "miners": dict(sorted(miners.items())),
+            "head": (ordered[-1]["revision"] if ordered else None),
+            "problems": problems}
+
+
+def format_report(rep: dict) -> str:
+    lines = []
+    header = ("kind", "round", "revision", "parent", "miners", "loss",
+              "replay", "source")
+    rows = []
+    for r in rep["revisions"]:
+        rows.append((r["kind"], str(r["round"]), r["revision"][:12],
+                     (r["parent"] or "-")[:12],
+                     str(len(r["contributions"])),
+                     f"{r['loss']:.4f}" if r.get("loss") is not None
+                     else "-",
+                     "yes" if r["replayable"] else "no",
+                     r.get("source", "?")))
+    widths = [max(len(h), *(len(row[i]) for row in rows)) if rows
+              else len(h) for i, h in enumerate(header)]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    lines.append("")
+    lines.append(f"{len(rep['revisions'])} lineage record(s); "
+                 f"head {rep['head'] or '-'}")
+    if rep["miners"]:
+        lines.append("contribution rollup (merges / total weight / "
+                     "wire bytes):")
+        for h, m in rep["miners"].items():
+            lines.append(f"  {h}: {m['merges']} / {m['weight']:.4f} / "
+                         f"{m['wire_bytes']}")
+    for p in rep["problems"]:
+        lines.append(f"  PROBLEM: {p}")
+    return "\n".join(lines)
+
+
+def run_replay(transport, revision: str, *, parent_path: str | None,
+               target_path: str | None, tol: float) -> dict:
+    """Fetch + verify the record, re-derive, assert parity. Raises
+    engine.lineage.LineageError on any audit failure."""
+    from distributedtraining_tpu.engine import lineage as lin
+
+    rec = lin.fetch_record(transport, revision)
+    if rec is None:
+        raise lin.LineageError(
+            f"no lineage record for revision {revision!r}")
+    parent = target = None
+    if parent_path:
+        parent = _load_params(parent_path)
+    if target_path:
+        target = _load_params(target_path)
+    if parent is not None:
+        template = _zeros_like(parent)
+    elif target is not None:
+        template = _zeros_like(target)
+    else:
+        from distributedtraining_tpu import serialization as ser
+        from distributedtraining_tpu import signing
+        data = transport.fetch_base_bytes()
+        if data is None:
+            raise lin.LineageError(
+                "no --parent/--target and no published base to derive "
+                "the replay template from")
+        template = _zeros_like(
+            ser.from_msgpack(signing.strip_envelope(data)))
+    result = lin.replay_record(transport, rec, template, parent=parent,
+                               target=target, tol=tol)
+    return {"record": rec, "replay": result.as_dict()}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="*",
+                   help="per-role JSONL metric files ({'lineage': ...} "
+                        "mirrors)")
+    p.add_argument("--work-dir", default=None,
+                   help="glob <work-dir>/*.jsonl and use "
+                        "<work-dir>/artifacts as the store")
+    p.add_argument("--store", default=None,
+                   help="localfs transport root holding the __lineage__ "
+                        "records (e.g. <work-dir>/artifacts)")
+    p.add_argument("--replay", default=None, metavar="REVISION",
+                   help="replay-audit this revision: re-derive it from "
+                        "its record and assert parity vs the published "
+                        "artifact (exit 2 on any mismatch)")
+    p.add_argument("--parent", default=None,
+                   help="msgpack params of the PARENT base revision "
+                        "(required to replay a 'base' record)")
+    p.add_argument("--target", default=None,
+                   help="msgpack params to audit against instead of the "
+                        "store's current artifact (archived bases)")
+    p.add_argument("--tol", type=float, default=1e-6,
+                   help="replay parity tolerance (max abs diff)")
+    p.add_argument("--json", dest="json_out", action="store_true")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON report to this path")
+    a = p.parse_args(argv)
+
+    paths = list(a.files)
+    store = a.store
+    if a.work_dir:
+        paths += sorted(glob.glob(os.path.join(a.work_dir, "*.jsonl")))
+        if store is None:
+            cand = os.path.join(a.work_dir, "artifacts")
+            store = cand if os.path.isdir(cand) else a.work_dir
+    transport = _open_store(store) if store else None
+    if transport is None and not paths:
+        p.error("no inputs (pass JSONL paths, --store, or --work-dir)")
+
+    from distributedtraining_tpu.engine.lineage import LineageError
+
+    if a.replay:
+        if transport is None:
+            p.error("--replay needs --store/--work-dir (the records and "
+                    "artifacts live in the transport)")
+        try:
+            rep = run_replay(transport, a.replay, parent_path=a.parent,
+                             target_path=a.target, tol=a.tol)
+        except LineageError as e:
+            print(f"REPLAY FAILED for {a.replay}: {e}", file=sys.stderr)
+            if a.json_out:
+                print(json.dumps({"ok": False, "revision": a.replay,
+                                  "error": str(e)}, indent=1))
+            return 2
+        r = rep["replay"]
+        if a.json_out:
+            print(json.dumps(rep, indent=1, default=float))
+        else:
+            print(f"replay OK: revision {r['revision']} re-derived from "
+                  f"{r['contributions']} contribution(s), max abs diff "
+                  f"{r['max_abs_diff']:.3e} <= {a.tol:g}")
+        if a.out:
+            with open(a.out, "w") as f:
+                json.dump(rep, f, indent=1, default=float)
+        return 0
+
+    rep = build_report(transport, _load_jsonl_records(paths))
+    if not rep["revisions"]:
+        print(f"no lineage records found in {len(paths)} file(s)"
+              + (f" or store {store}" if store else "")
+              + " — is the averager running with lineage enabled?")
+        return 1
+    if a.json_out:
+        print(json.dumps(rep, indent=1, default=float))
+    else:
+        print(format_report(rep))
+    if a.out:
+        with open(a.out, "w") as f:
+            json.dump(rep, f, indent=1, default=float)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head et al. closing stdout is not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

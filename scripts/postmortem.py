@@ -35,7 +35,7 @@ import obs_report  # noqa: E402 — same directory; shares record loading
 # REJECTED on ingest, the same bundle-schema lint consumers apply
 EVENT_KINDS = ("config", "span", "metrics", "anomaly", "slo", "lease",
                "swap", "publish", "heartbeat", "remediation", "crash",
-               "note")
+               "lineage.record", "lineage.drift", "note")
 
 # a torn or failed publish outcome — the needle a crash forensics pass
 # is usually looking for
@@ -172,8 +172,27 @@ def collect(paths: list[str]) -> tuple[list[dict], list[dict]]:
                 {"outcome": "ok" if rec.get("published") else "declined",
                  "merged_loss": rec.get("merged_loss"),
                  "round": rec.get("step"),
+                 "revision": rec.get("base_revision"),
                  "cids": sorted((rec.get("merge_delta_ids") or {})
                                 .values())}))
+        elif isinstance(rec.get("lineage"), dict):
+            # a merge's provenance record (engine/lineage.py): joins the
+            # timeline on revision AND on every contributing cid, so
+            # "which deltas made this base" sits next to the publishes,
+            # breaches, and crashes that surrounded it
+            lin = rec["lineage"]
+            contribs = lin.get("contributions") or []
+            timeline.append(_entry(
+                lin.get("t", ts),
+                f"{lin.get('kind', '?')}/{lin.get('node', '?')}",
+                "lineage.record", "jsonl",
+                {"revision": lin.get("revision"),
+                 "parent": lin.get("parent"),
+                 "record_id": lin.get("record_id"),
+                 "round": lin.get("round"),
+                 "miners": len(contribs),
+                 "cids": sorted(c.get("cid") for c in contribs
+                                if isinstance(c, dict) and c.get("cid"))}))
     timeline.sort(key=lambda e: e["t"])
     return bundles, timeline
 
@@ -205,6 +224,8 @@ def report(paths: list[str]) -> dict:
             and e.get("outcome") in _BAD_PUBLISH]
     slo = [e for e in timeline if e["kind"] == "slo"]
     crashes = [e for e in timeline if e["kind"] == "crash"]
+    lineage = [e for e in timeline if e["kind"] == "lineage.record"]
+    drifts = [e for e in timeline if e["kind"] == "lineage.drift"]
     # the causal joins: cids (and rounds) whose events span >1 source —
     # one artifact's life (or one round's decisions) seen from multiple
     # roles at once, which is the whole point of the postmortem plane
@@ -227,6 +248,8 @@ def report(paths: list[str]) -> dict:
         "torn_publishes": torn,
         "slo_fired": slo,
         "crashes": crashes,
+        "lineage_records": lineage,
+        "quality_drifts": drifts,
         "roles": sorted({b["role"] for b in bundles}
                         | {e["source"].split("/", 1)[0]
                            for e in timeline if e["source"][0] != "-"}),
@@ -261,6 +284,10 @@ def format_report(rep: dict) -> str:
     if rep["crashes"]:
         lines.append("crashes:")
         for e in rep["crashes"]:
+            lines.append("  " + _fmt(e))
+    if rep.get("quality_drifts"):
+        lines.append("merged-model quality drifts:")
+        for e in rep["quality_drifts"]:
             lines.append("  " + _fmt(e))
     if rep["joined_cids"]:
         lines.append("cids joined across roles:")

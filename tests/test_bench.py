@@ -176,6 +176,24 @@ def test_time_flight_overhead_ab():
     assert out["flight_overhead_frac"] < 0.10, out
 
 
+def test_time_lineage_overhead_ab():
+    """The lineage-plane A/B (ISSUE 13 tentpole): production averager
+    rounds with the provenance record + drift detector per publish vs
+    without (engine/lineage.py). The plane must actually freeze records
+    each merged round, and its measured cost must stay small — loosened
+    to 25% here because at 2 rounds x ~70 ms a single scheduler hiccup
+    on a loaded CI box is a double-digit fraction by itself; the
+    recorded bench (docs/perf.md round 18, median of 3 trials) pins
+    the real number against the < 2% acceptance floor."""
+    out = bench._time_lineage_overhead(miners=3, rounds=2, trials=1)
+    for key in ("lineage_off_s", "lineage_on_s",
+                "lineage_overhead_frac"):
+        assert key in out and out[key] is not None, out
+    assert out["lineage_records_published"] >= 2, out
+    assert out["lineage_off_s"] > 0 and out["lineage_on_s"] > 0
+    assert out["lineage_overhead_frac"] < 0.25, out
+
+
 def test_time_devprof_overhead_ab():
     """The device-observatory A/B (ISSUE 12 tentpole): the production
     MinerLoop with the obs layer on both sides, contrast =

@@ -223,6 +223,75 @@ def test_gates_fail_on_regressed_numbers():
     assert not fs.evaluate_gates(bad2)["rounds"]["ok"]
 
 
+# ---------------------------------------------------------------------------
+# Lineage coverage + merged-quality gates (engine/lineage.py)
+# ---------------------------------------------------------------------------
+
+def test_lineage_coverage_and_quality_gates_green_on_healthy_fleet():
+    spec = smoke_spec(rounds=4, stale_miners=1, poison_miners=1)
+    card = fs.assemble_scorecard(fs.simulate(spec))
+    lin = card["lineage"]
+    # every landed revision carries a fetchable, integrity-verified
+    # record — coverage is 100%, not best-effort
+    assert lin["published"] >= spec.rounds - 1
+    assert lin["coverage"] == 1.0 and lin["tampered"] == 0
+    assert lin["drift_breaches"] == 0
+    # the toy problem converges, so merged quality strictly improves
+    assert lin["quality_last"] < lin["quality_first"]
+    assert card["gates"]["lineage"]["ok"]
+    assert card["gates"]["quality"]["ok"]
+
+
+def test_quality_and_lineage_gates_fail_on_regression():
+    spec = smoke_spec(rounds=3)
+    card = fs.assemble_scorecard(fs.simulate(spec))
+    # a quality drift (or a run that ends WORSE than it started) fails
+    # the scorecard, not just a human eyeball
+    bad = json.loads(json.dumps(card))
+    bad["lineage"]["drift_breaches"] = 1
+    assert not fs.evaluate_gates(bad)["quality"]["ok"]
+    bad2 = json.loads(json.dumps(card))
+    bad2["lineage"]["quality_last"] = \
+        bad2["lineage"]["quality_first"] + 1.0
+    assert not fs.evaluate_gates(bad2)["quality"]["ok"]
+    # missing or tampered records fail the coverage gate
+    bad3 = json.loads(json.dumps(card))
+    bad3["lineage"]["coverage"] = 0.5
+    assert not fs.evaluate_gates(bad3)["lineage"]["ok"]
+    bad4 = json.loads(json.dumps(card))
+    bad4["lineage"]["tampered"] = 1
+    assert not fs.evaluate_gates(bad4)["lineage"]["ok"]
+
+
+def test_cli_finalize_ts_makes_reruns_byte_identical(tmp_path):
+    """PR-11's caveat closed: with --finalize-ts injected, two same-seed
+    CLI runs produce byte-identical scorecard FILES (previously equal
+    only modulo the wall-clock ``t``)."""
+    import importlib.util
+    import os as _os
+
+    spec_path = importlib.util.spec_from_file_location(
+        "fleetsim_cli", _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            "scripts", "fleetsim.py"))
+    cli = importlib.util.module_from_spec(spec_path)
+    spec_path.loader.exec_module(cli)
+    spec_json = json.dumps({"miners": 6, "validators": 1, "servers": 1,
+                            "rounds": 2, "seed": 5,
+                            "validator_cohort": 4})
+    outs = []
+    for name in ("a.json", "b.json"):
+        out = str(tmp_path / name)
+        rc = cli.main(["--spec", spec_json, "--no-serve", "--no-control",
+                       "--out", out, "--finalize-ts", "123.0"])
+        assert rc == 0
+        outs.append(open(out, "rb").read())
+    assert outs[0] == outs[1]
+    card = json.loads(outs[0])
+    assert card["t"] == 123.0
+    assert card["lineage"]["coverage"] == 1.0
+
+
 def test_baseline_regression_gate():
     spec = smoke_spec(rounds=4, stale_miners=2)
     card = fs.assemble_scorecard(fs.simulate(spec),

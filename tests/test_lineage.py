@@ -1,0 +1,729 @@
+"""Lineage & contribution-attribution observatory (engine/lineage.py +
+the __lineage__ reserved transport namespace + scripts/lineage_report).
+
+The pins here are the audit contract: a record's content address
+round-trips build -> publish -> fetch -> parse unchanged; the replay
+audit re-derives a multi-miner (hierarchical, mixed v1+v2 wire) merged
+revision with parity <= 1e-6 from nothing but the record + the store;
+and every hostile case — a tampered record, a torn record, a drifted
+contribution, a republished (mismatched) base — fails LOUDLY
+(LineageError / lineage_report exit 2), never silently. Credit and
+drift are pinned on constructed rounds with known answers.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import delta as dl
+from distributedtraining_tpu.engine import lineage as lin
+from distributedtraining_tpu.engine.average import (AveragerLoop,
+                                                    GeneticMerge,
+                                                    OuterOptMerge,
+                                                    ParameterizedMerge,
+                                                    WeightedAverage)
+from distributedtraining_tpu.engine.hier_average import SubAverager
+from distributedtraining_tpu.engine.publish import DeltaPublisher
+from distributedtraining_tpu.transport import base as tbase
+from distributedtraining_tpu.transport.chaos import ChaosSpec, ChaosTransport
+from distributedtraining_tpu.transport.localfs import LocalFSTransport
+from distributedtraining_tpu.transport.memory import InMemoryTransport
+from distributedtraining_tpu.transport.retry import RetryPolicy
+
+FAST_RETRY = RetryPolicy(attempts=2, base_delay=0.0, max_delay=0.0,
+                         jitter=0.0)
+
+
+def _tree(seed=0, big=(300, 40), small=(32,)):
+    rs = np.random.RandomState(seed)
+    return {"wte": (rs.randn(*big) * 0.01).astype(np.float32),
+            "ln": {"g": (rs.randn(*small) * 0.01).astype(np.float32)}}
+
+
+def _template(tree=None):
+    return jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), np.float32), tree or _tree())
+
+
+def _record(**over):
+    kw = dict(kind="base", node="avg", revision="rev2", parent="rev1",
+              round_no=3,
+              contributions=[{"hotkey": "m0", "rev": "d0", "cid": "c0",
+                              "weight": 0.25, "wire_bytes": 100,
+                              "verdict": "ok", "score": 1.0},
+                             {"hotkey": "m1", "rev": "d1", "cid": "c1",
+                              "weight": 0.75, "wire_bytes": 0,
+                              "verdict": "ok", "score": 3.0}],
+              loss=1.5, parent_loss=1.6, now=123.0)
+    kw.update(over)
+    return lin.build_record(**kw)
+
+
+# ---------------------------------------------------------------------------
+# Record schema: build/parse/digest round trip
+# ---------------------------------------------------------------------------
+
+def test_record_digest_roundtrips_through_publish_and_parse():
+    rec = _record()
+    assert rec["record_id"] == lin.record_digest(rec)
+    # the wall-clock stamp is outside the content address
+    assert lin.record_digest(dict(rec, t=999.0)) == rec["record_id"]
+    # byte round trip through parse preserves the digest
+    parsed = lin.parse_record(json.dumps(rec, default=float).encode())
+    assert parsed is not None
+    assert lin.record_digest(parsed) == rec["record_id"]
+    assert parsed["contributions"][0]["cid"] == "c0"
+    assert parsed["parent"] == "rev1"
+
+
+def test_parse_record_rejects_hostile_shapes():
+    good = _record()
+    hostile = [
+        b"not json", b"[]", b"{}",
+        json.dumps({**good, "lineage": 0}, default=float).encode(),
+        json.dumps({**good, "kind": "evil"}, default=float).encode(),
+        json.dumps({**good, "revision": ""}, default=float).encode(),
+        json.dumps({**good, "contributions": "x"},
+                   default=float).encode(),
+        json.dumps({**good, "contributions": [{"weight": 1.0}]},
+                   default=float).encode(),    # contribution sans hotkey
+        b"{" * 100,                            # torn JSON
+        b"x" * (lin.LINEAGE_MAX_BYTES + 1),    # oversized
+    ]
+    for data in hostile:
+        assert lin.parse_record(data) is None, data[:40]
+
+
+def test_lineage_id_is_reserved_and_injective():
+    rid = tbase.lineage_id("abc123")
+    assert tbase.is_lineage_id(rid)
+    assert tbase.is_reserved_id(rid)
+    # revisions with separator chars cannot collide
+    assert tbase.lineage_id("a/b.c") != tbase.lineage_id("a.b/c")
+
+
+def test_fetch_record_roundtrip_and_walk_chain():
+    transport = InMemoryTransport()
+    r1 = _record(revision="rev1", parent=None, contributions=[],
+                 round_no=0, strategy="genesis", replayable=False)
+    r2 = _record(revision="rev2", parent="rev1")
+    assert lin.publish_record(transport, r1)
+    assert lin.publish_record(transport, r2)
+    got = lin.fetch_record(transport, "rev2")
+    assert got["record_id"] == r2["record_id"]
+    chain = lin.walk_chain(transport, "rev2")
+    assert [r["revision"] for r in chain] == ["rev2", "rev1"]
+    assert chain[-1]["parent"] is None
+    assert lin.fetch_record(transport, "ghost") is None
+
+
+def test_fetch_record_raises_loudly_on_tamper_and_torn():
+    transport = InMemoryTransport()
+    rec = _record()
+    assert lin.publish_record(transport, rec)
+    rid = tbase.lineage_id(rec["revision"])
+    # tamper: a flipped weight keeps the JSON valid but breaks the
+    # content address
+    doc = json.loads(transport.fetch_delta_bytes(rid))
+    doc["contributions"][0]["weight"] = 0.99
+    transport.publish_raw(rid, json.dumps(doc).encode())
+    with pytest.raises(lin.LineageError, match="tampered|content"):
+        lin.fetch_record(transport, rec["revision"])
+    # torn: truncated bytes are present-but-unparseable, also loud
+    transport.publish_raw(
+        rid, json.dumps(rec, default=float).encode()[:40])
+    with pytest.raises(lin.LineageError, match="torn"):
+        lin.fetch_record(transport, rec["revision"])
+
+
+# ---------------------------------------------------------------------------
+# Strategy weight declarations (what makes a record replayable)
+# ---------------------------------------------------------------------------
+
+def test_strategy_lineage_weight_declarations():
+    w = np.asarray([0.25, 0.75], np.float32)
+    got, kind = lin.resolve_weights(WeightedAverage(), w, 2)
+    assert kind == "merge" and got == [0.25, 0.75]
+    got, kind = lin.resolve_weights(GeneticMerge(), w, 2)
+    assert kind == "merge" and got == [0.25, 0.75]
+    # scalar meta-learned weights replay through the softmax
+    strat = ParameterizedMerge(None, per_tensor=False)
+    got, kind = lin.resolve_weights(strat, np.zeros(2, np.float32), 2)
+    assert kind == "merge"
+    np.testing.assert_allclose(got, [0.5, 0.5])
+    # per-tensor and outer-momentum merges are attribution-only
+    assert lin.resolve_weights(ParameterizedMerge(None, per_tensor=True),
+                               w, 2) == (None, "opaque")
+    assert lin.resolve_weights(OuterOptMerge(WeightedAverage()),
+                               w, 2) == (None, "opaque")
+    # a strategy without the hook is opaque, never an error
+    assert lin.resolve_weights(object(), w, 2) == (None, "opaque")
+    # shape/NaN mismatches resolve opaque instead of recording garbage
+    assert lin.resolve_weights(WeightedAverage(), w, 3) == (None, "opaque")
+    assert lin.resolve_weights(
+        WeightedAverage(), np.asarray([np.nan, 1.0]), 2) == (None, "opaque")
+
+
+# ---------------------------------------------------------------------------
+# Quality-drift detector
+# ---------------------------------------------------------------------------
+
+def test_drift_detector_quiet_on_converging_loss():
+    det = lin.QualityDriftDetector()
+    for i in range(20):
+        assert det.update(2.0 * (0.9 ** i)) is None
+    assert det.breaches == 0
+
+
+def test_drift_detector_fires_on_sustained_regression_and_rearms():
+    det = lin.QualityDriftDetector(alpha=0.25, slack=0.02, threshold=0.25)
+    for _ in range(5):
+        det.update(1.0)
+    fired = None
+    for i in range(1, 20):
+        fired = det.update(1.0 + 0.12 * i)
+        if fired is not None:
+            break
+    assert fired is not None and fired["reason"] == "quality_drift"
+    assert det.breaches == 1
+    # the CUSUM resets on fire: a PERSISTING drift fires again
+    again = None
+    for i in range(20, 40):
+        again = det.update(1.0 + 0.12 * i)
+        if again is not None:
+            break
+    assert again is not None
+    assert det.breaches == 2
+
+
+def test_drift_detector_nonfinite_loss_breaches_immediately():
+    det = lin.QualityDriftDetector()
+    det.update(1.0)
+    fired = det.update(float("nan"))
+    assert fired is not None and fired["reason"] == "nonfinite_loss"
+
+
+# ---------------------------------------------------------------------------
+# Credit attribution
+# ---------------------------------------------------------------------------
+
+def _scored(rows):
+    return [SimpleNamespace(hotkey=h, loss=l, score=s) for h, l, s in rows]
+
+
+def test_loo_credits_weighted_by_normalized_scores():
+    # base 2.0: m0 improved by 0.5 at weight 1/4, m1 by 0.1 at 3/4,
+    # m2 worsened (negative credit), zero-score rows weigh nothing
+    credits = lin.loo_credits(2.0, _scored([
+        ("m0", 1.5, 1.0), ("m1", 1.9, 3.0), ("m2", 2.4, 0.0)]))
+    np.testing.assert_allclose(credits["m0"], 0.25 * 0.5)
+    np.testing.assert_allclose(credits["m1"], 0.75 * 0.1)
+    np.testing.assert_allclose(credits["m2"], 0.0 * -0.4)
+    # no base loss / no finite candidate losses -> no attribution
+    assert lin.loo_credits(None, _scored([("m0", 1.0, 1.0)])) == {}
+    assert lin.loo_credits(2.0, _scored([("m0", None, 1.0)])) == {}
+    # all-zero scores fall back to uniform (the consensus rule)
+    uniform = lin.loo_credits(2.0, _scored([("a", 1.0, 0.0),
+                                            ("b", 3.0, 0.0)]))
+    np.testing.assert_allclose(uniform["a"], 0.5)
+    np.testing.assert_allclose(uniform["b"], -0.5)
+
+
+def test_credit_ledger_one_estimate_per_revision():
+    ledger = lin.CreditLedger(max_revisions=2)
+    ledger.update("r1", 2.0, _scored([("m0", 1.0, 1.0)]))
+    # re-validating the SAME revision replaces, never double-counts
+    ledger.update("r1", 2.0, _scored([("m0", 1.5, 1.0)]))
+    np.testing.assert_allclose(ledger.totals()["m0"], 0.5)
+    # a new revision accumulates
+    ledger.update("r2", 2.0, _scored([("m0", 1.5, 1.0)]))
+    np.testing.assert_allclose(ledger.totals()["m0"], 1.0)
+    # eviction settles old revisions into the totals (cumulative ledger)
+    ledger.update("r3", 2.0, _scored([("m0", 1.9, 1.0)]))
+    assert ledger.revisions() == ["r2", "r3"]
+    np.testing.assert_allclose(ledger.totals()["m0"], 1.1)
+
+
+def test_fleet_ledger_credit_reaches_exporter_as_dt_lineage_credit():
+    from distributedtraining_tpu.engine.health import FleetMonitor
+    from distributedtraining_tpu.utils import obs_http
+
+    transport = InMemoryTransport()
+    fm = FleetMonitor(transport, workers=1)
+    try:
+        fm.record_staging([SimpleNamespace(hotkey="m0", revision="d0",
+                                           delta={}, reason="accepted",
+                                           wire_bytes=10)])
+        fm.record_credit({"m0": 0.125, "ghost": 0.0})
+        led = fm.ledger()
+        assert led["miner/m0"]["credit"] == 0.125
+        assert "miner/ghost" not in led     # zero-credit never-seen
+        body = obs_http.render(registry=None, fleet=fm)
+        assert 'dt_lineage_credit{role="miner",hotkey="m0"} 0.125' in body
+    finally:
+        fm.close()
+
+
+# ---------------------------------------------------------------------------
+# Averager loop: record publication + replay audit (the acceptance pin)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine_cfg():
+    """ONE shared mini-GPT2 engine for every averager-round test in
+    this module: the rounds only need a real evaluate() + wire
+    templates, and sharing the instance shares its jitted programs —
+    the per-test cost is the round, not a fresh compile set."""
+    from distributedtraining_tpu.engine import TrainEngine
+    from distributedtraining_tpu.models import gpt2
+
+    model, cfg = gpt2.make_model(gpt2.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_head=2, n_layer=2))
+    return TrainEngine(model, seq_len=16), cfg
+
+
+def _eval_batches(cfg):
+    rs = np.random.RandomState(0)
+    batch = {"input_ids": rs.randint(0, cfg.vocab_size, (2, 16))
+             .astype(np.int32)}
+
+    def factory():
+        return iter([batch])
+
+    return factory
+
+
+class _Chain:
+    def __init__(self, hotkeys, consensus=None, my_hotkey="avg"):
+        self.my_hotkey = my_hotkey
+        self._hotkeys = list(hotkeys)
+        self._consensus = dict(consensus or {})
+
+    def sync(self):
+        return SimpleNamespace(hotkeys=self._hotkeys + [self.my_hotkey])
+
+    def consensus_scores(self):
+        return dict(self._consensus)
+
+
+def _publish_mixed_fleet(transport, template, base_rev):
+    """Three miners: two dense v1, one packed v2 — with cids."""
+    for i, h in enumerate(["m0", "m1"]):
+        d = jax.tree_util.tree_map(
+            lambda x, s=i: (0.01 * (s + 1)
+                            * np.random.RandomState(s).randn(*np.shape(x))
+                            ).astype(np.float32), template)
+        transport.publish_delta(h, d)
+        transport.publish_delta_meta(h, {"delta_id": f"cid-{h}",
+                                         "base_revision": base_rev})
+    raw = jax.tree_util.tree_map(
+        lambda x: (0.03 * np.random.RandomState(7).randn(*np.shape(x))
+                   ).astype(np.float32), template)
+    packed, _ = dl.pack_delta_v2(raw, density=1 / 8)
+
+    class _R:
+        pushes = pushes_failed = pushes_superseded = 0
+
+    pub = DeltaPublisher(transport, "m2", report=_R(),
+                         publish_retry=FAST_RETRY, meta_retry=FAST_RETRY,
+                         wire_spec={"format": 2, "density": 1 / 8,
+                                    "quant": "int8"})
+    try:
+        assert pub.publish_now(jax.device_get(packed), None, base_rev,
+                               cid="cid-m2")
+    finally:
+        pub.close()
+
+
+def _averager(engine, transport, cfg, consensus, plane, *,
+              strategy=None, **over):
+    kw = dict(val_batches=_eval_batches(cfg), publish_policy="always",
+              stale_deltas="skip", ingest_workers=1, lineage=plane)
+    kw.update(over)
+    return AveragerLoop(engine, transport,
+                        _Chain(list(consensus), consensus),
+                        strategy if strategy is not None
+                        else WeightedAverage(), **kw)
+
+
+def test_averager_round_publishes_replayable_record(tmp_path, engine_cfg):
+    """ACCEPTANCE: a multi-miner mixed v1+v2 merge re-derives from its
+    lineage record with parity <= 1e-6, and the record carries full cid
+    coverage, the genesis parent link, and the staging facts."""
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    engine, cfg = engine_cfg
+    template = host_wire_template(engine)
+    consensus = {"m0": 1.0, "m1": 2.0, "m2": 3.0}
+    transport = LocalFSTransport(str(tmp_path))
+    plane = lin.LineagePlane(transport, node="avg")
+    loop = _averager(engine, transport, cfg, consensus, plane)
+    try:
+        loop.bootstrap(rng=jax.random.PRNGKey(0))
+        genesis = transport.base_revision()
+        grec = lin.fetch_record(transport, genesis)
+        assert grec["strategy"] == "genesis" and grec["parent"] is None
+        parent_params = transport.fetch_base(template)[0]
+        _publish_mixed_fleet(transport, template, genesis)
+        assert loop.run_round() is True
+        rev = transport.base_revision()
+        assert rev != genesis
+        rec = lin.fetch_record(transport, rev)
+        assert rec["parent"] == genesis
+        assert rec["replayable"] and rec["weights_kind"] == "merge"
+        by_hotkey = {c["hotkey"]: c for c in rec["contributions"]}
+        assert set(by_hotkey) == {"m0", "m1", "m2"}
+        assert by_hotkey["m2"]["cid"] == "cid-m2"
+        assert by_hotkey["m2"]["wire_bytes"] > 0
+        np.testing.assert_allclose(
+            [by_hotkey[h]["weight"] for h in ("m0", "m1", "m2")],
+            [1 / 6, 2 / 6, 3 / 6], rtol=1e-6)
+        res = lin.replay_record(transport, rec, template,
+                                parent=parent_params)
+        assert res.ok and res.max_abs_diff <= 1e-6
+        # the JSONL-mirror-free DAG walk reaches the genesis root
+        chain = lin.walk_chain(transport, rev)
+        assert [r["revision"] for r in chain] == [rev, genesis]
+    finally:
+        loop.close()
+
+
+def test_replay_fails_loudly_on_weight_tamper_and_cli_exit(tmp_path, engine_cfg):
+    """A tampered record (weight flipped to shift credit) must fail
+    fetch_record AND exit lineage_report --replay nonzero."""
+    import importlib.util
+    import sys
+
+    from distributedtraining_tpu import serialization as ser
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    engine, cfg = engine_cfg
+    template = host_wire_template(engine)
+    store = str(tmp_path / "artifacts")
+    transport = LocalFSTransport(store)
+    plane = lin.LineagePlane(transport, node="avg")
+    loop = _averager(engine, transport, cfg,
+                     {"m0": 1.0, "m1": 2.0, "m2": 3.0}, plane)
+    try:
+        loop.bootstrap(rng=jax.random.PRNGKey(0))
+        genesis = transport.base_revision()
+        parent_params = transport.fetch_base(template)[0]
+        parent_path = str(tmp_path / "parent.msgpack")
+        ser.save_file(parent_params, parent_path)
+        _publish_mixed_fleet(transport, template, genesis)
+        assert loop.run_round() is True
+        rev = transport.base_revision()
+
+        spec = importlib.util.spec_from_file_location(
+            "lineage_report", os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "scripts", "lineage_report.py"))
+        lr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(lr)
+        sys.modules.setdefault("lineage_report", lr)
+        # the honest record replays through the CLI (exit 0)
+        assert lr.main(["--store", store, "--replay", rev,
+                        "--parent", parent_path]) == 0
+
+        # tamper the stored record: flip one weight, keep JSON valid
+        rid = tbase.lineage_id(rev)
+        doc = json.loads(transport.fetch_delta_bytes(rid))
+        doc["contributions"][0]["weight"] = 0.999
+        transport.publish_raw(rid, json.dumps(doc).encode())
+        assert lr.main(["--store", store, "--replay", rev,
+                        "--parent", parent_path]) == 2
+    finally:
+        loop.close()
+
+
+def test_replay_fails_on_republished_base_and_drifted_contribution(
+        tmp_path, engine_cfg):
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    engine, cfg = engine_cfg
+    template = host_wire_template(engine)
+    transport = LocalFSTransport(str(tmp_path))
+    plane = lin.LineagePlane(transport, node="avg")
+    loop = _averager(engine, transport, cfg, {"m0": 1.0, "m1": 2.0,
+                                              "m2": 3.0}, plane)
+    try:
+        loop.bootstrap(rng=jax.random.PRNGKey(0))
+        genesis = transport.base_revision()
+        parent_params = transport.fetch_base(template)[0]
+        _publish_mixed_fleet(transport, template, genesis)
+        assert loop.run_round() is True
+        rec = lin.fetch_record(transport, transport.base_revision())
+
+        # a drifted contribution: m0 republished since the record froze
+        transport.publish_delta("m0", jax.tree_util.tree_map(
+            lambda x: np.ones(np.shape(x), np.float32), template))
+        with pytest.raises(lin.LineageError, match="drifted"):
+            lin.replay_record(transport, rec, template,
+                              parent=parent_params)
+
+        # a republished (mismatched) base: the store no longer names the
+        # recorded revision — loud, never a silent compare
+        transport.publish_base(jax.tree_util.tree_map(
+            lambda x: np.zeros(np.shape(x), np.float32), template))
+        # restore m0 so the failure isolates to the base mismatch
+        with pytest.raises(lin.LineageError):
+            lin.replay_record(transport, rec, template,
+                              parent=parent_params)
+    finally:
+        loop.close()
+
+
+def test_opaque_strategy_records_are_attribution_only(tmp_path, engine_cfg):
+    """OuterOptMerge publishes a NON-linear base: the record must say so
+    (replayable False) and the replay audit must refuse, not produce a
+    wrong parity number."""
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    engine, cfg = engine_cfg
+    template = host_wire_template(engine)
+    transport = LocalFSTransport(str(tmp_path))
+    plane = lin.LineagePlane(transport, node="avg")
+    loop = _averager(engine, transport, cfg, {"m0": 1.0, "m1": 2.0,
+                                              "m2": 3.0}, plane,
+                     strategy=OuterOptMerge(WeightedAverage()))
+    try:
+        loop.bootstrap(rng=jax.random.PRNGKey(0))
+        genesis = transport.base_revision()
+        _publish_mixed_fleet(transport, template, genesis)
+        assert loop.run_round() is True
+        rec = lin.fetch_record(transport, transport.base_revision())
+        assert rec["replayable"] is False
+        assert rec["weights_kind"] == "opaque"
+        # contributions still carry the audit facts
+        assert {c["hotkey"] for c in rec["contributions"]} \
+            == {"m0", "m1", "m2"}
+        with pytest.raises(lin.LineageError, match="not replayable"):
+            lin.replay_record(transport, rec, template,
+                              parent=transport.fetch_base(template)[0])
+    finally:
+        loop.close()
+
+
+def test_chaos_transport_gates_lineage_records_without_raising():
+    """ChaosTransport case: the reserved __lineage__ surface is gated
+    like every artifact — a publish fault degrades to the JSONL mirror
+    (False, counted), a fetch fault reads as None (counted) — and the
+    caller never sees an exception from the plane's public entries."""
+    inner = InMemoryTransport()
+    rec = _record()
+    dead = ChaosTransport(inner, ChaosSpec(publish_error_rate=1.0),
+                          role="avg")
+    assert lin.publish_record(dead, rec) is False
+    assert lin.fetch_record(inner, rec["revision"]) is None  # never landed
+    assert lin.publish_record(inner, rec) is True
+    blind = ChaosTransport(inner, ChaosSpec(fetch_error_rate=1.0),
+                           role="avg")
+    assert lin.fetch_record(blind, rec["revision"]) is None  # fault, quiet
+    got = lin.fetch_record(inner, rec["revision"])           # store intact
+    assert got["record_id"] == rec["record_id"]
+
+
+def test_lineage_publish_failure_is_isolated_from_the_round(tmp_path, engine_cfg):
+    """ChaosTransport case: every lineage publish faults; the merge
+    round still completes and publishes the base, the plane counts the
+    failure, and the record survives in the metrics-sink mirror."""
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    engine, cfg = engine_cfg
+    template = host_wire_template(engine)
+    inner = LocalFSTransport(str(tmp_path))
+
+    class _LineageChaos:
+        """Faults exactly the reserved __lineage__ publishes."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def publish_delta_raw(self, artifact_id, data):
+            if tbase.is_lineage_id(artifact_id):
+                raise OSError("injected lineage publish fault")
+            return self._inner.publish_raw(artifact_id, data)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    transport = _LineageChaos(inner)
+    plane = lin.LineagePlane(transport, node="avg")
+    loop = _averager(engine, transport, cfg, {"m0": 1.0, "m1": 2.0,
+                                              "m2": 3.0}, plane)
+    try:
+        loop.bootstrap(rng=jax.random.PRNGKey(0))
+        genesis = inner.base_revision()
+        _publish_mixed_fleet(inner, template, genesis)
+        assert loop.run_round() is True          # the round survived
+        rev = inner.base_revision()
+        assert rev != genesis                    # base landed
+        assert lin.fetch_record(inner, rev) is None   # record did not
+        assert plane.records >= 1                # ...but was built
+    finally:
+        loop.close()
+
+
+def test_signed_transport_envelopes_and_verifies_lineage_records(
+        tmp_path):
+    """SignedTransport case: records travel enveloped under the delta
+    context (attributable provenance); a tampered envelope reads as
+    absent/torn, never as a verified record."""
+    pytest.importorskip("cryptography")
+    from distributedtraining_tpu.transport.signed import SignedTransport
+    from distributedtraining_tpu.utils.identity import Identity
+
+    inner = InMemoryTransport()
+    ident = Identity.generate()
+    signed = SignedTransport(inner, identity=ident)
+    rec = _record()
+    assert lin.publish_record(signed, rec)
+    got = lin.fetch_record(signed, rec["revision"])
+    assert got["record_id"] == rec["record_id"]
+    # raw bytes on the wire are an envelope, not naked JSON
+    raw = inner.fetch_delta_bytes(tbase.lineage_id(rec["revision"]))
+    assert lin.parse_record(raw) is None or raw[:1] != b"{"
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical records
+# ---------------------------------------------------------------------------
+
+def test_subaverager_agg_record_replays_mixed_wire(tmp_path):
+    """A sub-averager's "agg" record re-derives the published aggregate
+    from a mixed v1+v2 slice — the hierarchical half of the acceptance
+    pin (the root's "base" record is pinned above)."""
+    template = _template()
+    transport = LocalFSTransport(str(tmp_path))
+    transport.publish_base(_tree(100))
+    base_rev = transport.base_revision()
+    transport.publish_delta("m0", _tree(1))
+
+    class _R:
+        pushes = pushes_failed = pushes_superseded = 0
+
+    packed, _ = dl.pack_delta_v2(_tree(2), density=1 / 8)
+    pub = DeltaPublisher(transport, "m1", report=_R(),
+                         publish_retry=FAST_RETRY, meta_retry=FAST_RETRY,
+                         wire_spec={"format": 2, "density": 1 / 8,
+                                    "quant": "int8"})
+    plane = lin.LineagePlane(transport, node="subavg.n0")
+    sub = SubAverager(transport, "n0", template, ["m0", "m1"],
+                      consensus={"m0": 1.0, "m1": 3.0},
+                      retry_policy=FAST_RETRY, publish_retry=FAST_RETRY,
+                      meta_retry=FAST_RETRY, ingest_workers=1,
+                      lineage=plane)
+    try:
+        assert pub.publish_now(jax.device_get(packed), None, base_rev)
+        assert sub.run_round() is True
+        agg_rev = transport.delta_revision(tbase.agg_id("n0"))
+        rec = lin.fetch_record(transport, agg_rev)
+        assert rec["kind"] == "agg"
+        assert rec["artifact"] == tbase.agg_id("n0")
+        assert rec["parent"] == base_rev
+        np.testing.assert_allclose(
+            [c["weight"] for c in rec["contributions"]], [0.25, 0.75])
+        res = lin.replay_record(transport, rec, template)
+        assert res.ok and res.max_abs_diff <= 1e-6
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_root_record_marks_agg_contributions(tmp_path, engine_cfg):
+    """In hier mode the root's record names the __agg__ artifacts (tier
+    "agg") with the subtree weight masses — the DAG's middle level."""
+    from distributedtraining_tpu.engine.hier_average import plan_fanout
+    from distributedtraining_tpu.engine.train import host_wire_template
+
+    engine, cfg = engine_cfg
+    template = host_wire_template(engine)
+    hotkeys = ["m0", "m1", "m2", "m3"]
+    consensus = {h: float(i + 1) for i, h in enumerate(hotkeys)}
+    transport = LocalFSTransport(str(tmp_path))
+    plane = lin.LineagePlane(transport, node="avg")
+    loop = AveragerLoop(
+        engine, transport, _Chain(hotkeys, consensus), WeightedAverage(),
+        val_batches=_eval_batches(cfg), publish_policy="always",
+        stale_deltas="skip", ingest_workers=1,
+        hierarchy=["n0", "n1"], lineage=plane)
+    subs = []
+    try:
+        loop.bootstrap(rng=jax.random.PRNGKey(0))
+        genesis = transport.base_revision()
+        parent_params = transport.fetch_base(template)[0]
+        for i, h in enumerate(hotkeys):
+            transport.publish_delta(h, jax.tree_util.tree_map(
+                lambda x, s=i: (0.01 * (s + 1) * np.random.RandomState(s)
+                                .randn(*np.shape(x))).astype(np.float32),
+                template))
+        plan = plan_fanout(hotkeys, nodes=["n0", "n1"])
+        for node, slice_ in plan.items():
+            sub = SubAverager(
+                transport, node, template, slice_, consensus=consensus,
+                retry_policy=FAST_RETRY, publish_retry=FAST_RETRY,
+                meta_retry=FAST_RETRY, ingest_workers=1,
+                lineage=lin.LineagePlane(transport,
+                                         node=f"subavg.{node}"))
+            subs.append(sub)
+            assert sub.run_round() is True
+        assert loop.run_round() is True
+        rec = lin.fetch_record(transport, transport.base_revision())
+        assert rec["parent"] == genesis
+        assert {c["hotkey"] for c in rec["contributions"]} \
+            == {tbase.agg_id("n0"), tbase.agg_id("n1")}
+        assert all(c.get("tier") == "agg" for c in rec["contributions"])
+        # each agg contribution's own record exists: the DAG level below
+        for c in rec["contributions"]:
+            sub_rec = lin.fetch_record(transport, c["rev"])
+            assert sub_rec is not None and sub_rec["kind"] == "agg"
+        # HIERARCHICAL replay (acceptance): the root's base record
+        # re-derives the published base from the __agg__ artifacts at
+        # the recorded per-subtree weights, parity <= 1e-6
+        res = lin.replay_record(transport, rec, template,
+                                parent=parent_params)
+        assert res.ok and res.max_abs_diff <= 1e-6
+    finally:
+        for sub in subs:
+            sub.close()
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# lineage_report report mode
+# ---------------------------------------------------------------------------
+
+def test_lineage_report_builds_dag_from_store_and_jsonl(tmp_path):
+    import importlib.util
+    import sys
+
+    transport = LocalFSTransport(str(tmp_path / "artifacts"))
+    r1 = _record(revision="rev1", parent=None, contributions=[],
+                 round_no=0, strategy="genesis", replayable=False)
+    r2 = _record(revision="rev2", parent="rev1")
+    lin.publish_record(transport, r1)
+    lin.publish_record(transport, r2)
+    transport.publish_base(_tree(0))   # head probe target (any base)
+    jsonl = tmp_path / "avg.jsonl"
+    r3 = _record(revision="rev3", parent="rev2")   # history: mirror only
+    jsonl.write_text(json.dumps({"lineage": r3}, default=float) + "\n")
+
+    spec = importlib.util.spec_from_file_location(
+        "lineage_report", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "lineage_report.py"))
+    lr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lr)
+    rep = lr.build_report(transport, lr._load_jsonl_records([str(jsonl)]))
+    revs = {r["revision"]: r for r in rep["revisions"]}
+    assert set(revs) == {"rev1", "rev2", "rev3"}
+    assert revs["rev2"]["source"] == "store"
+    assert revs["rev3"]["source"] == "jsonl"
+    assert rep["miners"]["m0"]["merges"] == 2
+    text = lr.format_report(rep)
+    assert "rev2" in text and "contribution rollup" in text

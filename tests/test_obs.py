@@ -524,3 +524,72 @@ def test_correlation_id_roundtrip_localfs(tmp_path):
     merged_ids = [r["merge_delta_ids"] for r in arecs
                   if "merge_delta_ids" in r]
     assert merged_ids and merged_ids[-1] == {"hotkey_0": cid}
+
+
+# ---------------------------------------------------------------------------
+# Doc-drift lint: every dt_* name the exporter can emit is documented
+# ---------------------------------------------------------------------------
+
+def test_every_exporter_metric_name_is_documented():
+    """Doc-drift lint (PR-13 satellite, the metric twin of the
+    EVENT_KINDS/devprof producer-lint discipline): every dt_* metric
+    name the Prometheus exporter (utils/obs_http.py) can emit must
+    appear in docs/observability.md. Three emission sources:
+
+    - registry names: every LITERAL first argument of obs.count /
+      obs.gauge across the package (dynamic f-string names are covered
+      by their documented ``<rule>``-style placeholder rows and are
+      not enumerable statically);
+    - span names: every literal obs.span(...) name (rendered as
+      ``span.<name>_ms`` / the span taxonomy table);
+    - labeled families: the _FLEET_SERIES ledger series, the SLO
+      breach family, and every literal dt_* family in
+      utils/devprof.py + utils/obs_http.py.
+
+    A metric added without a doc row fails HERE, at the producer, not
+    in a dashboard review months later."""
+    import ast
+    import glob as _glob
+    import re
+
+    import distributedtraining_tpu as pkg
+    from distributedtraining_tpu.utils import devprof, obs_http
+
+    root = os.path.dirname(pkg.__file__)
+    doc_path = os.path.join(os.path.dirname(root), "docs",
+                            "observability.md")
+    doc = open(doc_path).read()
+
+    counter_names: set[str] = set()
+    span_names: set[str] = set()
+    for path in _glob.glob(os.path.join(root, "**", "*.py"),
+                           recursive=True):
+        tree = ast.parse(open(path).read(), filename=path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "obs"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            if node.func.attr in ("count", "gauge"):
+                counter_names.add(node.args[0].value)
+            elif node.func.attr == "span":
+                span_names.add(node.args[0].value)
+
+    families = {"dt_" + suffix for _, suffix, _ in obs_http._FLEET_SERIES}
+    families.add("dt_fleet_slo_breached")
+    for mod in (devprof, obs_http):
+        src = open(mod.__file__).read()
+        families |= set(re.findall(r'"(dt_[a-z0-9_]+)"', src))
+
+    missing = sorted(
+        [n for n in counter_names if n not in doc]
+        + [f"span:{n}" for n in span_names if n not in doc]
+        + [f for f in families if f not in doc])
+    assert not missing, (
+        "metric names the exporter can emit are missing from "
+        f"docs/observability.md: {missing} — add a table row (or a "
+        "placeholder rule row) for each")
