@@ -636,6 +636,136 @@ def _time_wire_v2(*, trials: int = 2) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _time_base_distribution(*, trials: int = 1) -> dict:
+    """Base-distribution A/B over localfs (round-19 tentpole): the
+    monolithic fetch_base pull vs the content-addressed sharded
+    delta-pull (engine/basedist.py) of the IDENTICAL base tree.
+
+      base_mono_bytes_per_pull    bytes one monolithic pull moves
+                                  (the full model, every round)
+      base_dist_cold_bytes        bytes the FIRST sharded pull moves
+                                  (manifest + every shard — a cold
+                                  fetcher pays the model once)
+      base_dist_warm_bytes        bytes a warm pull moves when ONE
+                                  layer changed (manifest + 1 shard)
+      base_warm_bytes_ratio       mono / sharded-warm (acceptance:
+                                  >= 5 — the ISSUE's byte-reduction
+                                  gate)
+      base_unchanged_layer_bytes  shard bytes fetched for UNCHANGED
+                                  layers that round (acceptance:
+                                  exactly 0 — store-granular dedupe)
+      base_warm_hit_rate          store hit fraction that round
+      base_mono_fetch_ms /        end-to-end host cost of one warm
+      base_dist_fetch_ms          pull, each path
+      base_dist_parity            sharded tree == monolithic tree,
+                                  bit-exact (the fetched base IS the
+                                  published base either way)
+
+    trials=1 and a mini GPT2Config: the contrast is artifact BYTES —
+    a transport-independent quantity — and the tier-1 budget is
+    tight."""
+    import shutil
+    import tempfile
+
+    from distributedtraining_tpu.engine.basedist import (BaseFetcher,
+                                                         BasePublisher)
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import LocalFSTransport
+
+    cfg = gpt2.GPT2Config(vocab_size=256, n_positions=64, n_embd=32,
+                          n_head=2, n_layer=2)
+    model, cfg = gpt2.make_model(cfg)
+    base = jax.device_get(model.init_params(jax.random.PRNGKey(0)))
+    template = jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), np.asarray(x).dtype), base)
+
+    tmp = tempfile.mkdtemp(prefix="basedist_bench_")
+    fetched: list[tuple[str, int]] = []
+
+    class CountFS(LocalFSTransport):
+        def fetch_delta_bytes(self, mid):
+            d = super().fetch_delta_bytes(mid)
+            if d is not None:
+                fetched.append((mid, len(d)))
+            return d
+
+        def fetch_base_bytes(self):
+            d = super().fetch_base_bytes()
+            if d is not None:
+                fetched.append(("__mono__", len(d)))
+            return d
+
+    try:
+        transport = CountFS(tmp)
+        pub = BasePublisher(transport)
+        rev = transport.publish_base(base)
+        assert pub.publish_revision(base, rev)
+        mono_bytes = os.path.getsize(
+            os.path.join(tmp, "base", "averaged_model.msgpack"))
+
+        # -- cold sharded pull + parity vs monolithic -------------------
+        f = BaseFetcher(transport)
+        fetched.clear()
+        got = f.fetch(template)
+        assert got is not None and got[1] == rev
+        cold_bytes = sum(n for _, n in fetched)
+        mono = transport.fetch_base(template)
+        parity = mono is not None and all(
+            np.array_equal(a, b) for a, b in
+            zip(jax.tree_util.tree_leaves(got[0]),
+                jax.tree_util.tree_leaves(mono[0])))
+
+        # -- warm rounds: ONE layer changes per trial (wpe — a mid-size
+        # tensor; the sparse-delta merge regime moves a few layers per
+        # round, not the whole tree, and the A/B isolates exactly that)
+        warm_bytes, unchanged, hits = [], [], []
+        dist_ms, mono_ms = [], []
+        b2 = dict(base)
+        for i in range(trials):
+            b2 = dict(b2)
+            b2["wpe"] = (np.asarray(b2["wpe"])
+                         + np.float32(0.001 * (i + 1)))
+            rev2 = transport.publish_base(b2)
+            assert pub.publish_revision(b2, rev2)
+            fetched.clear()
+            lookups0 = f.shard_lookups_total
+            hits0 = f.store_hits_total
+            t0 = time.perf_counter()
+            got2 = f.fetch(template)
+            dist_ms.append((time.perf_counter() - t0) * 1e3)
+            assert got2 is not None and got2[1] == rev2
+            assert f.fallbacks_total == 0   # stayed on the shard plane
+            warm_bytes.append(sum(n for _, n in fetched))
+            unchanged.append(sum(
+                n for mid, n in fetched
+                if mid.startswith("__base__.s.") and "wpe" not in mid))
+            looked = f.shard_lookups_total - lookups0
+            hits.append((f.store_hits_total - hits0) / max(1, looked))
+            parity = parity and np.array_equal(got2[0]["wpe"], b2["wpe"])
+            t0 = time.perf_counter()
+            mono2 = transport.fetch_base(template)
+            mono_ms.append((time.perf_counter() - t0) * 1e3)
+            parity = parity and mono2 is not None and all(
+                np.array_equal(a, b) for a, b in
+                zip(jax.tree_util.tree_leaves(got2[0]),
+                    jax.tree_util.tree_leaves(mono2[0])))
+
+        warm = float(np.mean(warm_bytes))
+        return {
+            "base_mono_bytes_per_pull": int(mono_bytes),
+            "base_dist_cold_bytes": int(cold_bytes),
+            "base_dist_warm_bytes": int(warm),
+            "base_warm_bytes_ratio": round(mono_bytes / max(warm, 1.0), 1),
+            "base_unchanged_layer_bytes": int(sum(unchanged)),
+            "base_warm_hit_rate": round(float(np.mean(hits)), 3),
+            "base_mono_fetch_ms": round(float(np.mean(mono_ms)), 2),
+            "base_dist_fetch_ms": round(float(np.mean(dist_ms)), 2),
+            "base_dist_parity": bool(parity),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _time_hier_average(*, n_miners: int = 32, fanout: int = 4,
                        trials: int = 2) -> dict:
     """Hierarchical averager A/B (round-13 tentpole): the flat
@@ -1834,6 +1964,15 @@ def main(argv=None) -> None:
         extras.update(_time_wire_v2())
     except Exception as e:
         extras["wire_v2_error"] = repr(e)
+
+    try:
+        # monolithic base pull vs content-addressed sharded delta-pull
+        # over localfs (round-19 tentpole): warm-round base-fetch bytes
+        # collapse to manifest + changed shards, unchanged layers fetch
+        # zero, fetched base bit-exact either way
+        extras.update(_time_base_distribution())
+    except Exception as e:
+        extras["base_distribution_error"] = repr(e)
 
     try:
         # flat single-node merge vs fanout tree aggregation over localfs

@@ -100,6 +100,16 @@ class RunConfig:
     wire_density: float = 1.0 / 64.0         # v2 kept-coordinate ratio
     wire_quant: str = "int8"                 # v2 kept values: int8 | none
     accept_wire_v2: bool = True              # receivers: decode v2 manifests
+    # content-addressed base distribution (engine/basedist.py): the
+    # averager publishes hash-addressed per-layer base shards + a signed
+    # per-revision manifest next to the monolithic base; fetchers
+    # delta-pull only changed-hash layers, racing __mirror__ replicas
+    # before the origin. The monolithic artifact stays the fallback, so
+    # mixed old/new fleets interoperate with no flag day.
+    base_wire_v2: bool = True                # sharded publish + delta-pull
+    base_mirrors: str = ""                   # comma list of mirror nodes
+    base_mirror: bool = True                 # sub-averagers: mirror duty
+    base_store_mb: int = 1024                # local shard-store budget
     remat: Optional[bool] = None             # per-block rematerialization
     prefetch_depth: int = 2                  # host pipeline look-ahead (0=off)
     accum_steps: int = 1                     # microbatches per optimizer step
@@ -358,6 +368,39 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                    help="hotkey expected to sign the published base model "
                         "(the averager's); with a registered pubkey, base "
                         "fetches then REQUIRE a valid signature")
+    g.add_argument("--base-wire-v2", dest="base_wire_v2",
+                   action="store_true", default=d.base_wire_v2,
+                   help="content-addressed sharded base distribution "
+                        "(engine/basedist.py; default ON): the averager "
+                        "publishes each base as hash-addressed per-layer "
+                        "shards + a signed per-revision manifest NEXT TO "
+                        "the monolithic artifact, and fetchers pull only "
+                        "changed-hash layers (unchanged layer = 0 bytes), "
+                        "racing any mirror that has the hash before the "
+                        "origin. Mixed fleets need no flag day: the "
+                        "monolithic base stays the fallback")
+    g.add_argument("--no-base-wire-v2", dest="base_wire_v2",
+                   action="store_false",
+                   help="monolithic-only base distribution (the reference "
+                        "posture): the averager publishes no shard plane "
+                        "and fetchers never probe for manifests")
+    g.add_argument("--base-mirrors", dest="base_mirrors",
+                   default=d.base_mirrors,
+                   help="comma list of mirror node ids this fetcher races "
+                        "for base shards before the origin (normally the "
+                        "fleet's __agg__ sub-averager nodes; the "
+                        "averager's announce rider extends the list at "
+                        "run time)")
+    g.add_argument("--no-base-mirror", dest="base_mirror",
+                   action="store_false", default=d.base_mirror,
+                   help="sub-averagers only: do NOT re-publish base "
+                        "shards under this node's __mirror__ slots")
+    g.add_argument("--base-store-mb", dest="base_store_mb", type=int,
+                   default=d.base_store_mb,
+                   help="byte budget of the local content-addressed base "
+                        "shard store (the delta-pull dedupe memory; 0 "
+                        "disables caching — every sharded pull re-fetches "
+                        "all layers)")
 
     g = p.add_argument_group("model")
     g.add_argument("--model", default=d.model)

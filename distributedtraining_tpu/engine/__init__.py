@@ -10,6 +10,8 @@ pattern made first-class (SURVEY.md §4).
 from .scheduler import Clock, RealClock, FakeClock, PeriodicAction
 from .train import TrainEngine, MinerLoop, TrainState, default_optimizer
 from .lora_train import LoRAEngine, LoRAMinerLoop, fetch_delta_any
+from .basedist import (BaseFetcher, BasePublisher, BaseShardStore,
+                       MirrorDuty)
 from .batched_eval import BatchedCohortEvaluator, stage_cohorts
 from .health import (FleetMonitor, HeartbeatPublisher, NodeHealth, SLORule,
                      Vitals, default_slo_rules, report_vitals)
@@ -33,6 +35,7 @@ __all__ = [
     "Clock", "RealClock", "FakeClock", "PeriodicAction",
     "TrainEngine", "MinerLoop", "TrainState", "default_optimizer",
     "LoRAEngine", "LoRAMinerLoop", "fetch_delta_any",
+    "BaseFetcher", "BasePublisher", "BaseShardStore", "MirrorDuty",
     "BatchedCohortEvaluator", "stage_cohorts",
     "DeltaCache", "DeltaIngestor", "IngestPool", "StagedDelta",
     "DeltaPublisher", "PublishWorker", "SupersedeQueue",

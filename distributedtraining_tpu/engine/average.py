@@ -573,7 +573,8 @@ class AveragerLoop:
                  remediation=None,
                  lease=None,
                  hierarchy: Sequence[str] | None = None,
-                 lineage=None):
+                 lineage=None,
+                 base_dist=None):
         self.engine = engine
         # fleet health plane (engine/health.py FleetMonitor): polled at
         # the round cadence, fed the EXACT staging outcomes each gather
@@ -600,6 +601,14 @@ class AveragerLoop:
         # held-out loss to the quality-drift detector. None = no
         # provenance (the reference posture).
         self.lineage = lineage
+        # base distribution plane (engine/basedist.BasePublisher): each
+        # monolithic publish_base is followed by the hash-addressed
+        # shard set + per-revision manifest, so sharded fetchers
+        # delta-pull only changed layers while legacy fetchers keep the
+        # monolithic artifact. None = monolithic-only (the reference
+        # posture, --no-base-wire-v2). Single-host only: on a pod the
+        # coordinator-gated monolithic publish stays the whole story.
+        self.base_dist = base_dist
         # agg artifact id -> declared weight sum (meta rider), per round
         self._round_agg_weights: dict[str, float] = {}
         self.transport = transport
@@ -712,8 +721,9 @@ class AveragerLoop:
             self.base_params = template
             # the averager owns the shared repo and publishes the first base
             # (averaging_logic.py:549-568); coordinator-gated on a pod
-            self._base_revision = self.transport.publish_base(
-                wire_out(self.engine, template))
+            wire_tree = wire_out(self.engine, template)
+            self._base_revision = self.transport.publish_base(wire_tree)
+            self._publish_base_dist(wire_tree)
             if self.lineage is not None and self._base_revision:
                 # the DAG root: a genesis record with no parent and no
                 # contributions, so every later revision's chain
@@ -850,6 +860,23 @@ class AveragerLoop:
                     return None
             out.append((h, rev))
         return frozenset(out)
+
+    def _publish_base_dist(self, wire_tree: Params) -> None:
+        """Shard-plane publication for the revision that just landed
+        monolithically (engine/basedist.py): changed shards, then the
+        per-revision manifest, then the announce rider. Isolated AND
+        single-host only — a shard-plane failure degrades fetchers to
+        the monolithic base they already have, never the round; a pod's
+        publish is coordinator-gated at the monolithic layer and stays
+        monolithic-only."""
+        if self.base_dist is None or self._base_revision is None \
+                or self._multi():
+            return
+        try:
+            self.base_dist.publish_revision(wire_tree, self._base_revision)
+        except Exception:
+            logger.exception("averager: sharded base publish failed; "
+                             "fetchers stay on the monolithic base")
 
     def _record_lineage(self, ids: list[str], weights, consensus,
                         parent: str | None, loss: float) -> None:
@@ -1037,8 +1064,9 @@ class AveragerLoop:
         parent_revision = self._base_revision
         from .train import wire_out
         with obs.span("avg.publish", cids=cids):
-            self._base_revision = self.transport.publish_base(
-                wire_out(self.engine, merged))
+            wire_tree = wire_out(self.engine, merged)
+            self._base_revision = self.transport.publish_base(wire_tree)
+            self._publish_base_dist(wire_tree)
         if self.metrics:
             self.metrics.log({"merged_loss": loss, "merged_ppl": ppl,
                               "accepted": len(ids), "published": 1,

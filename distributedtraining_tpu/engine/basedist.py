@@ -1,0 +1,699 @@
+"""Content-addressed base-model distribution: sharded publish, regional
+mirrors, delta-pull rounds.
+
+Wire v2 (docs/wire.md) made miner deltas ~23x smaller, which left the
+BASE-MODEL broadcast as the dominant bytes-on-wire: every miner,
+validator, and server pulled the full new base as one monolithic blob
+from a single origin every round — an incast that scales linearly with
+fleet size (ROADMAP item 3). This module applies the same
+shard-the-update insight (arXiv 2004.13336) to the distribution
+channel, with the any-replica-dies-is-a-non-event posture of
+arXiv 2606.15870:
+
+- :class:`BasePublisher` — the averager publishes each new base as
+  hash-addressed per-layer shards (``__base__.s.<slug>`` slots, only
+  CHANGED hashes re-upload) plus one small signed manifest under the
+  per-revision ``__base__.<revision>`` id, MANIFEST-LAST like
+  ``DeltaPublisher._publish_v2`` so a torn shard set is never decoded.
+  The monolithic ``publish_base`` artifact still lands FIRST and stays
+  the source of truth: it defines the revision the manifest names, and
+  it is the fallback every pre-round-19 (or ``--no-base-wire-v2``)
+  fetcher keeps using — the mixed-fleet negotiation needs no flag day.
+  A ``{"base_wire": ...}`` META rider on the stable ``__base__`` id
+  announces the plane + the mirror list (the v1/v2-delta-style
+  declaration).
+- :class:`BaseFetcher` — fetchers diff the new manifest against their
+  local content-addressed :class:`BaseShardStore` and pull ONLY
+  changed-hash layers: a warm-round base pull is KBs (manifest + the
+  layers the merge actually moved), an unchanged layer is 0 bytes. Per
+  shard, the fetcher races replicas — announced/configured MIRRORS
+  first (rotating so load spreads), then origin — verifying every
+  fetched shard against the manifest sha256 whatever slot served it.
+  A replica that fails accumulates strikes and is skipped for a
+  cooldown (per-replica backoff without wall-clock sleeps). ANY
+  sharded-path failure — missing/hostile/torn manifest, unreachable
+  shards, shape drift — degrades to the monolithic pull, and a
+  successful monolithic fetch SEEDS the store (pack_base_shard is
+  deterministic in the array bytes, so locally-derived digests match
+  the publisher's), making the next round warm anyway.
+- :class:`MirrorDuty` — ``__agg__`` sub-averagers double as regional
+  mirrors: each round they pull the manifest, fetch only the shards
+  whose hash they have not yet replicated, re-publish them under
+  ``shard_id(__mirror__.<node>, layer)`` slots, and stamp a presence
+  rider naming the revision they hold. Mirrors never need their own
+  manifest — content addressing means a fetcher verifies mirror bytes
+  against the ORIGIN's signed manifest.
+
+Pod rule: multi-host roles keep the coordinator-read + broadcast
+monolithic path (engine/train.broadcast_base_fetch) — the shard plane
+is a single-host fetch optimization; a pod pays one coordinator pull
+either way.
+
+Registry metrics (``base.*`` family — docs/observability.md): publish
+side ``base.shards_uploaded`` / ``base.shards_skipped`` /
+``base.bytes_published`` / ``base.manifest_publishes`` /
+``base.publish_failures``; fetch side ``base.bytes_fetched`` /
+``base.shards_fetched`` / ``base.shards_deduped`` /
+``base.mirror_hits`` / ``base.mirror_bytes`` / ``base.origin_bytes`` /
+``base.replica_misses`` / ``base.torn_fetches`` /
+``base.manifest_rejects`` / ``base.monolithic_fallbacks`` /
+``base.sharded_fetches`` and the ``base.fetch_ms`` histogram; mirror
+side ``base.mirror_publishes`` / ``base.mirror_sync_bytes`` /
+``base.mirror_rounds``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .. import serialization as ser
+from ..transport import base as tbase
+from ..utils import flight, obs
+
+logger = logging.getLogger(__name__)
+
+Params = Any
+
+# replicas with this many consecutive failures are skipped for
+# STRIKE_COOLDOWN subsequent shard attempts (deterministic backoff in
+# operation counts, not wall-clock — fleetsim stays seeded)
+REPLICA_STRIKES = 2
+STRIKE_COOLDOWN = 16
+
+DEFAULT_STORE_BYTES = 1 << 30
+
+
+def base_layer_items(tree: Params) -> dict[str, np.ndarray]:
+    """Host split of a WIRE-layout base tree into its shard units: one
+    ``"a/b/c" -> ndarray`` per leaf, keys "/"-joined state-dict paths —
+    the layer keys the base manifest addresses
+    (serialization.build_base_manifest). Publisher-side on its OWN tree
+    (or on a template whose paths are trusted), so a path component
+    containing "/" raises instead of producing ambiguous keys."""
+    import jax
+
+    out: dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        parts = ser.path_components(path)
+        if any("/" in p for p in parts):
+            raise ValueError(f"base_layer_items: path component with '/' "
+                             f"in {parts!r} would make layer keys "
+                             "ambiguous")
+        out["/".join(parts)] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def assemble_base_tree(entries: dict[str, np.ndarray],
+                       template: Params) -> Params | None:
+    """Inverse of :func:`base_layer_items` against a trusted template:
+    reassemble fetched layer arrays into the template's structure,
+    validating per-leaf shape AND dtype (the base's dtype IS the
+    contract — a shard that parses at the wrong dtype would silently
+    change training numerics). None on any mismatch."""
+    import jax
+
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tmpl_leaf in paths:
+        key = "/".join(ser.path_components(path))
+        arr = entries.get(key)
+        if arr is None:
+            return None
+        t = np.asarray(tmpl_leaf)
+        if tuple(arr.shape) != tuple(t.shape) or arr.dtype != t.dtype:
+            return None
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), leaves)
+
+
+class BaseShardStore:
+    """LRU host store of base-layer arrays keyed by shard CONTENT hash
+    (sha256 of the shard bytes). Thread-safe: the serve watcher stages
+    off-thread while the role main may seed. Holding DECODED arrays
+    (not bytes) makes warm-round assembly free for unchanged layers —
+    the mirror path, which needs bytes, re-encodes deterministically."""
+
+    def __init__(self, max_bytes: int = DEFAULT_STORE_BYTES):
+        self.max_bytes = max(0, int(max_bytes))
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, tuple[np.ndarray, int]] = \
+            OrderedDict()
+        self._bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, digest: str) -> np.ndarray | None:
+        if self.max_bytes <= 0 or not isinstance(digest, str):
+            return None
+        with self._lock:
+            hit = self._entries.get(digest)
+            if hit is None:
+                return None
+            self._entries.move_to_end(digest)
+            return hit[0]
+
+    def put(self, digest: str, arr: np.ndarray) -> None:
+        if self.max_bytes <= 0 or not isinstance(digest, str):
+            return
+        nb = int(np.asarray(arr).nbytes)
+        if nb > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[digest] = (arr, nb)
+            self._bytes += nb
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, (_, ev_nb) = self._entries.popitem(last=False)
+                self._bytes -= ev_nb
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+# ---------------------------------------------------------------------------
+# Publisher (averager side)
+# ---------------------------------------------------------------------------
+
+class BasePublisher:
+    """Shard-plane publication for the averager's base publishes.
+
+    ``publish_revision(tree, revision)`` runs AFTER the monolithic
+    ``publish_base`` landed (the revision it names): serialize + hash
+    every wire-layout layer, upload only the shards whose content hash
+    changed since the last CONFIRMED publish, then the manifest, then
+    the announce rider. Manifest-last is the torn-set invariant;
+    ``_last_shards`` advances only once the manifest lands, so a failed
+    publish re-uploads everything unconfirmed next round. Failures
+    degrade the shard plane, never the round — the monolithic base is
+    already out, and fetchers fall back to it.
+
+    ``mirrors`` names the mirror nodes the announce rider advertises
+    (normally the fleet's ``__agg__`` hierarchy nodes)."""
+
+    def __init__(self, transport, *, mirrors: Sequence[str] = (),
+                 publish_retry=None,
+                 sleep: Callable[[float], None] | None = None):
+        from ..transport.retry import DEFAULT_PUBLISH_RETRY
+        self.transport = transport
+        self.mirrors = [str(m) for m in mirrors]
+        self.publish_retry = publish_retry or DEFAULT_PUBLISH_RETRY
+        self._sleep = sleep
+        # layer_key -> sha256 of the last shard set the FLEET can see
+        # (advanced only after the manifest commits) — publisher-side
+        # dedupe, the exact twin of DeltaPublisher._last_shards
+        self._last_shards: dict[str, str] = {}
+
+    def publish_revision(self, tree: Params, revision: str) -> bool:
+        """Publish ``tree``'s shard set + manifest for the
+        already-landed monolithic ``revision``. Returns True when the
+        manifest committed; False (logged + counted) on any failure."""
+        from ..transport.retry import call_with_retry
+        kw = {"sleep": self._sleep} if self._sleep is not None else {}
+        try:
+            entries = base_layer_items(tree)
+            shards = {k: ser.pack_base_shard(a) for k, a in entries.items()}
+            layers = {k: (ser.shard_digest(d), len(d))
+                      for k, d in shards.items()}
+            manifest = ser.build_base_manifest(layers, revision=revision)
+        except Exception:
+            obs.count("base.publish_failures")
+            logger.exception("base publisher: shard encode failed; "
+                             "fetchers stay on the monolithic base")
+            return False
+        changed = [k for k, (digest, _) in layers.items()
+                   if self._last_shards.get(k) != digest]
+        shards_done = 0
+        try:
+            for key in changed:
+                data = shards[key]
+                call_with_retry(
+                    lambda key=key, data=data: tbase.publish_base_shard(
+                        self.transport, key, data),
+                    policy=self.publish_retry,
+                    describe=f"base shard {key}", **kw)
+                obs.count("base.bytes_published", len(data))
+                shards_done += 1
+            obs.count("base.shards_uploaded", len(changed))
+            obs.count("base.shards_skipped", len(shards) - len(changed))
+            call_with_retry(
+                lambda: tbase.publish_base_manifest(
+                    self.transport, revision, manifest),
+                policy=self.publish_retry,
+                describe="base manifest publish", **kw)
+        except Exception:
+            # torn shard set: some shards landed, the manifest did not.
+            # Fetchers are safe (no manifest for this revision -> they
+            # stay monolithic; fetchers of the PREVIOUS manifest see
+            # hash mismatches and fall back) — but the flight ring must
+            # name the tear, like a torn delta publish.
+            obs.count("base.publish_failures")
+            flight.record("publish", outcome="torn",
+                          hotkey=tbase.BASE_PREFIX,
+                          cid=obs.current_cid() or "",
+                          shards_done=shards_done,
+                          shards_total=len(changed), manifest=False)
+            logger.exception("base publisher: sharded publish failed "
+                             "(monolithic base already out)")
+            return False
+        obs.count("base.bytes_published", len(manifest))
+        obs.count("base.manifest_publishes")
+        self._last_shards = {k: digest for k, (digest, _) in layers.items()}
+        flight.record("publish", outcome="ok", hotkey=tbase.BASE_PREFIX,
+                      cid=obs.current_cid() or "", wire="base")
+        self._announce(revision)
+        return True
+
+    def _announce(self, revision: str) -> None:
+        """Stamp the base-wire declaration rider on the stable
+        ``__base__`` id (rider-last: it names a manifest that already
+        committed, so the only inconsistent window reads as
+        not-yet-announced — fetchers then probe the manifest id anyway
+        or stay monolithic for one round). Best-effort, like the
+        delta-meta rider."""
+        pm = getattr(self.transport, "publish_delta_meta", None)
+        if pm is None:
+            return
+        try:
+            pm(tbase.BASE_PREFIX,
+               {"base_wire": {"format": 1, "revision": revision,
+                              "mirrors": self.mirrors}})
+        except Exception:
+            logger.warning("base publisher: announce rider failed; "
+                           "fetchers discover the manifest by probe",
+                           exc_info=True)
+
+
+def read_base_wire_rider(transport) -> dict | None:
+    """Defensive read of the averager's base-wire declaration:
+    ``{"revision": str, "mirrors": [str, ...]}`` or None. All
+    peer-controlled; anything malformed reads as absent (= old
+    averager = monolithic-only), never an exception."""
+    fm = getattr(transport, "fetch_delta_meta", None)
+    if fm is None:
+        return None
+    try:
+        meta = fm(tbase.BASE_PREFIX)
+    except Exception:
+        return None
+    if not isinstance(meta, dict):
+        return None
+    bw = meta.get("base_wire")
+    if not isinstance(bw, dict) or bw.get("format") != 1:
+        return None
+    rev = bw.get("revision")
+    if not (isinstance(rev, str) and 0 < len(rev) <= 200):
+        return None
+    mirrors = bw.get("mirrors")
+    out_mirrors = []
+    if isinstance(mirrors, list):
+        for m in mirrors[:64]:
+            if isinstance(m, str) and 0 < len(m) <= 200:
+                out_mirrors.append(m)
+    return {"revision": rev, "mirrors": out_mirrors}
+
+
+# ---------------------------------------------------------------------------
+# Fetcher (miner / validator / server side)
+# ---------------------------------------------------------------------------
+
+class BaseFetcher:
+    """Delta-pull base fetches with mirror racing and monolithic
+    fallback. One instance per role, long-lived: the shard store and
+    the replica strike ledger live across rounds.
+
+    ``mirrors`` are CONFIGURED mirror nodes; the announce rider's list
+    is unioned in at fetch time, current-revision advertisers first.
+    ``fetch`` NEVER raises: every failure path counts, logs, and
+    degrades — first to the monolithic pull, then to None ("no new
+    base", the contract every caller already handles)."""
+
+    def __init__(self, transport, *, store: BaseShardStore | None = None,
+                 store_bytes: int = DEFAULT_STORE_BYTES,
+                 mirrors: Sequence[str] = (),
+                 enabled: bool = True):
+        self.transport = transport
+        self.store = store if store is not None \
+            else BaseShardStore(store_bytes)
+        self.mirrors = [str(m) for m in mirrors]
+        self.enabled = enabled
+        # replica -> (strikes, cooldown remaining); None key = origin
+        self._strikes: dict[str, int] = {}
+        self._cooldown: dict[str, int] = {}
+        self._rotate = 0
+        self._lock = threading.Lock()
+        # lifetime stats (heartbeat extras / fleet_report columns)
+        self.bytes_fetched_total = 0
+        self.mirror_hits_total = 0
+        self.network_shards_total = 0
+        self.shard_lookups_total = 0
+        self.store_hits_total = 0
+        self.last_fetch_bytes = 0
+        self.fallbacks_total = 0
+        self.sharded_fetches_total = 0
+
+    # -- replica bookkeeping -------------------------------------------------
+    def _replica_ok(self, node: str) -> None:
+        with self._lock:
+            self._strikes.pop(node, None)
+            self._cooldown.pop(node, None)
+
+    def _replica_failed(self, node: str) -> None:
+        with self._lock:
+            s = self._strikes.get(node, 0) + 1
+            self._strikes[node] = s
+            if s >= REPLICA_STRIKES:
+                self._cooldown[node] = STRIKE_COOLDOWN
+
+    def _skip(self, node: str) -> bool:
+        """Consume one cooldown tick; True while the replica is benched
+        (per-replica backoff measured in shard attempts, not seconds —
+        deterministic under the fleetsim's virtual clock)."""
+        with self._lock:
+            left = self._cooldown.get(node, 0)
+            if left <= 0:
+                return False
+            self._cooldown[node] = left - 1
+            if self._cooldown[node] <= 0:
+                del self._cooldown[node]
+                self._strikes.pop(node, None)
+            return True
+
+    def _replica_order(self, rider: dict | None) -> list[str]:
+        """Mirror try-order for this fetch: rider-advertised mirrors
+        (they claim the current revision) before configured-only ones,
+        rotated per fetch so a fleet of fetchers spreads across
+        replicas instead of piling onto the first."""
+        advertised = list((rider or {}).get("mirrors") or ())
+        rest = [m for m in self.mirrors if m not in advertised]
+        order = advertised + rest
+        if len(order) > 1:
+            with self._lock:
+                self._rotate = (self._rotate + 1) % len(order)
+                r = self._rotate
+            order = order[r:] + order[:r]
+        return order
+
+    # -- the fetch -----------------------------------------------------------
+    def fetch(self, template: Params,
+              revision: str | None = None
+              ) -> tuple[Params, str | None] | None:
+        """Fetch the current base: sharded delta-pull when a manifest
+        exists for the observed revision, else the monolithic pull.
+        Returns ``(wire-layout tree, revision)`` or None."""
+        t0 = time.perf_counter()
+        rev = revision
+        if rev is None:
+            try:
+                rev = self.transport.base_revision()
+            except Exception:
+                logger.warning("base fetch: revision probe failed",
+                               exc_info=True)
+                return None
+        if rev is None:
+            return None
+        self.last_fetch_bytes = 0
+        got = self._fetch_sharded(template, rev) if self.enabled else None
+        if got is None:
+            got = self._fetch_monolithic(template, rev)
+        if got is not None:
+            obs.observe("base.fetch_ms",
+                        (time.perf_counter() - t0) * 1e3)
+        return got
+
+    def seed(self, tree: Params) -> None:
+        """Warm the shard store from a base obtained OUT of band (a
+        restored checkpoint, a monolithic boot fetch): pack each layer
+        locally — the encoding is deterministic in the array bytes, so
+        the digests match the publisher's and the next sharded fetch
+        pulls only what actually changed."""
+        try:
+            for key, arr in base_layer_items(tree).items():
+                data = ser.pack_base_shard(arr)
+                self.store.put(ser.shard_digest(data), arr)
+        except Exception:
+            logger.warning("base fetch: store seeding failed",
+                           exc_info=True)
+
+    # -- sharded path --------------------------------------------------------
+    def _fetch_sharded(self, template: Params, rev: str):
+        try:
+            data = tbase.fetch_base_manifest_bytes(self.transport, rev)
+        except Exception:
+            obs.count("base.replica_misses")
+            return None
+        if data is None:
+            return None   # old averager / mid-publish: monolithic pull
+        self.last_fetch_bytes += len(data)
+        self.bytes_fetched_total += len(data)
+        obs.count("base.bytes_fetched", len(data))
+        obs.count("base.origin_bytes", len(data))
+        from .. import signing
+        man = ser.parse_base_manifest(signing.strip_envelope(bytes(data)))
+        if man is None or man["revision"] != rev:
+            # hostile/torn/mismatched manifest: LOUD (counted + warned),
+            # then degrade to the monolithic truth — the satellite-fix
+            # contract: a bad manifest is "no sharded set", never a
+            # mid-round crash
+            obs.count("base.manifest_rejects")
+            logger.warning("base fetch: manifest for %s rejected "
+                           "(hostile or torn); falling back to the "
+                           "monolithic base", rev and rev[:8])
+            return None
+        rider = read_base_wire_rider(self.transport)
+        replicas = self._replica_order(rider)
+        entries: dict[str, np.ndarray] = {}
+        for key, info in man["layers"].items():
+            self.shard_lookups_total += 1
+            cached = self.store.lookup(info["h"])
+            if cached is not None:
+                obs.count("base.shards_deduped")
+                self.store_hits_total += 1
+                entries[key] = cached
+                continue
+            arr = self._fetch_shard(key, info["h"], replicas)
+            if arr is None:
+                return None
+            entries[key] = arr
+        tree = assemble_base_tree(entries, template)
+        if tree is None:
+            obs.count("base.manifest_rejects")
+            logger.warning("base fetch: shard set for %s does not match "
+                           "the template; falling back", rev and rev[:8])
+            return None
+        obs.count("base.sharded_fetches")
+        self.sharded_fetches_total += 1
+        return tree, rev
+
+    def _fetch_shard(self, key: str, digest: str,
+                     replicas: list[str]) -> np.ndarray | None:
+        """One shard from ANY replica that has the hash: mirrors in
+        order, then origin. Every fetched payload is verified against
+        the manifest digest — a stale or hostile replica serves bytes
+        that fail the check and we move on."""
+        for node in replicas:
+            if self._skip(node):
+                continue
+            try:
+                data = tbase.fetch_shard(
+                    self.transport, tbase.mirror_node_id(node), key)
+            except Exception:
+                data = None
+            if data is None or ser.shard_digest(data) != digest:
+                if data is not None:
+                    obs.count("base.torn_fetches")
+                obs.count("base.replica_misses")
+                self._replica_failed(node)
+                continue
+            arr = ser.unpack_base_shard(data)
+            if arr is None:
+                obs.count("base.replica_misses")
+                self._replica_failed(node)
+                continue
+            self._replica_ok(node)
+            n = len(data)
+            self.last_fetch_bytes += n
+            self.bytes_fetched_total += n
+            self.mirror_hits_total += 1
+            self.network_shards_total += 1
+            obs.count("base.bytes_fetched", n)
+            obs.count("base.mirror_bytes", n)
+            obs.count("base.mirror_hits")
+            obs.count("base.shards_fetched")
+            self.store.put(digest, arr)
+            return arr
+        # fall through to origin
+        try:
+            data = tbase.fetch_base_shard(self.transport, key)
+        except Exception:
+            data = None
+        if data is None or ser.shard_digest(data) != digest:
+            if data is not None:
+                obs.count("base.torn_fetches")
+            obs.count("base.replica_misses")
+            return None
+        arr = ser.unpack_base_shard(data)
+        if arr is None:
+            obs.count("base.torn_fetches")
+            return None
+        n = len(data)
+        self.last_fetch_bytes += n
+        self.bytes_fetched_total += n
+        self.network_shards_total += 1
+        obs.count("base.bytes_fetched", n)
+        obs.count("base.origin_bytes", n)
+        obs.count("base.shards_fetched")
+        self.store.put(digest, arr)
+        return arr
+
+    # -- monolithic fallback -------------------------------------------------
+    def _fetch_monolithic(self, template: Params, rev: str):
+        if self.enabled:
+            obs.count("base.monolithic_fallbacks")
+            self.fallbacks_total += 1
+        try:
+            got = self.transport.fetch_base(template)
+        except Exception:
+            logger.warning("base fetch: monolithic pull failed",
+                           exc_info=True)
+            return None
+        if got is None:
+            return None
+        tree, fetched_rev = got
+        nb = sum(int(np.asarray(l).nbytes)
+                 for l in _tree_leaves(tree))
+        self.last_fetch_bytes += nb
+        self.bytes_fetched_total += nb
+        obs.count("base.bytes_fetched", nb)
+        obs.count("base.origin_bytes", nb)
+        if self.enabled:
+            # warm the store off the fallback: the NEXT round's sharded
+            # pull then fetches only what actually changed
+            self.seed(tree)
+        return tree, fetched_rev
+
+    # -- heartbeat extras ----------------------------------------------------
+    def heartbeat_fields(self) -> dict:
+        """Numeric extras for the role's heartbeat (fleet_report's
+        ``base_b``/``mirror_hit`` columns): lifetime fetched bytes, the
+        last pull's bytes, the store DEDUPE rate (the fraction of
+        looked-up layers that cost zero bytes), and the MIRROR hit rate
+        (of the shards that did hit the network, the fraction a mirror
+        served instead of the origin)."""
+        out = {"base_fetch_bytes": float(self.bytes_fetched_total),
+               "base_last_fetch_bytes": float(self.last_fetch_bytes)}
+        if self.shard_lookups_total:
+            out["base_dedupe_hit_rate"] = (
+                self.store_hits_total / self.shard_lookups_total)
+        if self.network_shards_total:
+            out["base_mirror_hit_rate"] = (
+                self.mirror_hits_total / self.network_shards_total)
+        return out
+
+
+def _tree_leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+# ---------------------------------------------------------------------------
+# Mirror duty (sub-averager side)
+# ---------------------------------------------------------------------------
+
+class MirrorDuty:
+    """Regional shard replication for one ``__agg__`` node: pull the
+    current base manifest, fetch (origin) only the shards whose hash
+    this node has not yet replicated, re-publish them under the node's
+    ``__mirror__.<node>`` shard slots, then stamp the presence rider
+    naming the mirrored revision — rider-last, the same commit
+    discipline as manifests, so a fetcher that reads the rider finds
+    the shards already in place. Bytes-only: the mirror never decodes
+    a shard (hash verification is enough; fetchers re-verify anyway).
+
+    ``sync()`` is isolated by the caller (a failed mirror round is a
+    non-event) and cheap when nothing changed: one rider/manifest read,
+    zero shard traffic."""
+
+    def __init__(self, transport, node_id: str):
+        self.transport = transport
+        self.node_id = node_id
+        self._mirrored: dict[str, str] = {}   # layer_key -> digest
+        self._last_revision: str | None = None
+
+    def sync(self) -> bool:
+        """One replication pass; True when this node now mirrors the
+        current revision's full shard set."""
+        try:
+            rev = self.transport.base_revision()
+        except Exception:
+            return False
+        if rev is None:
+            return False
+        if rev == self._last_revision:
+            obs.count("base.mirror_rounds")
+            return True
+        try:
+            data = tbase.fetch_base_manifest_bytes(self.transport, rev)
+        except Exception:
+            return False
+        if data is None:
+            return False   # monolithic-only averager: nothing to mirror
+        from .. import signing
+        man = ser.parse_base_manifest(signing.strip_envelope(bytes(data)))
+        if man is None or man["revision"] != rev:
+            obs.count("base.manifest_rejects")
+            return False
+        synced = 0
+        for key, info in man["layers"].items():
+            if self._mirrored.get(key) == info["h"]:
+                continue
+            try:
+                shard = tbase.fetch_base_shard(self.transport, key)
+            except Exception:
+                return False
+            if shard is None or ser.shard_digest(shard) != info["h"]:
+                obs.count("base.torn_fetches")
+                return False   # mid-publish race: next sync() heals it
+            try:
+                tbase.publish_shard(
+                    self.transport, tbase.mirror_node_id(self.node_id),
+                    key, shard)
+            except Exception as e:
+                logger.warning("mirror %s: shard republish failed: %s",
+                               self.node_id, e)
+                return False
+            obs.count("base.mirror_sync_bytes", len(shard))
+            self._mirrored[key] = info["h"]
+            synced += 1
+        # drop layers the manifest no longer names (a model-shape change)
+        for key in list(self._mirrored):
+            if key not in man["layers"]:
+                del self._mirrored[key]
+        self._last_revision = rev
+        obs.count("base.mirror_publishes", synced)
+        obs.count("base.mirror_rounds")
+        pm = getattr(self.transport, "publish_delta_meta", None)
+        if pm is not None:
+            try:
+                pm(tbase.mirror_node_id(self.node_id),
+                   {"mirror": {"revision": rev,
+                               "layers": len(man["layers"])}})
+            except Exception:
+                logger.debug("mirror %s: presence rider failed",
+                             self.node_id, exc_info=True)
+        return True

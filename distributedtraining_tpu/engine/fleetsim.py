@@ -73,7 +73,8 @@ import numpy as np
 
 from .. import serialization as ser
 from .. import signing
-from ..transport.base import (agg_id, encode_delta_meta, heartbeat_id,
+from ..transport.base import (BASE_PREFIX, MIRROR_PREFIX, SHARD_PREFIX,
+                              agg_id, encode_delta_meta, heartbeat_id,
                               lease_id)
 from ..transport.chaos import ChaosError, ChaosSpec, ChaosTransport
 from ..transport.memory import InMemoryTransport
@@ -152,6 +153,10 @@ class SimHub:
     Single-threaded by the simulator's construction, so no locks.
     """
 
+    # mirror-replica id prefix: shards re-published by a mirror node ride
+    # shard_id(mirror_node_id(node), layer) = __shard__.__mirror__.<node>.*
+    _MIRROR_SHARD_PREFIX = f"{SHARD_PREFIX}.{MIRROR_PREFIX}."
+
     def __init__(self):
         self.inner = InMemoryTransport()
         self.publish_bytes = 0
@@ -160,6 +165,21 @@ class SimHub:
         self.fetches = 0
         self.partition_faults = 0
         self._partitioned: set[str] = set()
+        # base-distribution plane accounting (engine/basedist.py): the
+        # fetch-side origin/mirror byte split is THE number the sharded
+        # plane exists to move — the scorecard's wire.base section and
+        # the base_dist gate read it per round
+        self.base_publish_bytes = 0
+        self.base_origin_fetch_bytes = 0
+        self.base_mirror_fetch_bytes = 0
+        # mirror kill switch (the mirror-kill chaos scenario): every
+        # operation touching a dead mirror's replica slots raises, while
+        # the node's OWN artifacts (its __agg__ aggregate, heartbeat)
+        # stay reachable — a mirror dying is a narrower event than a
+        # node partition, and the scenario proves fetchers fail over to
+        # origin with no round loss
+        self._mirror_dead: set[str] = set()
+        self.mirror_faults = 0
         self.round_samples: list[dict] = []
 
     # -- partitions ----------------------------------------------------------
@@ -171,15 +191,49 @@ class SimHub:
         return artifact_id.rsplit(".", 1)[-1] if "." in artifact_id \
             else artifact_id
 
+    @classmethod
+    def _mirror_node(cls, artifact_id: str) -> str | None:
+        """The mirror node an id belongs to, or None for non-mirror ids
+        (``__shard__.__mirror__.sub003.wte`` -> ``sub003``; the
+        ``__mirror__.sub003`` presence-rider slot maps the same way)."""
+        for prefix in (cls._MIRROR_SHARD_PREFIX, MIRROR_PREFIX + "."):
+            if artifact_id.startswith(prefix):
+                return artifact_id[len(prefix):].split(".", 1)[0]
+        return None
+
+    def _base_kind(self, artifact_id: str) -> str | None:
+        """Classify a raw artifact id into the base-distribution byte
+        ledger: "origin" (monolithic base, base shards, manifests, the
+        announce rider slot), "mirror" (replica shards + presence
+        riders), or None (everything else)."""
+        if self._mirror_node(artifact_id) is not None:
+            return "mirror"
+        if artifact_id == BASE_PREFIX \
+                or artifact_id.startswith(BASE_PREFIX + "."):
+            return "origin"
+        return None
+
     def partition(self, hotkey: str) -> None:
         self._partitioned.add(hotkey)
 
     def heal(self, hotkey: str) -> None:
         self._partitioned.discard(hotkey)
 
+    def kill_mirror(self, node: str) -> None:
+        self._mirror_dead.add(node)
+
+    def revive_mirror(self, node: str) -> None:
+        self._mirror_dead.discard(node)
+
     def _check(self, artifact_id: str | None) -> None:
-        if artifact_id is not None \
-                and self._owner(artifact_id) in self._partitioned:
+        if artifact_id is None:
+            return
+        mnode = self._mirror_node(artifact_id)
+        if mnode is not None and mnode in self._mirror_dead:
+            self.mirror_faults += 1
+            raise ChaosError(
+                f"sim[mirror]: replica {artifact_id} is dead")
+        if self._owner(artifact_id) in self._partitioned:
             self.partition_faults += 1
             raise ChaosError(
                 f"sim[partition]: {artifact_id} is unreachable")
@@ -192,6 +246,8 @@ class SimHub:
         self._check(miner_id)
         self.publishes += 1
         self.publish_bytes += len(data)
+        if self._base_kind(miner_id) is not None:
+            self.base_publish_bytes += len(data)
         return self.inner.publish_raw(miner_id, data)
 
     def publish_delta_raw(self, miner_id: str, data: bytes):
@@ -213,6 +269,11 @@ class SimHub:
         data = self.inner.fetch_delta_bytes(miner_id)
         if data is not None:
             self.fetch_bytes += len(data)
+            kind = self._base_kind(miner_id)
+            if kind == "origin":
+                self.base_origin_fetch_bytes += len(data)
+            elif kind == "mirror":
+                self.base_mirror_fetch_bytes += len(data)
         return data
 
     def delta_revision(self, miner_id: str):
@@ -240,6 +301,7 @@ class SimHub:
     def publish_base_raw(self, data: bytes):
         self.publishes += 1
         self.publish_bytes += len(data)
+        self.base_publish_bytes += len(data)
         return self.inner.publish_base_raw(data)
 
     def fetch_base(self, template: Params):
@@ -248,6 +310,7 @@ class SimHub:
         if data is None:
             return None
         self.fetch_bytes += len(data)
+        self.base_origin_fetch_bytes += len(data)
         try:
             tree = ser.validated_load(signing.strip_envelope(data),
                                       template)
@@ -260,6 +323,7 @@ class SimHub:
         data = self.inner.fetch_base_bytes()
         if data is not None:
             self.fetch_bytes += len(data)
+            self.base_origin_fetch_bytes += len(data)
         return data
 
     def base_revision(self):
@@ -269,14 +333,20 @@ class SimHub:
         pass
 
     # -- accounting ----------------------------------------------------------
-    def sample_round(self, round_no: int) -> dict:
+    def sample_round(self, round_no: int, **extra) -> dict:
         """Snapshot the cumulative wire counters at a round boundary;
         the scorecard derives per-round bytes from consecutive
-        samples."""
+        samples. ``extra`` lets the simulator attach actor-level
+        cumulative counters (successful base pulls) to the same
+        timeline."""
         rec = {"round": round_no, "publish_bytes": self.publish_bytes,
                "fetch_bytes": self.fetch_bytes,
                "publishes": self.publishes, "fetches": self.fetches,
-               "partition_faults": self.partition_faults}
+               "partition_faults": self.partition_faults,
+               "base_publish_bytes": self.base_publish_bytes,
+               "base_origin_fetch_bytes": self.base_origin_fetch_bytes,
+               "base_mirror_fetch_bytes": self.base_mirror_fetch_bytes,
+               "mirror_faults": self.mirror_faults, **extra}
         self.round_samples.append(rec)
         return rec
 
@@ -325,6 +395,15 @@ class FleetSpec:
     partitions_per_round: int = 0
     partition_rounds: int = 2       # < stale threshold: transient, heals
     fault_round: int = 2            # round injected behaviors begin
+    # content-addressed base distribution (engine/basedist.py): the REAL
+    # BasePublisher/BaseFetcher/MirrorDuty machinery over the hub —
+    # miners delta-pull only changed-hash layers, sub-averagers double
+    # as mirrors, and the scorecard's wire.base section reads the
+    # origin/mirror fetch split. ``mirror_kill_round`` > 0 kills EVERY
+    # mirror's replica slots at that round (the mirror-kill chaos
+    # scenario): fetchers must fail over to origin with no round loss.
+    base_wire_v2: bool = True
+    mirror_kill_round: int = 0
     # chaos transport (per-actor ChaosTransport over the hub)
     chaos: bool = True
     publish_error_rate: float = 0.02
@@ -355,6 +434,9 @@ class FleetSpec:
         if self.kill_primary_round < 0 or \
                 self.kill_primary_round > self.rounds:
             raise ValueError("kill_primary_round outside the run")
+        if self.mirror_kill_round < 0 or \
+                self.mirror_kill_round > self.rounds:
+            raise ValueError("mirror_kill_round outside the run")
         if self.round_s <= 0:
             raise ValueError("round_s must be > 0")
 
@@ -374,7 +456,8 @@ class FleetSpec:
         the misbehaving minority cost."""
         return dataclasses.replace(self, chaos=False, kills=0,
                                    kill_primary_round=0,
-                                   partitions_per_round=0)
+                                   partitions_per_round=0,
+                                   mirror_kill_round=0)
 
     @classmethod
     def from_json(cls, text: str) -> "FleetSpec":
@@ -530,19 +613,30 @@ class MinerActor(Actor):
         self.steps = 0
         self.pushes = 0
         self.pushes_failed = 0
+        self.base_pulls_ok = 0
         self.base_view = _zeros_tree(self.spec.layers, self.spec.dim)
         self._poison_i = 0
+        # the REAL content-addressed fetcher (engine/basedist.py): the
+        # shard store + replica strikes persist across rounds, mirrors
+        # come from the averager's announce rider. enabled=False makes
+        # fetch() the plain monolithic pull, so one code path serves
+        # both spec settings.
+        from .basedist import BaseFetcher
+        self.base_fetcher = BaseFetcher(self.transport,
+                                        enabled=self.spec.base_wire_v2)
 
     def _pull_base(self) -> None:
         template = _zeros_tree(self.spec.layers, self.spec.dim)
-        try:
-            got = self.transport.fetch_base(template)
-        except OSError:
-            self.count("sim.base_pull_faults")
-            return
+        # BaseFetcher.fetch never raises — chaos faults on the sharded
+        # path degrade to the monolithic pull internally, and a fully
+        # failed pull returns None (counted like the old OSError path)
+        got = self.base_fetcher.fetch(template)
         if got is not None:
             self.base_view = got[0]
+            self.base_pulls_ok += 1
             self.count("sim.base_pulls")
+        else:
+            self.count("sim.base_pull_faults")
 
     def _delta(self) -> dict:
         spec = self.spec
@@ -718,6 +812,29 @@ class SubAveragerActor(Actor):
         self.miners = miners
         self.node_id = agg_id(hotkey)
         self._seen_rev: dict[str, str | None] = {}
+        # regional mirror duty (engine/basedist.MirrorDuty): this node
+        # replicates the base shards under its __mirror__ slots so
+        # miner fetchers race a replica instead of the origin
+        self.mirror = None
+        if self.spec.base_wire_v2:
+            from .basedist import MirrorDuty
+            self.mirror = MirrorDuty(self.transport, hotkey)
+
+    def sync_mirror(self) -> None:
+        """One replication pass (run by the simulator AFTER the
+        averager's publish each round, so the replica is warm before
+        the NEXT round's miner pulls — the cadence a production mirror
+        gets from syncing at its round entry against the base published
+        the previous round)."""
+        if not self.alive or self.mirror is None:
+            return
+        try:
+            if self.mirror.sync():
+                self.count("sim.mirror_syncs")
+            else:
+                self.count("sim.mirror_sync_faults")
+        except OSError:
+            self.count("sim.mirror_sync_faults")
 
     def step(self, round_no: int) -> None:
         if not self.alive:
@@ -782,6 +899,22 @@ class AveragerActor(Actor):
         # the simulator's oracle for "did the merged model get better"
         # — to the EWMA/CUSUM drift detector the quality gate reads
         self.drift = QualityDriftDetector()
+        # the REAL sharded base publisher (engine/basedist.py): changed
+        # shards + per-revision manifest + announce rider after every
+        # monolithic publish. attempts=1 (no retry sleeps, no jitter
+        # rng) keeps the seeded region deterministic; a chaos-eaten
+        # shard publish just re-uploads next round (_last_shards only
+        # advances on a committed manifest).
+        self.base_pub = None
+        if spec.base_wire_v2:
+            from ..transport.retry import RetryPolicy
+            from .basedist import BasePublisher
+            self.base_pub = BasePublisher(
+                self.transport, mirrors=sim.sub_hotkeys,
+                publish_retry=RetryPolicy(attempts=1),
+                sleep=sim.clock.sleep)
+        self.base_dist_publishes = 0
+        self.base_dist_failures = 0
         self.lineage_revisions: list[str] = []
         self.lineage_publish_failures = 0
         self.drift_breaches = 0
@@ -865,6 +998,15 @@ class AveragerActor(Actor):
             self.count("sim.base_publishes")
         except OSError:
             self.count("sim.base_publish_faults")
+        if rev is not None and self.base_pub is not None:
+            # shard-plane publish for the landed revision (isolated:
+            # the monolithic base is already out either way)
+            if self.base_pub.publish_revision(self.base, rev):
+                self.base_dist_publishes += 1
+                self.count("sim.base_dist_publishes")
+            else:
+                self.base_dist_failures += 1
+                self.count("sim.base_dist_faults")
         if rev is not None:
             self._record_lineage(rev, parent_rev, staged, weights)
         self.fleet.record_staging(staged)
@@ -972,6 +1114,12 @@ class FleetResult:
     lineage_tampered: int = 0
     drift_breaches: int = 0
     quality_trace: list[float] = dataclasses.field(default_factory=list)
+    # base-distribution plane (engine/basedist.py at sim scale)
+    base_dist_publishes: int = 0
+    base_dist_failures: int = 0
+    base_sharded_pulls: int = 0
+    base_fallback_pulls: int = 0
+    base_mirror_shard_hits: int = 0
 
 
 class FleetSim:
@@ -1102,6 +1250,16 @@ class FleetSim:
                               + self.validators + self.subs
                               + self.averagers)
         for r in range(1, spec.rounds + 1):
+            if spec.mirror_kill_round and r == spec.mirror_kill_round:
+                # the mirror-kill chaos scenario: EVERY mirror's replica
+                # slots die at once (hub-side, so every fetcher sees it)
+                # — the strongest version of "any single mirror dying is
+                # a non-event". Fetchers must fail over to origin with
+                # no round loss; the base_dist gate checks exactly that.
+                for node in self.sub_hotkeys:
+                    self.hub.kill_mirror(node)
+                logger.info("fleetsim: round %d killed all %d mirrors",
+                            r, len(self.sub_hotkeys))
             for action, hotkey in self.partition_schedule.get(r, ()):
                 # a partition is BIDIRECTIONAL: readers cannot reach the
                 # node's artifacts (hub side) and the node itself cannot
@@ -1131,8 +1289,14 @@ class FleetSim:
                                       "round": r, "pm_published": ok})
             for actor in order:
                 actor.step(r)
+            # mirror replication AFTER the round's base publish: the
+            # replicas are warm before the next round's miner pulls
+            for sub in self.subs:
+                sub.sync_mirror()
             self.clock.advance(spec.round_s)
-            self.hub.sample_round(r)
+            self.hub.sample_round(
+                r, base_pulls_ok=sum(a.base_pulls_ok
+                                     for a in self.miners))
         return self._collect()
 
     # -- result assembly -----------------------------------------------------
@@ -1226,7 +1390,17 @@ class FleetSim:
             lineage_fetchable=fetchable,
             lineage_tampered=tampered,
             drift_breaches=sum(a.drift_breaches for a in self.averagers),
-            quality_trace=quality)
+            quality_trace=quality,
+            base_dist_publishes=sum(a.base_dist_publishes
+                                    for a in self.averagers),
+            base_dist_failures=sum(a.base_dist_failures
+                                   for a in self.averagers),
+            base_sharded_pulls=sum(a.base_fetcher.sharded_fetches_total
+                                   for a in self.miners),
+            base_fallback_pulls=sum(a.base_fetcher.fallbacks_total
+                                    for a in self.miners),
+            base_mirror_shard_hits=sum(a.base_fetcher.mirror_hits_total
+                                       for a in self.miners))
 
     def close(self) -> None:
         if self.closed:
@@ -1271,6 +1445,11 @@ DEFAULT_GATES = {
     "baseline_pr_drop_max": 0.05,
     "baseline_ttft_p99_ratio_max": 1.25,
     "baseline_bytes_ratio_max": 1.25,
+    # base-distribution plane (engine/basedist.py): per-round
+    # base-plane FETCH bytes (origin + mirror) may not regress past
+    # this ratio vs the baseline scorecard — the delta-pull economy is
+    # a gated number, not a one-time demo
+    "baseline_base_bytes_ratio_max": 1.25,
 }
 
 
@@ -1324,10 +1503,16 @@ def assemble_scorecard(result: FleetResult,
     precision, recall = _precision_recall(result.quarantined_ever,
                                           result.truth_bad)
     per_round_bytes = 0.0
+    base_fetch_per_round = base_origin_pr = base_mirror_pr = 0.0
     if result.wire_samples:
         last = result.wire_samples[-1]
         per_round_bytes = ((last["publish_bytes"] + last["fetch_bytes"])
                            / max(1, last["round"]))
+        base_origin_pr = (last.get("base_origin_fetch_bytes", 0)
+                          / max(1, last["round"]))
+        base_mirror_pr = (last.get("base_mirror_fetch_bytes", 0)
+                          / max(1, last["round"]))
+        base_fetch_per_round = base_origin_pr + base_mirror_pr
     card: dict[str, Any] = {
         "fleetsim": 1,
         "spec": dataclasses.asdict(spec),
@@ -1359,6 +1544,20 @@ def assemble_scorecard(result: FleetResult,
         "wire": {
             "samples": result.wire_samples,
             "bytes_per_round": round(per_round_bytes, 1),
+            # base-distribution plane: the fetch-side origin/mirror
+            # split the sharded plane exists to move (engine/basedist)
+            "base_fetch_bytes_per_round": round(base_fetch_per_round, 1),
+            "base_origin_bytes_per_round": round(base_origin_pr, 1),
+            "base_mirror_bytes_per_round": round(base_mirror_pr, 1),
+        },
+        "base_dist": {
+            "enabled": spec.base_wire_v2,
+            "mirror_kill_round": spec.mirror_kill_round,
+            "publishes": result.base_dist_publishes,
+            "publish_failures": result.base_dist_failures,
+            "sharded_pulls": result.base_sharded_pulls,
+            "fallback_pulls": result.base_fallback_pulls,
+            "mirror_shard_hits": result.base_mirror_shard_hits,
         },
         "chaos": {
             "enabled": spec.chaos,
@@ -1471,6 +1670,36 @@ def evaluate_gates(card: dict, *, gates: dict | None = None,
         out["hostile"] = {"ok": card["hostile"]["poison_declines"] > 0,
                           "poison_declines":
                               card["hostile"]["poison_declines"]}
+    bd = card.get("base_dist")
+    if bd and bd["enabled"] and bd["publishes"]:
+        # the sharded plane must actually carry pulls when it publishes
+        out["base_dist"] = {
+            "ok": bd["sharded_pulls"] > 0,
+            "sharded_pulls": bd["sharded_pulls"],
+            "fallback_pulls": bd["fallback_pulls"],
+        }
+        if spec["mirror_kill_round"] and spec["sub_averagers"]:
+            # the mirror-kill scenario: after EVERY mirror dies at once,
+            # (a) zero further mirror bytes move, and (b) miners keep
+            # completing base pulls every remaining round — failover to
+            # origin with no round loss. Computed from the per-round
+            # cumulative samples.
+            samples = {s["round"]: s for s in card["wire"]["samples"]}
+            kill = spec["mirror_kill_round"]
+            before = samples.get(kill - 1) or {}
+            last = samples.get(max(samples)) if samples else {}
+            post_mirror_bytes = (
+                (last or {}).get("base_mirror_fetch_bytes", 0)
+                - before.get("base_mirror_fetch_bytes", 0))
+            pulls_after = ((last or {}).get("base_pulls_ok", 0)
+                           - before.get("base_pulls_ok", 0))
+            out["base_dist"].update({
+                "post_kill_mirror_bytes": post_mirror_bytes,
+                "post_kill_pulls": pulls_after,
+            })
+            out["base_dist"]["ok"] = (out["base_dist"]["ok"]
+                                      and post_mirror_bytes == 0
+                                      and pulls_after > 0)
     if "serving" in card:
         pts = card["serving"]["load_points"]
         lowest = min(pts, key=lambda p: p["rate_rps"]) if pts else None
@@ -1516,6 +1745,11 @@ def _baseline_gate(card: dict, baseline: dict, g: dict) -> dict:
     if prev_b:
         _ratio(cur_b, prev_b, g["baseline_bytes_ratio_max"],
                "bytes_per_round")
+    cur_bb = card["wire"].get("base_fetch_bytes_per_round")
+    prev_bb = baseline.get("wire", {}).get("base_fetch_bytes_per_round")
+    if cur_bb is not None and prev_bb:
+        _ratio(cur_bb, prev_bb, g["baseline_base_bytes_ratio_max"],
+               "base_fetch_bytes_per_round")
     cur_pts = {p["rate_rps"]: p
                for p in card.get("serving", {}).get("load_points", ())}
     for p in baseline.get("serving", {}).get("load_points", ()):

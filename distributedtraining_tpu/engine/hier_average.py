@@ -151,6 +151,7 @@ class SubAverager:
                  lease=None, metrics=None, fleet=None,
                  retry_policy=None, publish_retry=None, meta_retry=None,
                  lineage=None,
+                 mirror=None,
                  clock: Clock | None = None):
         self.transport = transport
         self.node_id = node_id
@@ -181,6 +182,13 @@ class SubAverager:
         # slice that entered this fold — so the root's "base" record and
         # the subs' "agg" records together form the full DAG level
         self.lineage = lineage
+        # regional shard-mirror duty (engine/basedist.MirrorDuty): this
+        # __agg__ node re-publishes the base shards it already pulled
+        # under its __mirror__.<node> slots, so fetchers near it race a
+        # replica instead of joining the origin incast. One sync per
+        # round, isolated — a failed mirror pass is a non-event (the
+        # whole design premise: any replica may die).
+        self.mirror = mirror
         self.clock = clock or RealClock()
         self.report = SubAveragerReport()
         self._ingestor = None
@@ -256,6 +264,18 @@ class SubAverager:
                            exc_info=True)
             base_revision = None
         assigned = self.assigned()
+        if self.mirror is not None:
+            # mirror BEFORE the fold: the shards this node replicates
+            # are the base its miners are about to pull, so the replica
+            # is warm when the fan-out tree needs it. Runs on EVERY
+            # round (empty folds included) — mirror freshness must not
+            # depend on this subtree having submissions.
+            try:
+                with obs.span("subavg.mirror", node=self.node_id):
+                    self.mirror.sync()
+            except Exception:
+                logger.exception("subavg %s: mirror sync failed",
+                                 self.node_id)
         if self.fleet is not None:
             try:
                 self.fleet.poll(assigned)

@@ -177,7 +177,9 @@ class LoRAMinerLoop(MinerLoop):
         if self._multi():
             fetched = self._fetch_base_broadcast()
         elif self.transport.base_revision() is not None:
-            fetched = self.transport.fetch_base(self._wire_template())
+            # torn-publish guard + content-addressed pull, shared with
+            # the full-param loop (engine/train.py)
+            fetched = self._bootstrap_fetch_base()
         else:
             fetched = None
         if fetched is not None:
@@ -205,7 +207,7 @@ class LoRAMinerLoop(MinerLoop):
             rev = self.transport.base_revision()
             if rev is None or rev == self._base_revision:
                 return
-            fetched = self.transport.fetch_base(self._wire_template())
+            fetched = self._fetch_base_single(rev)
         if fetched is None:
             return
         from .train import wire_in

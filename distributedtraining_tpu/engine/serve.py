@@ -257,9 +257,19 @@ class BaseRevisionWatcher:
     (the ChaosTransport round in tests/test_serve.py pins this)."""
 
     def __init__(self, transport, template_fn: Callable[[], Params], *,
-                 poll_s: float = 10.0, start_revision: str | None = None):
+                 poll_s: float = 10.0, start_revision: str | None = None,
+                 fetcher=None):
         self._transport = transport
         self._template_fn = template_fn
+        # content-addressed base fetches (engine/basedist.BaseFetcher):
+        # the swap pull diffs the published manifest against the local
+        # shard store and fetches only changed-hash layers, racing any
+        # mirror that has the hash; ALL its failure paths — hostile or
+        # torn manifest included — degrade to the monolithic pull and
+        # then to "no new base", so serving stays on the current base
+        # (the same contract the ChaosTransport round pins for the
+        # monolithic path). None = monolithic pulls.
+        self.fetcher = fetcher
         self.poll_s = poll_s
         self._last_seen = start_revision
         self._pending: tuple[str | None, Params] | None = None
@@ -292,7 +302,10 @@ class BaseRevisionWatcher:
         if rev is None or rev == self._last_seen:
             return False
         try:
-            got = self._transport.fetch_base(self._template_fn())
+            if self.fetcher is not None:
+                got = self.fetcher.fetch(self._template_fn(), revision=rev)
+            else:
+                got = self._transport.fetch_base(self._template_fn())
         except Exception:
             obs.count("serve.swap_fetch_failures")
             flight.record("swap", outcome="fetch_failed",

@@ -731,8 +731,16 @@ class MinerLoop:
                  push_queue_depth: int = 1,
                  trace=None,
                  anomaly=None,
-                 heartbeat=None):
+                 heartbeat=None,
+                 base_fetcher=None):
         self.engine = engine
+        # content-addressed base fetches (engine/basedist.BaseFetcher):
+        # when set, single-host base pulls diff the published manifest
+        # against the local shard store and fetch only changed-hash
+        # layers (mirror racing + monolithic fallback inside). None =
+        # the monolithic reference pull. Pods keep the coordinator
+        # broadcast path either way.
+        self.base_fetcher = base_fetcher
         # optional fleet heartbeat publisher (engine/health.py): started
         # when the loop starts (its vitals read this loop's live report),
         # final beat + close on flush(). Self-timing on its own daemon
@@ -940,6 +948,12 @@ class MinerLoop:
         base-pull path matches the reference (fresh optimizer,
         training_manager.py:371-377)."""
         if self._restore_checkpoint(rng):
+            if self.base_fetcher is not None and self.base_params is not None:
+                # warm the shard store from the restored base: the first
+                # post-restart pull then fetches only the layers the
+                # fleet actually moved while this miner was down
+                self.base_fetcher.seed(wire_out(self.engine,
+                                                self.base_params))
             return
         if self._multi():
             # pod boot: the same coordinator-read + broadcast as _check_pull
@@ -948,7 +962,7 @@ class MinerLoop:
             # pod on divergent params
             fetched = self._fetch_base_broadcast()
         elif self.transport.base_revision() is not None:
-            fetched = self.transport.fetch_base(self._wire_template())
+            fetched = self._bootstrap_fetch_base()
         else:
             fetched = None
         if fetched is not None:
@@ -967,6 +981,41 @@ class MinerLoop:
             self.state = self.engine.init_state(params=init)
         self.base_params = _snapshot(self.state.params)
 
+    def _fetch_base_single(self, revision=None):
+        """Single-host base pull: the content-addressed delta-pull when
+        a :class:`~.basedist.BaseFetcher` is wired (changed-hash layers
+        only, mirror racing, monolithic fallback INSIDE the fetcher),
+        else the monolithic reference pull. Either way a torn or
+        hostile read returns None — "no new base this poll", never a
+        mid-round exception (the fetcher degrades internally; the plain
+        path's transports already return None on torn bytes)."""
+        if self.base_fetcher is not None:
+            return self.base_fetcher.fetch(self._wire_template(),
+                                           revision=revision)
+        return self.transport.fetch_base(self._wire_template())
+
+    def _bootstrap_fetch_base(self):
+        """Boot-time pull of a base the transport SAYS exists. A torn
+        mid-publish read (fetch returns None while base_revision() is
+        non-None) must not silently fork this miner to a genesis base —
+        retry briefly (publishes commit in ms), then surface an OSError
+        so the role's bounded bootstrap retry treats it like the
+        transport outage it is."""
+        for attempt in range(3):
+            fetched = self._fetch_base_single()
+            if fetched is not None:
+                return fetched
+            try:
+                if self.transport.base_revision() is None:
+                    return None   # base vanished: genuinely no base
+            except OSError:
+                pass
+            if attempt < 2:
+                self.clock.sleep(0.2 * (attempt + 1))
+        raise OSError("published base unreadable at bootstrap (torn "
+                      "publish or partitioned backend); refusing to "
+                      "fork to a genesis base")
+
     def _check_pull(self) -> None:
         if self._multi():
             fetched = self._fetch_base_broadcast()
@@ -974,7 +1023,7 @@ class MinerLoop:
             rev = self.transport.base_revision()
             if rev is None or rev == self._base_revision:
                 return
-            fetched = self.transport.fetch_base(self._wire_template())
+            fetched = self._fetch_base_single(rev)
         if fetched is None:
             return
         params, rev = fetched
@@ -1231,7 +1280,7 @@ class MinerLoop:
         where a per-process read could diverge."""
         if revision is None or self.transport.base_revision() != revision:
             return None
-        fetched = self.transport.fetch_base(self._wire_template())
+        fetched = self._fetch_base_single(revision)
         if fetched is None or fetched[1] != revision:
             return None
         return wire_in(self.engine, fetched[0])

@@ -60,8 +60,13 @@ class Validator:
                  ingest_workers: int = 4,
                  ingest_cache_mb: int = 2048,
                  fleet=None,
-                 remediation=None):
+                 remediation=None,
+                 base_fetcher=None):
         self.engine = engine
+        # content-addressed base fetches (engine/basedist.BaseFetcher):
+        # single-host base refreshes delta-pull only changed-hash layers
+        # (monolithic fallback inside); None = the monolithic pull
+        self.base_fetcher = base_fetcher
         # fleet health plane (engine/health.py FleetMonitor): heartbeats
         # polled per round, staging outcomes folded via the ingest
         # observer, per-miner scores recorded as the ledger's score
@@ -188,7 +193,7 @@ class Validator:
         if self._multi():
             fetched = self._broadcast_base(None)
         elif self.transport.base_revision() is not None:
-            fetched = self.transport.fetch_base(self._host_template())
+            fetched = self._fetch_base_single()
         else:
             fetched = None
         if fetched is not None:
@@ -203,6 +208,17 @@ class Validator:
                     rng if rng is not None else jax.random.PRNGKey(0))
         self.base_params = self.engine.place_params(base)
         self._eval_base()
+
+    def _fetch_base_single(self, revision=None):
+        """Single-host base pull: content-addressed delta-pull when a
+        BaseFetcher is wired (engine/basedist.py — it degrades to the
+        monolithic pull internally), else the monolithic read. Torn or
+        hostile reads return None, never raise (same contract as
+        MinerLoop._fetch_base_single)."""
+        if self.base_fetcher is not None:
+            return self.base_fetcher.fetch(self._host_template(),
+                                           revision=revision)
+        return self.transport.fetch_base(self._host_template())
 
     def _evaluator(self):
         if self._cohort_eval is None:
@@ -239,7 +255,7 @@ class Validator:
             rev = self.transport.base_revision()
             if rev is None or rev == self._base_revision:
                 return
-            fetched = self.transport.fetch_base(self._host_template())
+            fetched = self._fetch_base_single(rev)
         if fetched is None:
             return
         from .train import wire_in

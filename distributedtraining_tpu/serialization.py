@@ -395,7 +395,92 @@ def parse_wire_manifest(data: bytes) -> dict | None:
         return None
     if not isinstance(body, dict) or body.get("format") != 2:
         return None
-    layers = body.get("layers")
+    out_layers = _validated_manifest_layers(body.get("layers"))
+    if out_layers is None:
+        return None
+    quant = body.get("quant")
+    density = body.get("density")
+    return {"quant": quant if isinstance(quant, str) else "int8",
+            "density": float(density)
+            if isinstance(density, (int, float)) else None,
+            "layers": out_layers}
+
+
+# ---------------------------------------------------------------------------
+# Base-distribution manifest container (engine/basedist.py)
+#
+# The base model's sharded transport form: one raw-tensor shard per
+# wire-layout leaf (dense — unlike delta shards there is no packed
+# {"idx","q","scale"} form; the base IS the dense truth) plus one small
+# manifest that addresses them by sha256 and names the monolithic
+# revision the set assembles to. Content addressing is the dedupe key
+# (unchanged layer -> zero fetched bytes), the integrity pin (shards
+# travel unsigned; the hash rides the signed manifest), and the
+# torn-publish guard (manifest-last ordering, same as the delta wire).
+# ---------------------------------------------------------------------------
+
+# deliberately NOT valid msgpack (like WIRE_V2_MAGIC) so no monolithic
+# decode path can half-accept a manifest, and detection is a prefix
+# compare on the first bytes
+BASE_MANIFEST_MAGIC = b"DTBASE1\n"
+# one ~100-byte entry per wire tensor; 1 MiB covers ~10k layers with
+# headroom — anything bigger is hostile (transport/base.py mirrors the
+# number as the consumer-side read cap)
+BASE_MANIFEST_MAX_BYTES = 1 << 20
+
+
+def pack_base_shard(arr) -> bytes:
+    """One base layer's host array -> shard bytes (msgpack). The
+    publisher's own data — malformed input raises. Deterministic in the
+    array's bytes, so the FETCHER can re-derive the publisher's digests
+    from a monolithically-fetched tree (how the shard store warms off
+    the fallback path)."""
+    return flax_ser.msgpack_serialize(
+        {"x": np.asarray(jax.device_get(arr))})
+
+
+def unpack_base_shard(data: bytes, *, max_bytes: int = DEFAULT_MAX_BYTES):
+    """Shard bytes -> host ndarray, or None. Structural validation only;
+    shape/dtype validation against the base template happens at
+    assembly (engine/basedist.py), where the template is known."""
+    if len(data) > max_bytes:
+        return None
+    try:
+        raw = flax_ser.msgpack_restore(bytes(data))
+    except Exception:
+        return None
+    if not isinstance(raw, dict) or set(raw) != {"x"} \
+            or not isinstance(raw["x"], np.ndarray):
+        return None
+    return raw["x"]
+
+
+def build_base_manifest(layers: dict[str, tuple[str, int]], *,
+                        revision: str) -> bytes:
+    """``{layer_key: (shard sha256, shard nbytes)}`` + the monolithic
+    revision the set assembles to -> manifest bytes (magic + canonical
+    JSON). The publisher side of the contract in docs/wire.md."""
+    import json
+    body = {"format": 1, "revision": str(revision),
+            "layers": {str(k): {"h": h, "n": int(n)}
+                       for k, (h, n) in sorted(layers.items())}}
+    data = BASE_MANIFEST_MAGIC + json.dumps(
+        body, sort_keys=True, separators=(",", ":")).encode()
+    if len(data) > BASE_MANIFEST_MAX_BYTES:
+        raise PayloadError(f"base manifest {len(data)} bytes exceeds cap "
+                           f"{BASE_MANIFEST_MAX_BYTES}")
+    return data
+
+
+def is_base_manifest(data) -> bool:
+    return (isinstance(data, (bytes, bytearray, memoryview))
+            and bytes(data[:len(BASE_MANIFEST_MAGIC)])
+            == BASE_MANIFEST_MAGIC)
+
+
+def _validated_manifest_layers(layers) -> dict | None:
+    """Shared layer-table validation for the wire-v2 and base manifest
+    parsers: ``{key: {"h": sha256-hex, "n": int}}`` or None."""
     if not isinstance(layers, dict) or len(layers) > _WIRE_MAX_LAYERS:
         return None
     out_layers = {}
@@ -411,12 +496,33 @@ def parse_wire_manifest(data: bytes) -> dict | None:
         if not (isinstance(n, int) and 0 <= n <= DEFAULT_MAX_BYTES):
             return None
         out_layers[key] = {"h": h, "n": n}
-    quant = body.get("quant")
-    density = body.get("density")
-    return {"quant": quant if isinstance(quant, str) else "int8",
-            "density": float(density)
-            if isinstance(density, (int, float)) else None,
-            "layers": out_layers}
+    return out_layers
+
+
+def parse_base_manifest(data: bytes) -> dict | None:
+    """PEER-CONTROLLED base manifest bytes -> ``{"revision",
+    "layers": {key: {"h": sha256-hex, "n": int}}}`` or None. Everything
+    is validated — magic, size cap, JSON shape, format number, layer
+    count/key/hash/size bounds — a manifest that parses can at worst
+    make a fetcher pull bounded bytes that then fail their hash check
+    (and fall back to the monolithic base)."""
+    import json
+    if not is_base_manifest(data) or len(data) > BASE_MANIFEST_MAX_BYTES:
+        return None
+    try:
+        body = json.loads(
+            bytes(data[len(BASE_MANIFEST_MAGIC):]).decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(body, dict) or body.get("format") != 1:
+        return None
+    layers = _validated_manifest_layers(body.get("layers"))
+    if not layers:
+        return None
+    rev = body.get("revision")
+    if not (isinstance(rev, str) and 0 < len(rev) <= 200):
+        return None
+    return {"revision": rev, "layers": layers}
 
 
 def pack_wire_blob(packed) -> bytes:

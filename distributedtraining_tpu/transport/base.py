@@ -114,6 +114,53 @@ LINEAGE_PREFIX = "__lineage__"
 # engine/lineage.LINEAGE_MAX_BYTES mirrors it)
 LINEAGE_MAX_BYTES = 1 << 18
 
+# Content-addressed base distribution (engine/basedist.py): the averager
+# publishes the new base AS hash-addressed per-layer shards plus one
+# small signed manifest, next to (not instead of) the monolithic
+# ``publish_base`` artifact — the monolithic blob stays the source of
+# truth and the mixed-fleet fallback, while sharded fetchers diff the
+# manifest against their local shard store and pull ONLY changed-hash
+# layers (a warm-round base pull is KBs; an unchanged layer is 0
+# bytes — the same dedupe economics the wire-v2 delta path proved).
+#
+#   __base__.s.<layer-slug>   one base shard (layer-stable slot,
+#                             overwritten each publish like delta
+#                             shards; the content address rides the
+#                             manifest)
+#   __base__.<revision-slug>  the manifest for one published base
+#                             revision (keyed on the revision like
+#                             __lineage__ records, so a fetcher that
+#                             observed base_revision() == R reads
+#                             exactly R's shard set; manifests are KBs
+#                             — the storage bound is the manifest cap)
+#
+# The ``__base__`` id itself carries the averager's BASE-WIRE META
+# rider (``{"base_wire": {...}}``) declaring the plane, the current
+# revision, and the mirror list — the v1/v2-style negotiation: a
+# fetcher that reads no rider (old averager) never probes for
+# manifests and stays on the monolithic pull.
+BASE_PREFIX = "__base__"
+
+# consumer-side size cap for one base manifest read
+# (serialization.BASE_MANIFEST_MAX_BYTES mirrors it; same number, one
+# contract)
+BASE_MANIFEST_MAX_BYTES = 1 << 20
+
+# Regional shard mirrors (engine/basedist.MirrorDuty): an ``__agg__``
+# sub-averager re-publishes the base shards it already pulled under its
+# own reserved per-node namespace, and fetchers race/pick ANY replica
+# that has the hash (shards are verified against the signed manifest's
+# sha256 whatever slot served them, so a hostile or stale mirror can at
+# worst serve bytes that fail their hash check). The origin incast
+# becomes a fan-out tree built from roles the fleet already runs; any
+# single mirror dying is a non-event (fetchers fall through to origin).
+#
+#   __mirror__.<node>                       the mirror's presence rider
+#                                           slot ({"mirror": {...}})
+#   __shard__.__mirror__.<node>.<slug>      its shard replicas (via
+#                                           shard_id(mirror_node_id(n)))
+MIRROR_PREFIX = "__mirror__"
+
 
 def heartbeat_id(role: str, node_id: str) -> str:
     """The reserved per-node artifact id heartbeats publish under.
@@ -202,6 +249,44 @@ def is_lineage_id(artifact_id: str) -> bool:
         artifact_id.startswith(LINEAGE_PREFIX + ".")
 
 
+def base_shard_id(layer_key: str) -> str:
+    """The reserved artifact id one base layer's shard travels under on
+    id-namespace transports. Reuses :func:`shard_layer_slug`, so the
+    layer-key -> id mapping is injective by the same percent-escape
+    rule as delta shards (``a/b.c`` and ``a/b/c`` never collide). The
+    ``s.`` segment keeps shard ids disjoint from manifest ids: a
+    revision slug contains no literal ``.`` (lineage_slug escapes
+    them), so no manifest id can spell ``s.<anything-with-a-dot>``."""
+    return f"{BASE_PREFIX}.s.{shard_layer_slug(layer_key)}"
+
+
+def base_manifest_id(revision: str) -> str:
+    """The reserved artifact id the base manifest for ``revision``
+    publishes under — keyed on the revision (like ``__lineage__``
+    records), so a fetcher that probed ``base_revision() == R`` reads
+    exactly R's shard set and a mid-publish race degrades to the
+    monolithic fallback instead of a torn decode."""
+    return f"{BASE_PREFIX}.{lineage_slug(revision)}"
+
+
+def is_base_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(BASE_PREFIX + ".")
+
+
+def mirror_node_id(node_id: str) -> str:
+    """The reserved pseudo-hotkey one mirror's replicas travel under:
+    its shards ride ``shard_id(mirror_node_id(node), layer_key)`` and
+    its presence rider rides the ``__mirror__.<node>`` meta slot —
+    both through surfaces every transport already has."""
+    return f"{MIRROR_PREFIX}.{node_id}"
+
+
+def is_mirror_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(MIRROR_PREFIX + ".")
+
+
 def is_reserved_id(artifact_id: str) -> bool:
     """True for any id in the reserved control-plane/shard/aggregate/
     postmortem namespace (heartbeats, leases, wire-v2 shards, partial
@@ -215,7 +300,10 @@ def is_reserved_id(artifact_id: str) -> bool:
         or artifact_id.startswith(SHARD_PREFIX + ".")
         or artifact_id.startswith(AGG_PREFIX + ".")
         or artifact_id.startswith(PM_PREFIX + ".")
-        or artifact_id.startswith(LINEAGE_PREFIX + "."))
+        or artifact_id.startswith(LINEAGE_PREFIX + ".")
+        or artifact_id == BASE_PREFIX
+        or artifact_id.startswith(BASE_PREFIX + ".")
+        or artifact_id.startswith(MIRROR_PREFIX + "."))
 
 
 def publish_postmortem(transport, role: str, node_id: str,
@@ -290,6 +378,59 @@ def fetch_shard(transport, hotkey: str, layer_key: str) -> bytes | None:
     if fs is not None:
         return fs(hotkey, layer_key)
     return transport.fetch_delta_bytes(shard_id(hotkey, layer_key))
+
+
+def publish_base_shard(transport, layer_key: str, data: bytes) -> None:
+    """Publish one BASE shard through whatever surface ``transport``
+    offers: its own ``publish_base_shard`` method when present (HF Hub
+    stores a file inside the shared averaged-model repo), else
+    ``publish_raw`` under the reserved ``__base__.s.*`` id. Like delta
+    shards, base shards travel UNSIGNED — their integrity is the
+    sha256 the (signed) base manifest pins."""
+    ps = getattr(transport, "publish_base_shard", None)
+    if ps is not None:
+        ps(layer_key, data)
+        return
+    transport.publish_raw(base_shard_id(layer_key), data)
+
+
+def fetch_base_shard(transport, layer_key: str) -> bytes | None:
+    """One base shard's raw bytes from the ORIGIN slot (or None);
+    callers verify against the manifest hash (engine/basedist.py)."""
+    fs = getattr(transport, "fetch_base_shard", None)
+    if fs is not None:
+        return fs(layer_key)
+    return transport.fetch_delta_bytes(base_shard_id(layer_key))
+
+
+def publish_base_manifest(transport, revision: str, data: bytes) -> None:
+    """Publish one base manifest's bytes under the reserved
+    per-revision id. Prefers ``publish_delta_raw`` (SignedTransport
+    envelopes it — the fetched shard set's hashes are then
+    attributable to the averager), falling back to ``publish_raw`` on
+    plain transports — the exact split :func:`publish_lineage` uses."""
+    pbm = getattr(transport, "publish_base_manifest", None)
+    if pbm is not None:
+        pbm(revision, data)
+        return
+    pdr = getattr(transport, "publish_delta_raw", None)
+    if pdr is not None:
+        pdr(base_manifest_id(revision), data)
+        return
+    transport.publish_raw(base_manifest_id(revision), data)
+
+
+def fetch_base_manifest_bytes(transport, revision: str) -> bytes | None:
+    """Raw (possibly enveloped, size-capped) base manifest bytes for
+    one revision, or None — validation and envelope handling live in
+    engine/basedist.py, the same split as lineage reads. Absence is
+    the v1 negotiation signal: no manifest means monolithic fetch."""
+    fbm = getattr(transport, "fetch_base_manifest", None)
+    data = (fbm(revision) if fbm is not None
+            else transport.fetch_delta_bytes(base_manifest_id(revision)))
+    if data is not None and len(data) > BASE_MANIFEST_MAX_BYTES:
+        return None
+    return data
 
 
 def encode_delta_meta(meta: dict) -> bytes:

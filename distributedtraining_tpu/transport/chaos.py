@@ -256,6 +256,33 @@ class ChaosTransport:
             return fs(hotkey, layer_key)
         return self.inner.fetch_delta_bytes(base.shard_id(hotkey, layer_key))
 
+    # base-distribution ops (engine/basedist.py): each shard / manifest
+    # publish or fetch is its own faultable operation — a mid-publish
+    # fault is exactly how a torn base shard set happens, and a fetch
+    # fault is how a fetcher's mirror-failover path gets exercised.
+    # Delegation re-dispatches the module helper on the INNER transport
+    # so a wrapped backend's own surface (and signing preference) is
+    # preserved through the gate.
+    def publish_base_shard(self, layer_key: str, data: bytes):
+        from . import base
+        self._gate("publish")
+        return base.publish_base_shard(self.inner, layer_key, data)
+
+    def fetch_base_shard(self, layer_key: str):
+        from . import base
+        self._gate("fetch")
+        return base.fetch_base_shard(self.inner, layer_key)
+
+    def publish_base_manifest(self, revision: str, data: bytes):
+        from . import base
+        self._gate("publish")
+        return base.publish_base_manifest(self.inner, revision, data)
+
+    def fetch_base_manifest(self, revision: str):
+        from . import base
+        self._gate("fetch")
+        return base.fetch_base_manifest_bytes(self.inner, revision)
+
     def publish_delta_meta(self, miner_id: str, meta: dict) -> None:
         self._gate("publish", miner_id)
         pm = getattr(self.inner, "publish_delta_meta", None)

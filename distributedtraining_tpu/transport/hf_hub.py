@@ -199,6 +199,37 @@ class HFHubTransport:
         return parse_delta_meta(self._download_bytes(
             miner_id, META_FILE, max_bytes=META_MAX_BYTES))
 
+    # -- base-distribution shards/manifests (engine/basedist.py) -------------
+    # Base shards and per-revision manifests are FILES inside the shared
+    # averaged-model repo (base_shards/<layer>.msgpack,
+    # base_manifests/<revision>.json) — per-layer overwrite semantics
+    # for shards, per-revision append for manifests, both bounded by the
+    # base repo's history squash like the base file itself.
+    def publish_base_shard(self, layer_key: str, data: bytes) -> None:
+        from .base import shard_layer_slug
+        self._upload_bytes(self.base_repo_id,
+                           f"base_shards/{shard_layer_slug(layer_key)}"
+                           ".msgpack", data)
+
+    def fetch_base_shard(self, layer_key: str) -> bytes | None:
+        from .base import shard_layer_slug
+        return self._download_bytes(
+            self.base_repo_id,
+            f"base_shards/{shard_layer_slug(layer_key)}.msgpack")
+
+    def publish_base_manifest(self, revision: str, data: bytes) -> None:
+        from .base import lineage_slug
+        self._upload_bytes(self.base_repo_id,
+                           f"base_manifests/{lineage_slug(revision)}.json",
+                           data)
+
+    def fetch_base_manifest(self, revision: str) -> bytes | None:
+        from .base import BASE_MANIFEST_MAX_BYTES, lineage_slug
+        return self._download_bytes(
+            self.base_repo_id,
+            f"base_manifests/{lineage_slug(revision)}.json",
+            max_bytes=BASE_MANIFEST_MAX_BYTES)
+
     def _squash_base_repo(self) -> None:
         """Squash BEFORE publishing (reference order, hf_manager.py:73-136):
         squashing after would rewrite the just-returned commit SHA, so the

@@ -125,6 +125,30 @@ class SignedTransport:
             return fs(hotkey, layer_key)
         return self.inner.fetch_delta_bytes(base.shard_id(hotkey, layer_key))
 
+    # -- base-distribution shards (engine/basedist.py) -----------------------
+    # Same policy as delta shards: base shards travel UNSIGNED (their
+    # integrity is the sha256 in the signed base manifest, verified by
+    # every fetcher whatever replica served the bytes), so these
+    # delegate past the envelope machinery — a strict-mode fleet must
+    # not reject hash-pinned shards for lacking a signature the
+    # manifest already provides. The MANIFEST itself publishes through
+    # publish_delta_raw (transport/base.publish_base_manifest prefers
+    # it), so it IS enveloped and verified like a delta artifact.
+    def publish_base_shard(self, layer_key: str, data: bytes) -> None:
+        from . import base
+        ps = getattr(self.inner, "publish_base_shard", None)
+        if ps is not None:
+            ps(layer_key, data)
+            return
+        self.inner.publish_raw(base.base_shard_id(layer_key), data)
+
+    def fetch_base_shard(self, layer_key: str) -> bytes | None:
+        from . import base
+        fs = getattr(self.inner, "fetch_base_shard", None)
+        if fs is not None:
+            return fs(layer_key)
+        return self.inner.fetch_delta_bytes(base.base_shard_id(layer_key))
+
     # -- validator / averager side -----------------------------------------
     def fetch_delta_bytes(self, miner_id: str) -> bytes | None:
         raw = self.inner.fetch_delta_bytes(miner_id)

@@ -92,6 +92,13 @@ def _run_sub_averager(cfg: RunConfig, c, plane) -> int:
     if cfg.lineage:
         from distributedtraining_tpu.engine.lineage import LineagePlane
         lineage = LineagePlane(c.transport, node=f"subavg.{node}")
+    mirror = None
+    if cfg.base_wire_v2 and cfg.base_mirror:
+        # regional mirror duty (engine/basedist.py): this __agg__ node
+        # re-publishes the base shards it pulls under __mirror__.<node>
+        # so nearby fetchers race a replica instead of the origin
+        from distributedtraining_tpu.engine.basedist import MirrorDuty
+        mirror = MirrorDuty(c.transport, node)
     sub = SubAverager(
         c.transport, node, lambda: host_wire_template(c.engine), assigned,
         consensus=lambda: getattr(c.chain, "consensus_scores",
@@ -105,7 +112,7 @@ def _run_sub_averager(cfg: RunConfig, c, plane) -> int:
         ingest_cache_mb=cfg.ingest_cache_mb,
         wire_spec=True if cfg.hier_wire_v2 else None,
         lease=lease, metrics=c.metrics, fleet=plane.fleet,
-        lineage=lineage)
+        lineage=lineage, mirror=mirror)
     try:
         merged = sub.run_periodic(interval=cfg.averaging_interval,
                                   rounds=cfg.rounds)
@@ -182,6 +189,22 @@ def main(argv=None) -> int:
         from distributedtraining_tpu.engine.lineage import LineagePlane
         lineage = LineagePlane(c.transport, node=cfg.hotkey,
                                anomaly=anomaly)
+    # content-addressed base distribution (engine/basedist.py): each
+    # monolithic publish is followed by the changed-shard set + signed
+    # per-revision manifest; the announce rider advertises the fleet's
+    # __agg__ nodes (plus any --base-mirrors) as shard mirrors.
+    # Single-host only — a pod's coordinator-gated monolithic publish
+    # stays the whole story (the loop also gates on _multi()).
+    base_dist = None
+    if cfg.base_wire_v2:
+        import jax as _jax
+        if _jax.process_count() <= 1:
+            from distributedtraining_tpu.engine.basedist import BasePublisher
+            mirror_nodes = list(hierarchy or [])
+            mirror_nodes += [m.strip() for m in
+                             (cfg.base_mirrors or "").split(",")
+                             if m.strip() and m.strip() not in mirror_nodes]
+            base_dist = BasePublisher(c.transport, mirrors=mirror_nodes)
     loop = AveragerLoop(c.engine, c.transport, c.chain,
                         make_strategy(cfg, c.model),
                         val_batches=c.eval_batches(),
@@ -198,7 +221,8 @@ def main(argv=None) -> int:
                         remediation=plane.remediation,
                         lease=lease,
                         hierarchy=hierarchy,
-                        lineage=lineage)
+                        lineage=lineage,
+                        base_dist=base_dist)
     if plane.heartbeat is not None:
         plane.heartbeat.vitals = report_vitals(
             loop.report, base_revision=lambda: loop._base_revision)

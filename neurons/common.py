@@ -168,6 +168,7 @@ class HealthPlane:
 def build_health_plane(cfg: RunConfig, c: Components, *,
                        vitals=None, monitor: bool = False,
                        anomaly=None,
+                       collect=None,
                        start_heartbeat: bool = True) -> HealthPlane:
     """Assemble the role's health plane from config: a heartbeat
     publisher when ``--heartbeat-interval`` > 0 (``vitals`` supplies the
@@ -202,7 +203,8 @@ def build_health_plane(cfg: RunConfig, c: Components, *,
                         score_decay=cfg.score_decay))
         plane.heartbeat = HeartbeatPublisher(
             c.transport, cfg.role, cfg.hotkey,
-            interval=cfg.heartbeat_interval, vitals=vitals)
+            interval=cfg.heartbeat_interval, vitals=vitals,
+            collect=collect)
         if start_heartbeat:
             plane.heartbeat.start()
     elif cfg.remediate and coordinator:
@@ -218,6 +220,24 @@ def build_health_plane(cfg: RunConfig, c: Components, *,
                                      cfg.hotkey))
         plane.exporter.start()
     return plane
+
+
+def build_base_fetcher(cfg: RunConfig, c: Components):
+    """The role's content-addressed base fetcher
+    (engine/basedist.BaseFetcher) when ``--base-wire-v2`` is on, else
+    None (the monolithic reference pull). Mirrors come from
+    ``--base-mirrors`` (the averager's announce rider extends the list
+    at fetch time). Single-host machinery — pods keep the coordinator
+    broadcast path, so they get None."""
+    import jax
+
+    if not cfg.base_wire_v2 or jax.process_count() > 1:
+        return None
+    from distributedtraining_tpu.engine.basedist import BaseFetcher
+    mirrors = [m.strip() for m in (cfg.base_mirrors or "").split(",")
+               if m.strip()]
+    return BaseFetcher(c.transport, mirrors=mirrors,
+                       store_bytes=cfg.base_store_mb * (1 << 20))
 
 
 def enable_compile_cache(path: str) -> None:

@@ -25,7 +25,8 @@ force_platform_from_env()
 
 from distributedtraining_tpu.config import RunConfig   # noqa: E402
 from distributedtraining_tpu.engine import MinerLoop   # noqa: E402
-from neurons.common import build, build_health_plane   # noqa: E402
+from neurons.common import (build, build_base_fetcher,  # noqa: E402
+                            build_health_plane)
 
 
 def _guard_kwargs(cfg, c) -> dict:
@@ -77,6 +78,11 @@ def main(argv=None) -> int:
             cfg.anomaly_dir or os.path.join(cfg.work_dir, "anomaly_traces",
                                             cfg.hotkey),
             steps=cfg.profile_steps, arm=False))
+    # content-addressed base pulls (engine/basedist.py): changed-hash
+    # layers only, mirror racing, monolithic fallback; None when
+    # --no-base-wire-v2 (or on a pod, where the coordinator broadcast
+    # stays monolithic)
+    base_fetcher = build_base_fetcher(cfg, c)
     store = None
     if cfg.checkpoint_interval > 0:
         from distributedtraining_tpu.checkpoint import CheckpointStore
@@ -110,6 +116,7 @@ def main(argv=None) -> int:
                              push_async=cfg.push_async,
                              push_queue_depth=cfg.push_queue_depth,
                              trace=trace, anomaly=anomaly,
+                             base_fetcher=base_fetcher,
                              **_guard_kwargs(cfg, c))
     else:
         loop = MinerLoop(c.engine, c.transport, cfg.hotkey,
@@ -128,6 +135,7 @@ def main(argv=None) -> int:
                          push_async=cfg.push_async,
                          push_queue_depth=cfg.push_queue_depth,
                          trace=trace, anomaly=anomaly,
+                         base_fetcher=base_fetcher,
                          **_guard_kwargs(cfg, c))
     # fleet health plane: heartbeat publisher (loop-managed: starts with
     # training, final beat + close in flush()) and the --obs-port
@@ -136,7 +144,12 @@ def main(argv=None) -> int:
     plane = build_health_plane(
         cfg, c, start_heartbeat=False,
         vitals=report_vitals(loop.report,
-                             base_revision=lambda: loop._base_revision))
+                             base_revision=lambda: loop._base_revision),
+        # base-distribution extras (base_fetch_bytes / mirror hit rate)
+        # ride the heartbeat so fleet_report's base_b/mirror_hit columns
+        # show the delta-pull economy per node
+        collect=(base_fetcher.heartbeat_fields
+                 if base_fetcher is not None else None))
     loop.heartbeat = plane.heartbeat
 
     def _bootstrap():

@@ -40,7 +40,8 @@ from distributedtraining_tpu.config import RunConfig           # noqa: E402
 from distributedtraining_tpu.engine.serve import (             # noqa: E402
     BaseRevisionWatcher, GenerationEngine, ServeHTTPFrontend, ServeLoop,
     host_param_template)
-from neurons.common import build, build_health_plane           # noqa: E402
+from neurons.common import (build, build_base_fetcher,         # noqa: E402
+                            build_health_plane)
 
 logger = logging.getLogger(__name__)
 
@@ -79,10 +80,17 @@ def main(argv=None) -> int:
     from distributedtraining_tpu.utils import flight
     flight.install_crash_hooks()
 
+    # content-addressed base pulls (engine/basedist.py): hot-swap
+    # fetches become delta-pulls of only the layers the merge moved
+    base_fetcher = build_base_fetcher(cfg, c)
     watcher = BaseRevisionWatcher(
         c.transport, lambda: host_param_template(c.model),
-        poll_s=max(cfg.swap_poll, 0.1))
+        poll_s=max(cfg.swap_poll, 0.1), fetcher=base_fetcher)
     params, revision = _await_base(cfg, c, watcher)
+    if base_fetcher is not None and revision is None and params is not None:
+        # --init-from boot: seed the shard store from the weights we
+        # serve, so the FIRST published base pulls only what differs
+        base_fetcher.seed(params)
     engine = GenerationEngine(
         c.model, params, revision=revision,
         max_slots=cfg.serve_slots, page_size=cfg.serve_page_size,
@@ -122,7 +130,10 @@ def main(argv=None) -> int:
         steps=lambda: engine.steps,
         counters=_serve_counters,
         base_revision=lambda: engine.revision)
-    plane = build_health_plane(cfg, c, vitals=vitals)
+    plane = build_health_plane(
+        cfg, c, vitals=vitals,
+        collect=(base_fetcher.heartbeat_fields
+                 if base_fetcher is not None else None))
 
     frontend = None
     if cfg.serve_port:

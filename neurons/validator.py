@@ -25,7 +25,8 @@ force_platform_from_env()
 
 from distributedtraining_tpu.config import RunConfig   # noqa: E402
 from distributedtraining_tpu.engine import Validator   # noqa: E402
-from neurons.common import build, build_health_plane   # noqa: E402
+from neurons.common import (build, build_base_fetcher,  # noqa: E402
+                            build_health_plane)
 
 
 def main(argv=None) -> int:
@@ -36,6 +37,7 @@ def main(argv=None) -> int:
     # crash-forensics triggers (utils/flight.py, see neurons/miner.py)
     from distributedtraining_tpu.utils import flight
     flight.install_crash_hooks()
+    base_fetcher = build_base_fetcher(cfg, c)
     validator = Validator(c.engine, c.transport, c.chain,
                           eval_batches=c.eval_batches(),
                           metric=cfg.score_metric,
@@ -47,7 +49,8 @@ def main(argv=None) -> int:
                           cohort_size=cfg.val_cohort,
                           pipeline_depth=cfg.val_pipeline_depth,
                           ingest_workers=cfg.ingest_workers,
-                          ingest_cache_mb=cfg.ingest_cache_mb)
+                          ingest_cache_mb=cfg.ingest_cache_mb,
+                          base_fetcher=base_fetcher)
     # the reference gates weight-setting to staked validators
     # (btt_connector.py:358-385); refuse up front instead of silently
     # burning eval compute on scores no one will ever see. On a pod the
@@ -81,7 +84,10 @@ def main(argv=None) -> int:
     from distributedtraining_tpu.utils.obs import AnomalyMonitor
     plane = build_health_plane(cfg, c, monitor=True,
                                anomaly=AnomalyMonitor(),
-                               start_heartbeat=False)
+                               start_heartbeat=False,
+                               collect=(base_fetcher.heartbeat_fields
+                                        if base_fetcher is not None
+                                        else None))
     validator.fleet = plane.fleet   # before the first round's lazy _ingest
     validator.remediation = plane.remediation  # and the lazy evaluator
     if plane.heartbeat is not None:

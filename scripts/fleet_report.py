@@ -39,8 +39,15 @@ import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
            "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "published",
-           "accepted", "declined", "stale_rounds", "wire_b", "score",
-           "credit", "quar", "slo")
+           "accepted", "declined", "stale_rounds", "wire_b", "base_b",
+           "mirror_hit", "score", "credit", "quar", "slo")
+
+
+def _human_bytes(v) -> str:
+    for unit, div in (("G", 1 << 30), ("M", 1 << 20), ("k", 1 << 10)):
+        if v >= div:
+            return f"{v / div:.1f}{unit}"
+    return str(int(v))
 
 
 def build_report(paths: list[str]) -> dict:
@@ -161,12 +168,20 @@ def _cell(node: dict, col: str) -> str:
         # (engine/health.py ledger) — human-scaled: the whole point of
         # the v2 wire is making this column small
         v = node.get("wire_bytes")
-        if v is None:
-            return "-"
-        for unit, div in (("G", 1 << 30), ("M", 1 << 20), ("k", 1 << 10)):
-            if v >= div:
-                return f"{v / div:.1f}{unit}"
-        return str(int(v))
+        return "-" if v is None else _human_bytes(v)
+    if col == "base_b":
+        # lifetime BASE bytes this node fetched (engine/basedist.py
+        # BaseFetcher heartbeat extras) — the delta-pull twin of
+        # wire_b: the whole point of the sharded base plane is making
+        # this column grow by KBs per round, not model-sizes
+        v = node.get("base_fetch_bytes")
+        return "-" if v is None else _human_bytes(v)
+    if col == "mirror_hit":
+        # of the base shards this node pulled over the network, the
+        # fraction a __mirror__ replica served instead of the origin
+        # (base_mirror_hit_rate heartbeat extra)
+        v = node.get("base_mirror_hit_rate")
+        return "-" if not isinstance(v, (int, float)) else f"{v:.2f}"
     if col == "credit":
         # accumulated leave-one-out improvement credit (engine/lineage
         # CreditLedger via the ledger's credit field) — who actually

@@ -77,6 +77,12 @@ def build_spec(args) -> "FleetSpec":
         kill_primary_round=(args.rounds // 2
                             if args.failover and args.rounds >= 8 else 0),
         partitions_per_round=max(0, miners // 250),
+        # mirror-kill chaos scenario (engine/basedist.py): late in the
+        # run every __agg__ mirror's replica slots die at once; the
+        # base_dist gate then asserts fetchers failed over to origin
+        # with no round loss
+        mirror_kill_round=(2 * args.rounds // 3
+                           if subs and args.rounds >= 6 else 0),
         chaos=not args.no_chaos)
     return spec
 

@@ -18,6 +18,35 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Session-scoped persistent compilation cache (the tier-1 budget lever,
+# PR-19 satellite): dozens of test modules compile IDENTICAL tiny-model
+# programs — the persistent cache keys on the HLO, so every repeat
+# compile across modules deserializes instead of re-lowering (~30%
+# suite-wide on this rig, measured on the engines/neurons subset).
+# Exported via the ENVIRONMENT too, so subprocess tests (the
+# multi-OS-process round, supervise) inherit the same cache. Role tests
+# that point the cache elsewhere (neurons/common.enable_compile_cache)
+# simply take over from their call onward, exactly as before.
+import tempfile as _tempfile
+
+_JAX_CACHE_DIR = _tempfile.mkdtemp(prefix="dt-test-jax-cache-")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _JAX_CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+for _knob, _val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                    ("jax_persistent_cache_min_entry_size_bytes", 0)):
+    try:
+        jax.config.update(_knob, _val)
+    except (AttributeError, ValueError):  # pragma: no cover — jax drift
+        pass
+
+import atexit as _atexit
+import shutil as _shutil
+
+_atexit.register(_shutil.rmtree, _JAX_CACHE_DIR, True)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402

@@ -1,0 +1,696 @@
+"""Content-addressed base distribution (engine/basedist.py): sharded
+publish, mirror racing, delta-pull rounds.
+
+The acceptance pins here are the round's contract: a sharded pull is
+bit-exact with the monolithic pull, a warm pull fetches ONLY
+changed-hash layers (unchanged layer = 0 bytes), a hostile or torn
+shard set is never decoded (it degrades to the monolithic base —
+loudly), mirrors fail over to origin, and mixed old/new fleets
+interoperate with no flag day.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import serialization as ser
+from distributedtraining_tpu.engine.basedist import (BaseFetcher,
+                                                     BasePublisher,
+                                                     BaseShardStore,
+                                                     MirrorDuty,
+                                                     assemble_base_tree,
+                                                     base_layer_items,
+                                                     read_base_wire_rider)
+from distributedtraining_tpu.transport import base as tbase
+from distributedtraining_tpu.transport.localfs import LocalFSTransport
+from distributedtraining_tpu.transport.memory import InMemoryTransport
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import fleet_report  # noqa: E402
+
+
+def _tree(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"wte": rs.randn(20, 8).astype(np.float32),
+            "h_0": {"w": rs.randn(8, 8).astype(np.float32),
+                    "b": rs.randn(8).astype(np.float32)},
+            "ln": rs.randn(8).astype(np.float32)}
+
+
+def _template(tree=None):
+    return jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), np.asarray(x).dtype),
+        tree if tree is not None else _tree())
+
+
+def _leaves(t):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(t)]
+
+
+def _trees_equal(a, b) -> bool:
+    return all(np.array_equal(x, y)
+               for x, y in zip(_leaves(a), _leaves(b)))
+
+
+class CountingFS(LocalFSTransport):
+    """LocalFS that records every raw publish/fetch (id, nbytes)."""
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.published: list[tuple[str, int]] = []
+        self.fetched: list[tuple[str, int]] = []
+
+    def publish_raw(self, mid, data):
+        self.published.append((mid, len(data)))
+        return super().publish_raw(mid, data)
+
+    def fetch_delta_bytes(self, mid):
+        d = super().fetch_delta_bytes(mid)
+        if d is not None:
+            self.fetched.append((mid, len(d)))
+        return d
+
+    def fetch_base_bytes(self):
+        d = super().fetch_base_bytes()
+        if d is not None:
+            self.fetched.append(("__mono__", len(d)))
+        return d
+
+
+def _published(transport, tree, *, mirrors=()):
+    """Publish ``tree`` monolithically + sharded; returns (pub, rev)."""
+    rev = transport.publish_base(tree)
+    pub = BasePublisher(transport, mirrors=mirrors)
+    assert pub.publish_revision(tree, rev)
+    return pub, rev
+
+
+# ---------------------------------------------------------------------------
+# Manifest + shard container
+# ---------------------------------------------------------------------------
+
+def test_base_manifest_round_trip():
+    layers = {"a/b": ("ab" * 32, 100), "c": ("cd" * 32, 7)}
+    data = ser.build_base_manifest(layers, revision="rev123")
+    assert ser.is_base_manifest(data)
+    assert not ser.is_wire_v2_manifest(data)   # magics are disjoint
+    man = ser.parse_base_manifest(data)
+    assert man is not None
+    assert man["revision"] == "rev123"
+    assert man["layers"] == {k: {"h": h, "n": n}
+                             for k, (h, n) in layers.items()}
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda d: b"NOTMAGIC" + d[8:],                      # wrong magic
+    lambda d: d[:8] + b"{garbage",                      # broken JSON
+    lambda d: d[:8] + b'{"format":2,"layers":{}}',      # wrong format
+    lambda d: d[:8] + b'{"format":1,"layers":{}}',      # empty layers
+    lambda d: d[:8] + b'{"format":1,"revision":"r",'
+                      b'"layers":{"k":{"h":"xx","n":1}}}',   # bad hash
+    lambda d: d[:8] + b'{"format":1,"revision":"r","layers":'
+                      b'{"k":{"h":"' + b"a" * 64 + b'","n":-1}}}',  # bad n
+    lambda d: d[:8] + b'{"format":1,"layers":'
+                      b'{"k":{"h":"' + b"a" * 64 + b'","n":1}}}',  # no rev
+])
+def test_base_manifest_hostile_variants(mutate):
+    good = ser.build_base_manifest({"k": ("a" * 64, 1)}, revision="r")
+    assert ser.parse_base_manifest(mutate(good)) is None
+
+
+def test_base_shard_pack_round_trip():
+    for arr in (np.arange(6, dtype=np.float32).reshape(2, 3),
+                np.arange(4, dtype=np.int32),
+                np.float32(3.5)):
+        out = ser.unpack_base_shard(ser.pack_base_shard(arr))
+        assert out is not None
+        assert np.array_equal(out, np.asarray(arr))
+        assert out.dtype == np.asarray(arr).dtype
+    assert ser.unpack_base_shard(b"\x00garbage") is None
+    # deterministic encoding: the fetcher's locally-derived digests must
+    # match the publisher's (how the store warms off the fallback path)
+    a = np.arange(8, dtype=np.float32)
+    assert ser.pack_base_shard(a) == ser.pack_base_shard(a.copy())
+
+
+def test_layer_items_assemble_round_trip():
+    tree = _tree()
+    items = base_layer_items(tree)
+    assert set(items) == {"wte", "h_0/w", "h_0/b", "ln"}
+    out = assemble_base_tree(items, _template(tree))
+    assert out is not None and _trees_equal(out, tree)
+    # missing layer / wrong shape / wrong dtype all reject
+    assert assemble_base_tree({k: v for k, v in items.items()
+                               if k != "ln"}, _template(tree)) is None
+    bad = dict(items)
+    bad["ln"] = np.zeros(9, np.float32)
+    assert assemble_base_tree(bad, _template(tree)) is None
+    bad = dict(items)
+    bad["ln"] = items["ln"].astype(np.float64)
+    assert assemble_base_tree(bad, _template(tree)) is None
+
+
+def test_reserved_ids_and_slug_injectivity():
+    assert tbase.is_reserved_id(tbase.base_shard_id("a/b.c"))
+    assert tbase.is_reserved_id(tbase.base_manifest_id("rev"))
+    assert tbase.is_reserved_id(tbase.BASE_PREFIX)   # the rider slot
+    assert tbase.is_reserved_id(
+        tbase.shard_id(tbase.mirror_node_id("sub0"), "wte"))
+    assert tbase.is_reserved_id(tbase.mirror_node_id("sub0"))
+    # slug injectivity rides shard_layer_slug (docs/wire.md)
+    assert tbase.base_shard_id("a/b.c") != tbase.base_shard_id("a/b/c")
+    # a manifest id can never collide with a shard id: revision slugs
+    # contain no literal "." while shard ids carry the "s." segment
+    assert tbase.base_manifest_id("s.wte") != tbase.base_shard_id("wte")
+
+
+# ---------------------------------------------------------------------------
+# Publisher + fetcher over localfs
+# ---------------------------------------------------------------------------
+
+def test_cold_sharded_pull_is_bit_exact(tmp_path):
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    _published(t, tree)
+    f = BaseFetcher(t)
+    got = f.fetch(_template(tree))
+    assert got is not None
+    mono = t.fetch_base(_template(tree))
+    assert got[1] == mono[1]
+    assert _trees_equal(got[0], mono[0])
+    assert f.sharded_fetches_total == 1 and f.fallbacks_total == 0
+
+
+def test_warm_pull_fetches_only_changed_layers(tmp_path):
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    pub, _ = _published(t, tree)
+    f = BaseFetcher(t)
+    assert f.fetch(_template(tree)) is not None
+    tree2 = dict(tree)
+    tree2["ln"] = tree["ln"] + 1.0
+    rev2 = t.publish_base(tree2)
+    assert pub.publish_revision(tree2, rev2)
+    t.fetched.clear()
+    got = f.fetch(_template(tree))
+    assert got is not None and got[1] == rev2
+    assert _trees_equal(got[0], tree2)
+    shard_fetches = [(mid, n) for mid, n in t.fetched
+                     if mid.startswith(tbase.BASE_PREFIX + ".s.")]
+    # exactly ONE shard crossed the wire, and it is the changed layer;
+    # every unchanged layer cost 0 bytes (the store served it)
+    assert len(shard_fetches) == 1
+    assert shard_fetches[0][0] == tbase.base_shard_id("ln")
+    assert f.store_hits_total == 3
+
+
+def test_publisher_dedupes_unchanged_shards(tmp_path):
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    pub, _ = _published(t, tree)
+    uploads_cold = sum(1 for mid, _ in t.published
+                       if mid.startswith(tbase.BASE_PREFIX + ".s."))
+    assert uploads_cold == 4
+    tree2 = dict(tree)
+    tree2["ln"] = tree["ln"] + 1.0
+    rev2 = t.publish_base(tree2)
+    t.published.clear()
+    assert pub.publish_revision(tree2, rev2)
+    uploads_warm = [mid for mid, _ in t.published
+                    if mid.startswith(tbase.BASE_PREFIX + ".s.")]
+    assert uploads_warm == [tbase.base_shard_id("ln")]
+
+
+def test_monolithic_fallback_seeds_the_store(tmp_path):
+    """A fetcher whose first pull fell back to the monolithic path (no
+    manifest yet) still delta-pulls the NEXT round: the fallback seeds
+    the store with locally-derived digests."""
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    t.publish_base(tree)         # old averager: monolithic only
+    f = BaseFetcher(t)
+    assert f.fetch(_template(tree)) is not None
+    assert f.fallbacks_total == 1
+    # the averager upgrades; one layer changes
+    pub = BasePublisher(t)
+    tree2 = dict(tree)
+    tree2["ln"] = tree["ln"] + 1.0
+    rev2 = t.publish_base(tree2)
+    assert pub.publish_revision(tree2, rev2)
+    t.fetched.clear()
+    got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree2)
+    assert f.fallbacks_total == 1          # no second fallback
+    shard_fetches = [mid for mid, _ in t.fetched
+                     if mid.startswith(tbase.BASE_PREFIX + ".s.")]
+    assert shard_fetches == [tbase.base_shard_id("ln")]
+
+
+def test_announce_rider_round_trip(tmp_path):
+    t = LocalFSTransport(str(tmp_path))
+    tree = _tree()
+    _, rev = _published(t, tree, mirrors=["sub0", "sub1"])
+    rider = read_base_wire_rider(t)
+    assert rider == {"revision": rev, "mirrors": ["sub0", "sub1"]}
+    # hostile rider reads as absent, never an exception
+    t.publish_delta_meta(tbase.BASE_PREFIX, {"base_wire": "nope"})
+    assert read_base_wire_rider(t) is None
+
+
+# ---------------------------------------------------------------------------
+# Hostile / torn inputs degrade loudly to the monolithic base
+# ---------------------------------------------------------------------------
+
+def test_hostile_manifest_falls_back_to_monolithic(tmp_path, caplog):
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    rev = t.publish_base(tree)
+    t.publish_raw(tbase.base_manifest_id(rev),
+                  ser.BASE_MANIFEST_MAGIC + b"{hostile")
+    f = BaseFetcher(t)
+    with caplog.at_level("WARNING"):
+        got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree)
+    assert f.fallbacks_total == 1
+    assert any("rejected" in r.message for r in caplog.records)
+
+
+def test_bad_hash_manifest_falls_back(tmp_path):
+    """A manifest whose hashes match nothing on the wire: every shard
+    fails verification, the pull degrades to the monolithic base."""
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    rev = t.publish_base(tree)
+    layers = {k: ("a" * 64, 10) for k in base_layer_items(tree)}
+    t.publish_raw(tbase.base_manifest_id(rev),
+                  ser.build_base_manifest(layers, revision=rev))
+    f = BaseFetcher(t)
+    got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree)
+    assert f.fallbacks_total == 1
+
+
+def test_torn_shard_set_never_decodes(tmp_path):
+    """One shard overwritten after the manifest committed (the
+    mid-publish race): its hash check fails, the pull falls back, and
+    the fetched tree is STILL the published base — a half-new assembly
+    is never returned."""
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    pub, rev = _published(t, tree)
+    t.publish_raw(tbase.base_shard_id("ln"),
+                  ser.pack_base_shard(np.full(8, 999.0, np.float32)))
+    f = BaseFetcher(t)
+    got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree)
+    assert f.fallbacks_total == 1
+
+
+def test_tampered_signed_manifest_exits_loudly(tmp_path, caplog):
+    """Signed fleet: the manifest travels enveloped (publish_delta_raw)
+    and a tampered one is REJECTED at the signature layer with a
+    warning — the fetcher then falls back to the (equally signed,
+    verified) monolithic base."""
+    pytest.importorskip("cryptography")
+    from distributedtraining_tpu.transport.signed import SignedTransport
+    from distributedtraining_tpu.utils.identity import Identity
+
+    ident = Identity.generate()
+
+    def resolver(hotkey):
+        # the averager's key also pins every reserved id it publishes
+        return ident.public_bytes
+
+    inner = LocalFSTransport(str(tmp_path))
+    signed = SignedTransport(inner, identity=ident,
+                             pubkey_resolver=resolver,
+                             base_signer=ident.hotkey,
+                             my_hotkey=ident.hotkey)
+    tree = _tree()
+    rev = signed.publish_base(tree)
+    pub = BasePublisher(signed)
+    assert pub.publish_revision(tree, rev)
+    f = BaseFetcher(signed)
+    got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree)
+    assert f.fallbacks_total == 0
+    # attacker with write access swaps the manifest for unsigned bytes
+    good = ser.build_base_manifest(
+        {k: (ser.shard_digest(ser.pack_base_shard(v)), 1)
+         for k, v in base_layer_items(_tree(seed=9)).items()},
+        revision=rev)
+    inner.publish_raw(tbase.base_manifest_id(rev), good)
+    f2 = BaseFetcher(signed)
+    with caplog.at_level("WARNING"):
+        got2 = f2.fetch(_template(tree))
+    # the forged manifest is rejected (logged), the pull degrades to
+    # the signature-verified monolithic base — bit-exact, not hostile
+    assert got2 is not None and _trees_equal(got2[0], tree)
+    assert f2.fallbacks_total == 1
+    assert any("rejected" in r.message for r in caplog.records)
+
+
+def test_fetch_never_raises_on_probe_failure():
+    class Dead(InMemoryTransport):
+        def base_revision(self):
+            raise OSError("backend down")
+
+    f = BaseFetcher(Dead())
+    assert f.fetch(_template()) is None
+
+
+# ---------------------------------------------------------------------------
+# Mirrors
+# ---------------------------------------------------------------------------
+
+class FaultyFS(CountingFS):
+    """LocalFS whose origin base-shard slots and/or mirror slots can be
+    switched off (ChaosError-free spelling: a plain OSError, which is
+    what every isolation path treats as a transport fault)."""
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.origin_shards_dead = False
+        self.mirrors_dead = False
+
+    def fetch_delta_bytes(self, mid):
+        if self.origin_shards_dead and \
+                mid.startswith(tbase.BASE_PREFIX + ".s."):
+            raise OSError("origin shard slot dead")
+        if self.mirrors_dead and \
+                mid.startswith(f"{tbase.SHARD_PREFIX}.{tbase.MIRROR_PREFIX}."):
+            raise OSError("mirror replica dead")
+        return super().fetch_delta_bytes(mid)
+
+
+def test_mirror_serves_shards_and_fails_over(tmp_path):
+    t = FaultyFS(str(tmp_path))
+    tree = _tree()
+    pub, rev = _published(t, tree, mirrors=["sub0"])
+    mirror = MirrorDuty(t, "sub0")
+    assert mirror.sync()
+    # presence rider names the mirrored revision
+    meta = t.fetch_delta_meta(tbase.mirror_node_id("sub0"))
+    assert meta["mirror"]["revision"] == rev
+
+    # origin shard slots die: the pull still completes entirely off the
+    # mirror replica (the manifest's hashes verify whatever slot served)
+    t.origin_shards_dead = True
+    f = BaseFetcher(t)
+    got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree)
+    assert f.fallbacks_total == 0 and f.mirror_hits_total == 4
+
+    # a NEW revision with the mirror ALSO dead: per-shard fall-through
+    # to origin (revived), no round loss
+    t.origin_shards_dead = False
+    t.mirrors_dead = True
+    tree2 = dict(tree)
+    tree2["ln"] = tree["ln"] + 1.0
+    rev2 = t.publish_base(tree2)
+    assert pub.publish_revision(tree2, rev2)
+    f2 = BaseFetcher(t)
+    got2 = f2.fetch(_template(tree))
+    assert got2 is not None and _trees_equal(got2[0], tree2)
+    assert got2[1] == rev2
+    assert f2.mirror_hits_total == 0 and f2.fallbacks_total == 0
+
+
+def test_mirror_sync_is_incremental(tmp_path):
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    pub, _ = _published(t, tree)
+    mirror = MirrorDuty(t, "sub0")
+    assert mirror.sync()
+    republished = [mid for mid, _ in t.published
+                   if mid.startswith(
+                       f"{tbase.SHARD_PREFIX}.{tbase.MIRROR_PREFIX}.")]
+    assert len(republished) == 4
+    tree2 = dict(tree)
+    tree2["ln"] = tree["ln"] + 1.0
+    rev2 = t.publish_base(tree2)
+    assert pub.publish_revision(tree2, rev2)
+    t.published.clear()
+    assert mirror.sync()
+    republished = [mid for mid, _ in t.published
+                   if mid.startswith(
+                       f"{tbase.SHARD_PREFIX}.{tbase.MIRROR_PREFIX}.")]
+    # only the changed layer re-replicates
+    assert republished == [tbase.shard_id(tbase.mirror_node_id("sub0"),
+                                          "ln")]
+    # an unchanged revision is a no-op pass
+    t.published.clear()
+    assert mirror.sync()
+    assert not t.published
+
+
+# ---------------------------------------------------------------------------
+# Mixed fleets (the no-flag-day negotiation)
+# ---------------------------------------------------------------------------
+
+def test_old_fetcher_against_new_averager(tmp_path):
+    """A pre-round-19 node keeps using fetch_base and sees exactly the
+    published base — the shard plane is an overlay, not a format
+    change."""
+    t = LocalFSTransport(str(tmp_path))
+    tree = _tree()
+    _, rev = _published(t, tree)
+    got = t.fetch_base(_template(tree))
+    assert got is not None and got[1] == rev
+    assert _trees_equal(got[0], tree)
+
+
+def test_new_fetcher_against_old_averager(tmp_path):
+    """No manifest, no rider (old averager): the enabled fetcher
+    silently takes the monolithic path every round."""
+    t = LocalFSTransport(str(tmp_path))
+    tree = _tree()
+    t.publish_base(tree)
+    f = BaseFetcher(t)
+    got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree)
+    assert f.sharded_fetches_total == 0 and f.fallbacks_total == 1
+
+
+def test_disabled_fetcher_is_plain_monolithic(tmp_path):
+    t = CountingFS(str(tmp_path))
+    tree = _tree()
+    _published(t, tree)
+    f = BaseFetcher(t, enabled=False)
+    got = f.fetch(_template(tree))
+    assert got is not None and _trees_equal(got[0], tree)
+    # never probed the manifest id, never counted a fallback
+    assert not any(mid.startswith(tbase.BASE_PREFIX)
+                   for mid, _ in t.fetched)
+    assert f.fallbacks_total == 0
+
+
+# ---------------------------------------------------------------------------
+# Degrade-to-current-base regression pins (the satellite fix)
+# ---------------------------------------------------------------------------
+
+def _mini_engine():
+    from distributedtraining_tpu.engine.train import TrainEngine
+    from distributedtraining_tpu.models import gpt2
+    model, cfg = gpt2.make_model(gpt2.GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=16, n_layer=1, n_head=2))
+    return TrainEngine(model, seq_len=16)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return _mini_engine()
+
+
+def test_watcher_degrades_on_hostile_manifest(tmp_path, engine):
+    """BaseRevisionWatcher + fetcher: a hostile manifest (and then a
+    torn monolithic base too) leaves serving on the current base —
+    poll_once returns via the fallback or counts a failure, it never
+    raises (chaos-pinned twin of the monolithic torn-fetch test)."""
+    from distributedtraining_tpu.engine.serve import BaseRevisionWatcher
+    from distributedtraining_tpu.engine.train import host_wire_template
+    from distributedtraining_tpu.transport.chaos import (ChaosSpec,
+                                                         ChaosTransport)
+
+    inner = LocalFSTransport(str(tmp_path))
+    t = ChaosTransport(inner, ChaosSpec())   # fault-free gate: the wrap
+    #                                          pins the wrapper surface
+    template = host_wire_template(engine)
+    tree = jax.tree_util.tree_map(
+        lambda x: np.asarray(np.random.RandomState(0).randn(*np.shape(x)),
+                             np.asarray(x).dtype), template)
+    rev = t.publish_base(tree)
+    # hostile manifest for the revision: the sharded path must degrade
+    inner.publish_raw(tbase.base_manifest_id(rev),
+                      ser.BASE_MANIFEST_MAGIC + b"{hostile")
+    fetcher = BaseFetcher(t)
+    watcher = BaseRevisionWatcher(t, lambda: template, fetcher=fetcher)
+    assert watcher.poll_once()            # staged via monolithic fallback
+    staged = watcher.take_pending()
+    assert staged is not None and staged[0] == rev
+    assert fetcher.fallbacks_total == 1
+
+    # now the monolithic base is ALSO torn: no stage, no raise — serving
+    # stays on the current base
+    inner.publish_base_raw(b"torn-garbage")
+    assert watcher.poll_once() is False
+    assert watcher.take_pending() is None
+
+
+def test_miner_bootstrap_refuses_genesis_fork_on_torn_base(tmp_path,
+                                                           engine):
+    """A published-but-unreadable base at boot must NOT silently fork
+    the miner to a genesis base: bootstrap retries briefly, then
+    surfaces an OSError for the role's bounded bootstrap retry."""
+    from distributedtraining_tpu.engine.train import MinerLoop
+
+    t = LocalFSTransport(str(tmp_path))
+    t.publish_base_raw(b"torn-garbage")   # revision exists, decode fails
+    loop = MinerLoop(engine, t, "m0", send_interval=1e9, push_async=False)
+    with pytest.raises(OSError):
+        loop.bootstrap(rng=jax.random.PRNGKey(0))
+    loop.flush()
+
+
+def test_miner_bootstrap_degrades_on_manifest_parse_failure(tmp_path,
+                                                            engine):
+    """The satellite contract: a hostile/torn MANIFEST at boot degrades
+    to the monolithic base — the miner comes up on the published base,
+    not genesis, and not an exception."""
+    from distributedtraining_tpu.engine.train import (MinerLoop,
+                                                      host_wire_template)
+
+    t = LocalFSTransport(str(tmp_path))
+    template = host_wire_template(engine)
+    tree = jax.tree_util.tree_map(
+        lambda x: np.asarray(np.random.RandomState(1).randn(*np.shape(x)),
+                             np.asarray(x).dtype), template)
+    rev = t.publish_base(tree)
+    t.publish_raw(tbase.base_manifest_id(rev),
+                  ser.BASE_MANIFEST_MAGIC + b"{hostile")
+    fetcher = BaseFetcher(t)
+    loop = MinerLoop(engine, t, "m0", send_interval=1e9,
+                     push_async=False, base_fetcher=fetcher)
+    loop.bootstrap(rng=jax.random.PRNGKey(0))
+    assert loop._base_revision == rev
+    assert fetcher.fallbacks_total == 1
+    # next round the averager publishes a HEALTHY manifest: the pull
+    # goes back to the sharded path warm off the fallback-seeded store
+    pub = BasePublisher(t)
+    tree2 = dict(tree)
+    key = sorted(tree2)[0]
+    tree2[key] = jax.tree_util.tree_map(lambda x: x + 0.5, tree2[key]) \
+        if isinstance(tree2[key], dict) else tree2[key] + 0.5
+    rev2 = t.publish_base(tree2)
+    assert pub.publish_revision(tree2, rev2)
+    loop._check_pull()
+    assert loop._base_revision == rev2
+    assert fetcher.sharded_fetches_total == 1
+    loop.flush()
+
+
+# ---------------------------------------------------------------------------
+# Fleetsim: the mirror-kill chaos scenario (satellite)
+# ---------------------------------------------------------------------------
+
+def test_fleetsim_mirror_kill_fails_over_with_no_round_loss():
+    from distributedtraining_tpu.engine import fleetsim as fs
+
+    spec = fs.FleetSpec(miners=8, validators=1, servers=0,
+                        sub_averagers=2, rounds=6, seed=7, chaos=False,
+                        standby=False, mirror_kill_round=4)
+    result = fs.simulate(spec)
+    assert result.rounds_completed == spec.rounds
+    assert result.base_mirror_shard_hits > 0          # mirrors DID serve
+    card = fs.assemble_scorecard(result)
+    gate = card["gates"]["base_dist"]
+    assert gate["ok"], gate
+    assert gate["post_kill_mirror_bytes"] == 0        # dead means dead
+    # every miner completed a pull every post-kill round: no round loss
+    assert gate["post_kill_pulls"] == spec.miners * (spec.rounds
+                                                     - spec.mirror_kill_round
+                                                     + 1)
+    # per-round accounting: mirror bytes moved before the kill
+    samples = card["wire"]["samples"]
+    pre_kill = samples[spec.mirror_kill_round - 2]
+    assert pre_kill["base_mirror_fetch_bytes"] > 0
+
+
+def test_fleetsim_base_bytes_accounting_splits_origin_and_mirror():
+    from distributedtraining_tpu.engine import fleetsim as fs
+
+    spec = fs.FleetSpec(miners=6, validators=1, servers=0,
+                        sub_averagers=2, rounds=4, seed=1, chaos=False,
+                        standby=False)
+    result = fs.simulate(spec)
+    last = result.wire_samples[-1]
+    assert last["base_origin_fetch_bytes"] > 0
+    assert last["base_mirror_fetch_bytes"] > 0
+    assert (last["base_origin_fetch_bytes"]
+            + last["base_mirror_fetch_bytes"]) <= last["fetch_bytes"]
+    # the sharded plane OFF: no mirror bytes, byte-identical rerun logic
+    # still holds (determinism is pinned module-wide in test_fleetsim)
+    off = fs.simulate(dataclasses_replace(spec, base_wire_v2=False))
+    assert off.wire_samples[-1]["base_mirror_fetch_bytes"] == 0
+    assert off.base_sharded_pulls == 0
+
+
+def dataclasses_replace(spec, **kw):
+    import dataclasses
+    return dataclasses.replace(spec, **kw)
+
+
+# ---------------------------------------------------------------------------
+# fleet_report columns (satellite)
+# ---------------------------------------------------------------------------
+
+def test_fleet_report_base_columns():
+    assert "base_b" in fleet_report.COLUMNS
+    assert "mirror_hit" in fleet_report.COLUMNS
+    node = {"base_fetch_bytes": 5 * (1 << 20),
+            "base_mirror_hit_rate": 0.875}
+    assert fleet_report._cell(node, "base_b") == "5.0M"
+    assert fleet_report._cell(node, "mirror_hit") == "0.88"
+    assert fleet_report._cell({}, "base_b") == "-"
+    assert fleet_report._cell({}, "mirror_hit") == "-"
+
+
+def test_fetcher_heartbeat_fields(tmp_path):
+    t = LocalFSTransport(str(tmp_path))
+    tree = _tree()
+    _published(t, tree)
+    f = BaseFetcher(t)
+    assert f.fetch(_template(tree)) is not None
+    fields = f.heartbeat_fields()
+    assert fields["base_fetch_bytes"] > 0
+    assert fields["base_fetch_bytes"] == fields["base_last_fetch_bytes"]
+    # every name must pass the heartbeat producer lint
+    from distributedtraining_tpu.engine.health import build_heartbeat
+    build_heartbeat("miner", "m0", 1, now=0.0, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Store
+# ---------------------------------------------------------------------------
+
+def test_store_lru_byte_budget():
+    store = BaseShardStore(max_bytes=100)
+    a = np.zeros(10, np.float32)   # 40 bytes
+    store.put("d1", a)
+    store.put("d2", a)
+    assert len(store) == 2 and store.nbytes == 80
+    store.put("d3", a)             # evicts d1 (LRU)
+    assert store.lookup("d1") is None
+    assert store.lookup("d2") is not None
+    assert store.nbytes == 80
+    # an over-budget array is refused, not cached
+    store.put("big", np.zeros(1000, np.float32))
+    assert store.lookup("big") is None
+    # budget 0 disables caching entirely
+    off = BaseShardStore(max_bytes=0)
+    off.put("d", a)
+    assert off.lookup("d") is None
