@@ -22,6 +22,7 @@ faster than its own first measurement.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import json
 import os
 import time
@@ -1036,7 +1037,15 @@ def _time_serve(*, n_requests: int = 8, prompt_len: int = 16,
         swap_ms = reg.histogram("serve.swap_stall_ms").percentiles(
             (95.0,))["p95"]
         engine.close()
+        # the decode-attention kernel-vs-XLA micro A/B rides in the serve
+        # record (round-20 tentpole): the engine-level numbers above
+        # already RUN the kernel on TPU — this isolates its contribution
+        try:
+            attn_ab = _time_decode_attn_kernel()
+        except Exception as e:   # a failed sub-bench never sinks serve
+            attn_ab = {"decode_attn_error": repr(e)}
         return {
+            **attn_ab,
             "serve_naive_tokens_per_sec": round(naive_tps, 1),
             "serve_batched_tokens_per_sec": round(engine_tps, 1),
             "serve_speedup": round(engine_tps / naive_tps, 3),
@@ -1051,6 +1060,139 @@ def _time_serve(*, n_requests: int = 8, prompt_len: int = 16,
         }
     finally:
         obs.reset()
+
+
+def _time_decode_attn_kernel(*, B: int = 4, Hq: int = 4, Hkv: int = 2,
+                             D: int = 64, P: int = 16, MP: int = 8,
+                             iters: int = 20) -> dict:
+    """Fused paged-attention decode kernel vs the XLA gather+attend
+    spelling (round-20 tentpole, half a): one layer's decode attention
+    at serving shapes, parity-pinned <= 1e-6. On TPU both sides are
+    real device programs and the ratio is the per-token attention win;
+    off-TPU the kernel runs INTERPRETED (a correctness lane, orders of
+    magnitude slower by construction), so the timing contrast is marked
+    ``degraded_cpu`` and only the parity bit is rig-meaningful. Both
+    programs register in the device observatory (``serve.decode_attn``
+    vs the XLA path inside ``serve.decode``), so on TPU the roofline
+    achieved-bandwidth fraction rides ``prog_achieved`` into the
+    --baseline regression gate."""
+    from distributedtraining_tpu.ops import paged_attention as pa
+    from distributedtraining_tpu.utils import devprof
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    pool = 1 + B * MP
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.standard_normal((pool, P, Hkv, D)), jnp.float32)
+    v_pages = jnp.asarray(
+        rng.standard_normal((pool, P, Hkv, D)), jnp.float32)
+    k_new = jnp.asarray(rng.standard_normal((B, 1, Hkv, D)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((B, 1, Hkv, D)), jnp.float32)
+    tables = jnp.asarray(
+        1 + np.arange(B * MP).reshape(B, MP), jnp.int32)
+    seq_lens = jnp.asarray(
+        rng.randint(1, MP * P, size=(B,)), jnp.int32)
+
+    ref_prog = jax.jit(pa.paged_decode_reference)  # devprof: exempt (bench A/B twin of the serve.decode in-step path)
+    kernel = devprof.wrap(
+        "serve.decode_attn",
+        jax.jit(functools.partial(pa.paged_decode_attention,
+                                  interpret=not on_tpu)),
+        bucket=f"{B}x{MP}")
+
+    ref = ref_prog(q, k_pages, v_pages, tables, seq_lens, k_new, v_new)
+    out = kernel(q, k_pages, v_pages, tables, seq_lens, k_new, v_new)
+    if out is None:
+        return {"decode_attn_kernel": "declined"}
+    parity = float(jnp.max(jnp.abs(out - ref)))
+
+    def timed(fn, n):
+        jax.block_until_ready(
+            fn(q, k_pages, v_pages, tables, seq_lens, k_new, v_new))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = fn(q, k_pages, v_pages, tables, seq_lens, k_new, v_new)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    # interpret mode is a correctness lane: one timed call is plenty
+    n_kernel = iters if on_tpu else 1
+    out = {
+        "decode_attn_parity_err": parity,
+        "decode_attn_parity": bool(parity < 1e-6),
+        "decode_attn_xla_ms": round(timed(ref_prog, iters), 3),
+        "decode_attn_kernel_ms": round(timed(kernel, n_kernel), 3),
+        "decode_attn_shape": f"B{B} Hq{Hq} Hkv{Hkv} D{D} P{P} MP{MP}",
+    }
+    if not on_tpu:
+        out["decode_attn_degraded_cpu"] = True   # interpreted kernel
+    else:
+        out["decode_attn_speedup"] = round(
+            out["decode_attn_xla_ms"] / out["decode_attn_kernel_ms"], 3)
+    return out
+
+
+def _time_packed_ingest(*, n_miners: int = 8, trials: int = 2) -> dict:
+    """Packed wire-v2 ingest A/B (round-20 tentpole, half b): folding M
+    contributions into one f32 aggregate via the XLA ``.at[idx].add``
+    accumulate (a functional full-buffer copy per contribution without
+    donation) vs the fused dequantize->scatter-add Pallas kernel
+    (``delta.dequant_scatter``, O(k) bytes written in place). Parity
+    pinned <= 1e-6 over the whole aggregate. Off-TPU the kernel side
+    runs INTERPRETED — ``degraded_cpu``, parity-meaningful only — and
+    the shapes shrink to keep the interpreter inside the bench budget.
+    """
+    from distributedtraining_tpu import delta as delta_lib
+    from distributedtraining_tpu.ops import dequant_scatter as dsc
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if on_tpu and not dsc.enabled():
+        return {"packed_ingest_kernel": "declined"}
+    # one above-cutoff leaf (indexed-form entries, the kernel's case)
+    # plus one below-cutoff leaf (dense-form, both sides identical)
+    shape = (128, 256) if on_tpu else (96, 64)
+    rng = np.random.RandomState(0)
+    template = {"w": np.zeros(shape, np.float32),
+                "b": np.zeros((64,), np.float32)}
+    packs = []
+    for i in range(n_miners):
+        d = {"w": jnp.asarray(rng.standard_normal(shape), jnp.float32),
+             "b": jnp.asarray(rng.standard_normal((64,)), jnp.float32)}
+        packs.append(delta_lib.pack_delta_v2(d, density=1.0 / 8.0)[0])
+    weights = jnp.full((n_miners,), 1.0 / n_miners, jnp.float32)
+
+    def fold():
+        return delta_lib.aggregate_deltas(template, packs, weights)
+
+    def timed(n):
+        agg = fold()
+        jax.block_until_ready(jax.tree_util.tree_leaves(agg))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            agg = fold()
+        jax.block_until_ready(jax.tree_util.tree_leaves(agg))
+        return agg, (time.perf_counter() - t0) / n * 1e3
+
+    ref, xla_ms = timed(trials)
+    try:
+        dsc.use_interpret(not on_tpu)
+        agg, kernel_ms = timed(trials if on_tpu else 1)
+    finally:
+        dsc.use_interpret(False)
+    err = max(float(jnp.max(jnp.abs(ref[k] - agg[k]))) for k in ref)
+    out = {
+        "packed_ingest_miners": n_miners,
+        "packed_ingest_parity_err": err,
+        "packed_ingest_parity": bool(err < 1e-6),
+        "packed_ingest_xla_ms": round(xla_ms, 3),
+        "packed_ingest_kernel_ms": round(kernel_ms, 3),
+    }
+    if not on_tpu:
+        out["packed_ingest_degraded_cpu"] = True
+    else:
+        out["packed_ingest_speedup"] = round(xla_ms / kernel_ms, 3)
+    return out
 
 
 def _time_metrics_overhead(*, steps: int = 100, trials: int = 2,
@@ -1989,6 +2131,14 @@ def main(argv=None) -> None:
         extras.update(_time_serve())
     except Exception as e:
         extras["serve_error"] = repr(e)
+
+    try:
+        # packed wire-v2 ingest: fused dequant->scatter-add kernel vs
+        # the XLA accumulate (round-20 tentpole; parity-pinned, CPU
+        # side runs the interpreted kernel and marks degraded)
+        extras.update(_time_packed_ingest())
+    except Exception as e:
+        extras["packed_ingest_error"] = repr(e)
 
     try:
         # fleet health plane cost: production loop with the heartbeat

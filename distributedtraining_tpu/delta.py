@@ -1066,6 +1066,55 @@ _accum_packed_jit = devprof.wrap(
     "delta.accumulate", jax.jit(_accum_packed), bucket="packed")
 
 
+def _accum_packed_kernel(acc_leaves, entries, w):
+    """Kernel-backed twin of :func:`_accum_packed`: indexed-form entries
+    route through the fused dequantize->scatter-add Pallas kernel
+    (ops/dequant_scatter.py) whose accumulator is aliased in place —
+    bytes written per contribution drop from O(n) (the functional
+    ``.at[idx].add`` copy) to O(k). Leaves the kernel declines (too big
+    for VMEM, empty idx) keep the XLA spelling INSIDE the same program,
+    so the output is identical leaf-for-leaf either way (parity pinned
+    in tests/test_dequant_scatter.py)."""
+    from .ops import dequant_scatter as _dsc
+    out = []
+    for a, e in zip(acc_leaves, entries):
+        flat = a.reshape(-1)
+        idx, q, scale = e["idx"], e["q"], e["scale"]
+        n = flat.shape[0]
+        if idx.shape[0] == 0 and q.shape[0] == n and n > 0:
+            flat = flat + w * (q.astype(flat.dtype) * scale)
+        else:
+            got = _dsc.dequant_scatter_add(flat, idx, q, w * scale)
+            if got is None:   # static decline (shape/VMEM budget)
+                flat = flat.at[idx].add(w * (q.astype(flat.dtype) * scale))
+            else:
+                flat = got
+        out.append(flat.reshape(a.shape))
+    return out
+
+
+# built lazily: donation (the cross-call half of the in-place story — a
+# donated accumulator lets XLA alias the kernel's input_output_aliases
+# chain across contributions) is backend-dependent, and probing the
+# backend at import time would force backend init on every importer
+_ACCUM_KERNEL_PROG = None
+
+
+def _accum_packed_kernel_prog():
+    global _ACCUM_KERNEL_PROG
+    if _ACCUM_KERNEL_PROG is None:
+        try:
+            donate = (0,) if jax.default_backend() in ("tpu", "axon") \
+                else ()
+        except Exception:
+            donate = ()
+        _ACCUM_KERNEL_PROG = devprof.wrap(
+            "delta.dequant_scatter",
+            jax.jit(_accum_packed_kernel, donate_argnums=donate),
+            bucket="packed")
+    return _ACCUM_KERNEL_PROG
+
+
 def _accum_dense(acc, d, w):
     return jax.tree_util.tree_map(
         lambda a, x: a + w * x.astype(a.dtype), acc, d)
@@ -1085,6 +1134,7 @@ def accumulate_delta(acc: Params, delta: Params, weight) -> Params:
     rounds and varying weights reuse the compiled programs."""
     w = jnp.asarray(weight, jnp.float32)
     if is_packed_v2(delta):
+        from .ops import dequant_scatter as _dsc
         leaves, treedef = jax.tree_util.tree_flatten(acc)
         entries = jax.tree_util.tree_leaves(delta["leaves"],
                                             is_leaf=is_packed_entry)
@@ -1093,8 +1143,10 @@ def accumulate_delta(acc: Params, delta: Params, weight) -> Params:
                 f"accumulate_delta: {len(entries)} packed entries for a "
                 f"{len(leaves)}-leaf accumulator (run packed_matches "
                 "before accumulating)")
+        prog = _accum_packed_kernel_prog() if _dsc.enabled() \
+            else _accum_packed_jit
         return jax.tree_util.tree_unflatten(
-            treedef, _accum_packed_jit(leaves, entries, w))
+            treedef, prog(leaves, entries, w))
     return _accum_dense_jit(acc, delta, w)
 
 

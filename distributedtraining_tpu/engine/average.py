@@ -751,6 +751,18 @@ class AveragerLoop:
         identical whatever this averager's scan setting."""
         if self._ingestor is None:
             from .ingest import DeltaIngestor
+            from .train import _scan_wire_adapters
+            # packed submissions stay PACKED end-to-end when the merge
+            # strategy folds a host list by scatter-add
+            # (WeightedAverage's aggregate_deltas path) and the engine
+            # layout IS the wire layout (no mesh stack, no scan-blocks
+            # restack) — the densify_packed_v2 round-trip (full-tensor
+            # writes per contribution) then never runs on this role;
+            # regressions are visible as ``delta.densify_fallbacks``
+            self._packed_ingest = (
+                getattr(self.strategy, "host_list_ingest", False)
+                and getattr(self.engine, "mesh", None) is None
+                and _scan_wire_adapters(self.engine.model) is None)
             self._ingestor = DeltaIngestor(
                 self.transport, self._host_template,
                 lora_cfg=self.lora_cfg,
@@ -762,6 +774,7 @@ class AveragerLoop:
                 workers=self.ingest_workers,
                 cache_bytes=self.ingest_cache_mb * (1 << 20),
                 span_prefix="avg",
+                densify=not self._packed_ingest,
                 observer=(self.fleet.record_staging
                           if self.fleet is not None else None))
         return self._ingestor
@@ -834,7 +847,12 @@ class AveragerLoop:
                 continue
             ids.append(s.hotkey)
             self._round_staged[s.hotkey] = s
-            deltas.append(wire_in(self.engine, s.delta))
+            # packed v2 trees are ALREADY wire layout by definition (and
+            # only staged packed when the engine layout matches it —
+            # _ingest's densify gate); wire_in's restack would mangle
+            # their {"idx","q","scale"} entries
+            deltas.append(s.delta if delta_lib.is_packed_v2(s.delta)
+                          else wire_in(self.engine, s.delta))
         # only the cids of ACCEPTED deltas annotate the merge records
         self._round_cids = {h: c for h, c in self._round_cids.items()
                             if h in set(ids)}

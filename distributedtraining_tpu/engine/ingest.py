@@ -742,7 +742,12 @@ class DeltaIngestor:
                 # verdict passed: NOW densify for the merge/eval paths
                 # downstream (they consume dense wire-layout trees).
                 # densify=False consumers (the packed scatter-add merge)
-                # keep the packed form instead.
+                # keep the packed form instead. Counted: a merge-path
+                # consumer that silently regresses onto this round-trip
+                # (full-tensor writes per contribution — the cost the
+                # dequant-scatter kernel deletes) shows up in
+                # fleet_report, not a profile months later.
+                obs.count("delta.densify_fallbacks")
                 t0 = time.perf_counter()
                 dense = delta_lib.densify_packed_v2(s.delta,
                                                     self._template())

@@ -24,8 +24,11 @@ Design, in the order it matters on TPU:
   page tables. A sequence owns exactly the pages its length needs, so
   admitting a short prompt next to a long generation never pads the
   whole batch to the longest sequence: decode recomputes ONE token per
-  sequence per step and attention gathers each slot's own pages (dead
-  page slots are masked by real lengths — ops/attention.cached_attention).
+  sequence per step and attention reads each slot's own pages straight
+  through the table (ops/paged_attention.py — the fused gather+attend
+  Pallas kernel on TPU, its XLA twin elsewhere; dead page slots are
+  masked by real lengths, and the dense gathered context the
+  pre-round-20 spelling materialized per token no longer exists).
   Long prompts prefill through the standard model forward, i.e. through
   ops/flash_attention.py wherever the model's ``attention_impl`` does.
   Page exhaustion preempts the youngest sequence back to the queue
@@ -563,20 +566,17 @@ class GenerationEngine:
         stack_kv = self._stack_kv
 
         def step(params, k_pages, v_pages, page_tables, seq_lens, tokens):
-            # per-slot context gather from the page pool: the classic
-            # paged-attention spelling — [L, B, MP, P, H, D] and flatten
-            # the page axis into a padded context of MP*P positions
-            k_ctx = k_pages[:, page_tables]
-            v_ctx = v_pages[:, page_tables]
-            B = tokens.shape[0]
-            S = n_pages * P
-            k_ctx = k_ctx.reshape(L, B, S, *k_ctx.shape[-2:])
-            v_ctx = v_ctx.reshape(L, B, S, *v_ctx.shape[-2:])
-            kv_ctx = tuple((k_ctx[i], v_ctx[i]) for i in range(L))
+            # paged attention: each block reads its OWN page-pool slice
+            # directly through the table (ops/paged_attention.py — the
+            # fused gather+attend kernel on TPU, its XLA twin off-TPU).
+            # The dense [L, B, S, H, D] gathered context the pre-kernel
+            # spelling materialized here per token no longer exists.
+            kv_pages = tuple((k_pages[i], v_pages[i]) for i in range(L))
             logits, muts = model.apply(
                 {"params": params}, tokens[:, None],
                 position_ids=seq_lens[:, None],
-                kv_ctx=kv_ctx, kv_lens=seq_lens,
+                kv_pages=kv_pages, page_tables=page_tables,
+                kv_lens=seq_lens,
                 sow_kv=True, mutable=["intermediates"])
             new_k, new_v = stack_kv(muts["intermediates"])  # [L, B, 1, H, D]
             page_idx = jnp.take_along_axis(

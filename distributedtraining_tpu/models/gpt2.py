@@ -118,15 +118,21 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, attention_mask, segment_ids, deterministic,
-                 kv_ctx=None, kv_lens=None, sow_kv=False):
+                 kv_ctx=None, kv_lens=None, sow_kv=False,
+                 kv_pages=None, page_tables=None):
         """``kv_ctx``/``kv_lens``/``sow_kv`` are the serving plane's
         KV-cache hooks (engine/serve.py). ``sow_kv=True`` sows this
         block's (k, v) into the ``intermediates`` collection so a prefill
         pass can populate a cache; ``kv_ctx=(k_ctx, v_ctx)`` switches
         attention to decode mode — the current tokens attend over the
         padded cached context (valid through ``kv_lens``) plus
-        themselves. Both default off, leaving the training forward
-        byte-identical to before."""
+        themselves. ``kv_pages=(k_pages, v_pages)`` (+ ``page_tables``)
+        is the PAGED decode mode: attention reads this layer's page-pool
+        slice directly through the table (ops/paged_attention.py — the
+        fused TPU kernel, or its XLA twin off-TPU) instead of a
+        pre-gathered context; the fresh (k, v) still reach the pool via
+        the sow + the engine's post-step scatter. All default off,
+        leaving the training forward byte-identical to before."""
         cfg = self.cfg
         B, T, E = x.shape
         h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.compute_dtype(),
@@ -143,7 +149,11 @@ class Block(nn.Module):
         v = v.reshape(B, T, cfg.n_head, cfg.head_dim)
         if sow_kv:
             self.sow("intermediates", "kv_cache", (k, v))
-        if kv_ctx is not None:
+        if kv_pages is not None:
+            from ..ops.paged_attention import paged_attention
+            attn = paged_attention(q, kv_pages[0], kv_pages[1],
+                                   page_tables, kv_lens, k, v)
+        elif kv_ctx is not None:
             k_ctx, v_ctx = kv_ctx
             attn = cached_attention(q,
                                     jnp.concatenate([k_ctx, k], axis=1),
@@ -194,7 +204,8 @@ class GPT2(nn.Module):
     def __call__(self, input_ids, *, attention_mask=None, segment_ids=None,
                  position_ids=None, deterministic: bool = True,
                  return_hidden: bool = False,
-                 kv_ctx=None, kv_lens=None, sow_kv: bool = False):
+                 kv_ctx=None, kv_lens=None, sow_kv: bool = False,
+                 kv_pages=None, page_tables=None):
         """``return_hidden=True`` skips the LM head and returns the final
         normed hidden states [B, T, E] — the fused cross-entropy path
         (ops.losses.fused_linear_cross_entropy) computes the head matmul
@@ -209,7 +220,8 @@ class GPT2(nn.Module):
         (``scan_blocks=False``); the serving engine always runs one."""
         cfg = self.cfg
         B, T = input_ids.shape
-        if (kv_ctx is not None or sow_kv) and cfg.scan_blocks:
+        if (kv_ctx is not None or kv_pages is not None or sow_kv) \
+                and cfg.scan_blocks:
             raise ValueError(
                 "KV-cache generation needs the unrolled block layout; "
                 "rebuild the serving model with scan_blocks=False "
@@ -265,7 +277,7 @@ class GPT2(nn.Module):
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
             x, _ = scan(cfg, name="h")(x, attention_mask, segment_ids,
                                        deterministic)
-        elif kv_ctx is not None or sow_kv:
+        elif kv_ctx is not None or kv_pages is not None or sow_kv:
             # serving forward: remat is for backward-pass memory and a
             # generation step never differentiates, so the cache paths
             # skip it (sowing through jax.checkpoint is also undefined);
@@ -274,7 +286,9 @@ class GPT2(nn.Module):
                 x = Block(cfg, name=f"h_{i}")(
                     x, attention_mask, segment_ids, deterministic,
                     kv_ctx[i] if kv_ctx is not None else None,
-                    kv_lens, sow_kv)
+                    kv_lens, sow_kv,
+                    kv_pages[i] if kv_pages is not None else None,
+                    page_tables)
         else:
             block = Block
             if cfg.remat:

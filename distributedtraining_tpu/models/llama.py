@@ -120,12 +120,16 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, attention_mask, segment_ids, position_ids,
-                 kv_ctx=None, kv_lens=None, sow_kv=False):
+                 kv_ctx=None, kv_lens=None, sow_kv=False,
+                 kv_pages=None, page_tables=None):
         """KV-cache hooks mirror gpt2.Block: ``sow_kv`` sows post-RoPE,
         PRE-GQA-broadcast (k, v) — the cache stores Hkv heads and the
         decode path broadcasts to query heads at attention time, so a
         GQA cache is n_head/n_kv_head times smaller than the activations
-        it replaces."""
+        it replaces. The PAGED decode mode (``kv_pages``/``page_tables``,
+        ops/paged_attention.py) is GQA-native: the kernel groups query
+        heads per kv head in-kernel, so the decode path never
+        materializes the ``jnp.repeat`` head broadcast at all."""
         cfg = self.cfg
         B, T, E = x.shape
         Hq, Hkv, D = cfg.n_head, cfg.n_kv_head, cfg.head_dim
@@ -138,7 +142,11 @@ class LlamaBlock(nn.Module):
         k = rotary_embedding(k, position_ids, cfg.rope_theta)
         if sow_kv:
             self.sow("intermediates", "kv_cache", (k, v))
-        if kv_ctx is not None:
+        if kv_pages is not None:
+            from ..ops.paged_attention import paged_attention
+            attn = paged_attention(q, kv_pages[0], kv_pages[1],
+                                   page_tables, kv_lens, k, v)
+        elif kv_ctx is not None:
             k_ctx, v_ctx = kv_ctx
             k_full = jnp.concatenate([k_ctx, k], axis=1)
             v_full = jnp.concatenate([v_ctx, v], axis=1)
@@ -190,18 +198,21 @@ class Llama(nn.Module):
     def __call__(self, input_ids, *, attention_mask=None, segment_ids=None,
                  position_ids=None, deterministic: bool = True,
                  return_hidden: bool = False,
-                 kv_ctx=None, kv_lens=None, sow_kv: bool = False):
+                 kv_ctx=None, kv_lens=None, sow_kv: bool = False,
+                 kv_pages=None, page_tables=None):
         """``return_hidden=True`` skips the LM head and returns the final
         normed hidden states (fused-CE path, ops.losses) — at Llama vocab
         sizes (32k/128k padded) the [B, T, V] logits this avoids are the
         single largest activation tensor in the step.
 
-        ``kv_ctx``/``kv_lens``/``sow_kv`` are the serving plane's KV-cache
-        hooks — see gpt2.GPT2.__call__; the cache stores n_kv_head heads
-        (GQA) and requires the unrolled block layout."""
+        ``kv_ctx``/``kv_lens``/``sow_kv``/``kv_pages``/``page_tables``
+        are the serving plane's KV-cache hooks — see gpt2.GPT2.__call__;
+        the cache stores n_kv_head heads (GQA) and requires the unrolled
+        block layout."""
         cfg = self.cfg
         B, T = input_ids.shape
-        if (kv_ctx is not None or sow_kv) and cfg.scan_blocks:
+        if (kv_ctx is not None or kv_pages is not None or sow_kv) \
+                and cfg.scan_blocks:
             raise ValueError(
                 "KV-cache generation needs the unrolled block layout; "
                 "rebuild the serving model with scan_blocks=False "
@@ -228,7 +239,7 @@ class Llama(nn.Module):
                 metadata_params={nn.meta.PARTITION_NAME: "layers"})
             x, _ = scan(cfg, name="layers")(x, attention_mask, segment_ids,
                                             position_ids)
-        elif kv_ctx is not None or sow_kv:
+        elif kv_ctx is not None or kv_pages is not None or sow_kv:
             # serving forward: no backward pass, so remat (and sowing
             # through jax.checkpoint, which is undefined) is skipped;
             # param names are identical with or without the wrapper
@@ -236,7 +247,9 @@ class Llama(nn.Module):
                 x = LlamaBlock(cfg, name=f"layer_{i}")(
                     x, attention_mask, segment_ids, position_ids,
                     kv_ctx[i] if kv_ctx is not None else None,
-                    kv_lens, sow_kv)
+                    kv_lens, sow_kv,
+                    kv_pages[i] if kv_pages is not None else None,
+                    page_tables)
         else:
             block = LlamaBlock
             if cfg.remat:

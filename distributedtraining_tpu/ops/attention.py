@@ -219,15 +219,28 @@ def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Same fp32-softmax math as ``dot_product_attention`` — padded keys hit
     the NEG_INF branch, whose exp underflows to exact 0, so garbage in
     dead cache slots cannot leak into the output.
+
+    The mask is an iota compare folded into the score computation, not a
+    materialized buffer: the old spelling concatenated two broadcast
+    ``[B, Tq, S]``/``[B, Tq, Tq]`` boolean arrays into a ``[B, Tq, S+Tq]``
+    mask per decode step — O(B·S) bytes written every token for a
+    predicate XLA can fuse into the ``where`` on the scores for free.
+    Identical mask semantics (pinned in tests/test_paged_attention.py):
+    context positions valid below ``ctx_lens``, the trailing Tq fresh
+    positions causal among themselves and always visible to themselves.
     """
-    B, Tq, _, _ = q.shape
+    B, Tq, _, depth = q.shape
     S = k.shape[1] - Tq
-    ctx_valid = jnp.arange(S)[None, :] < ctx_lens[:, None]          # [B, S]
-    new_mask = jnp.tril(jnp.ones((Tq, Tq), bool))                   # [Tq, Tq]
-    mask = jnp.concatenate(
-        [jnp.broadcast_to(ctx_valid[:, None, :], (B, Tq, S)),
-         jnp.broadcast_to(new_mask[None], (B, Tq, Tq))], axis=-1)
-    return dot_product_attention(q, k, v, mask[:, None, :, :])
+    scale = depth ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    kv_pos = jnp.arange(S + Tq)[None, None, :]                # [1, 1, S+Tq]
+    q_pos = jnp.arange(Tq)[None, :, None]                     # [1, Tq, 1]
+    valid = (kv_pos < ctx_lens[:, None, None]) | (
+        (kv_pos >= S) & (kv_pos - S <= q_pos))                # [B, Tq, S+Tq]
+    scores = jnp.where(valid[:, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
 def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -1,0 +1,159 @@
+"""Fused dequantize->scatter-add kernel for packed wire-v2 entries.
+
+The averager-side hot loop of the v2 wire: folding one packed
+contribution ``{"idx": int32[k], "q": int8|f32[k], "scale": f32}`` into
+a running f32 aggregate is ``acc[idx] += w * q_f32 * scale`` — k useful
+element updates against a buffer of n >> k elements. The XLA spelling
+(``delta._accum_packed``: ``flat.at[idx].add(w * q * scale)``) is
+functionally a full-buffer copy plus a scatter: without guaranteed
+donation XLA rewrites every contribution as "copy n elements, then
+touch k", so ingesting M contributions writes O(M*n) HBM bytes for
+O(M*k) of work — the measured ``delta.accumulate`` cost the device
+observatory attributes (docs/perf.md round 17). This kernel does the
+dequantize (int8 -> f32 times the folded ``w*scale``) and the
+scatter-add in ONE Pallas program whose accumulator is aliased in-place
+(``input_output_aliases``), so bytes written per contribution drop to
+O(k) and the dense intermediate of the densify-then-add spelling never
+exists.
+
+Same discipline as ops/flash_attention.py / ops/paged_attention.py:
+one-time capability probe -> kernel -> XLA fallback, and explicit
+``interpret=`` plumbing so tier-1 pins the kernel math on CPU. Leaves
+whose flat size exceeds the VMEM budget (:data:`MAX_ACC_ELEMS`) ride
+the XLA spelling — correctness identical (duplicate indices SUM in both,
+the ``_accum_packed`` convention; the screened-upstream hostile cases
+keep their semantics because this kernel is only reached AFTER
+``packed_matches`` admission, like every accumulate path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover — pallas-less backend
+    pl = None
+    pltpu = None
+
+# accumulator leaves above this many f32 elements stay on the XLA path:
+# the whole flat buffer must sit in VMEM next to the idx/q/val arrays
+# (~8 MB of the ~16 MB/core budget)
+MAX_ACC_ELEMS = 2 * 1024 * 1024
+
+# test/bench hook: force the interpreter so CPU lanes exercise the
+# KERNEL math instead of the XLA fallback (set via use_interpret)
+_FORCE_INTERPRET = False
+
+
+def use_interpret(on: bool) -> None:
+    """Route :func:`enabled` callers through the interpreter (CPU test
+    and bench lanes). Production never sets this."""
+    global _FORCE_INTERPRET
+    _FORCE_INTERPRET = bool(on)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _scatter_kernel(acc_ref, idx_ref, q_ref, sw_ref, out_ref, val_ref):
+    """acc[idx[j]] += q[j] * sw for j in [0, k). ``acc`` is aliased to
+    ``out`` (true in-place: O(k) bytes written); the dequantize runs
+    once, vectorized, into VMEM scratch; the scatter itself is a serial
+    read-modify-write loop — duplicates SUM, deterministically."""
+    del acc_ref  # aliased: out_ref IS the accumulator
+    val_ref[...] = q_ref[...].astype(jnp.float32) * sw_ref[0]
+    k = idx_ref.shape[0]
+
+    def body(j, _):
+        pos = idx_ref[j]
+        out_ref[pl.ds(pos, 1)] = out_ref[pl.ds(pos, 1)] + val_ref[pl.ds(j, 1)]
+        return 0
+
+    jax.lax.fori_loop(0, k, body, 0)
+
+
+def _build_call(n: int, k: int, q_dtype, interpret: bool):
+    return pl.pallas_call(  # devprof: exempt (attributed under delta.dequant_scatter — the wrapped _accum_packed_kernel program this kernel runs inside)
+        _scatter_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),            # acc
+            pl.BlockSpec(memory_space=pltpu.VMEM),            # idx
+            pl.BlockSpec(memory_space=pltpu.VMEM),            # q
+            pl.BlockSpec(memory_space=pltpu.SMEM),            # sw
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((k,), jnp.float32)],       # dequant val
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )
+
+
+@functools.cache
+def _probe_ok() -> bool:
+    """One-time eager probe at a tiny shape: Mosaic either lowers the
+    dynamic-index RMW loop on this backend or the kernel is declined
+    forever (the paged_attention probe discipline — a lowering failure
+    inside a caller's jit would be uncatchable there)."""
+    if pl is None or not _on_tpu():
+        return False
+    try:
+        out = _build_call(256, 8, jnp.int8, False)(
+            jnp.zeros((256,), jnp.float32),
+            jnp.arange(8, dtype=jnp.int32),
+            jnp.ones((8,), jnp.int8),
+            jnp.ones((1,), jnp.float32))
+        jax.block_until_ready(out)
+        return True
+    except Exception:  # pragma: no cover — hardware-dependent
+        return False
+
+
+def enabled() -> bool:
+    """True when accumulate paths should route packed entries through
+    the kernel (TPU with a passing probe, or the CPU interpreter when a
+    test/bench lane forced it)."""
+    if _FORCE_INTERPRET:
+        return pl is not None
+    return _probe_ok()
+
+
+def dequant_scatter_add(flat: jax.Array, idx: jax.Array, q: jax.Array,
+                        scale_w, *, interpret: bool | None = None
+                        ) -> Optional[jax.Array]:
+    """``flat.at[idx].add(q_f32 * scale_w)`` as one fused in-place
+    kernel, or None to decline (caller uses the XLA spelling).
+
+    ``flat`` f32 [n]; ``idx`` int32 [k]; ``q`` int8 or f32 [k];
+    ``scale_w`` the pre-folded ``weight * scale`` scalar. Indexed-form
+    entries only (dense-form k==n entries are a plain fused add XLA
+    already handles well).
+    """
+    if pl is None:
+        return None
+    if interpret is None:
+        if _FORCE_INTERPRET:
+            interpret = True
+        elif _probe_ok():
+            interpret = False
+        else:
+            return None
+    n, k = flat.shape[0], idx.shape[0]
+    if k == 0 or n > MAX_ACC_ELEMS:
+        return None
+    try:
+        call = _build_call(n, k, q.dtype, interpret)
+        sw = jnp.asarray(scale_w, jnp.float32).reshape(1)
+        return call(flat.astype(jnp.float32), idx.astype(jnp.int32), q, sw)
+    except Exception:
+        return None  # unsupported shape/backend — XLA fallback

@@ -1,0 +1,318 @@
+"""Fused paged-attention decode kernel for TPU.
+
+The serving plane's per-token cost: every decode step attends one fresh
+query per sequence over that sequence's paged KV context. The XLA
+spelling (engine/serve.py before this kernel) gathered every slot's full
+padded context out of the page pool into a dense ``[B, S, Hkv, D]``
+tensor per layer per token — O(B*S) HBM bytes moved to compute an
+output whose useful work is O(sum(seq_lens)) — and then materialized a
+``[B, Tq, S+Tq]`` boolean mask on top. This module deletes both: one
+Pallas kernel walks each slot's page table, DMAs exactly the pages the
+table names from HBM into VMEM, runs the fp32 online-softmax attend
+in-kernel (GQA-aware: pages hold ``Hkv`` heads, queries ``Hq``; no
+``jnp.repeat`` broadcast ever materializes), folds the step's OWN fresh
+(k, v) in as the final context column (they are not in the pool yet —
+the engine scatters them after the forward), and masks dead page slots
+with ``seq_lens``.
+
+Why not ``jax.experimental.pallas.ops.tpu.paged_attention``: the
+library kernel downcasts every loaded K/V block to bfloat16 before the
+QK/PV matmuls (``MultiPageAsyncCopyDescriptor._maybe_dequantize``),
+which breaks this repo's greedy-parity contract (engine outputs pinned
+token-identical to the full-recompute oracle at f32 — docs/serving.md).
+This kernel keeps the pool dtype through the loads and accumulates in
+fp32, so parity vs ``ops.attention.cached_attention`` holds to 1e-6.
+
+Structure follows ops/flash_attention.py's discipline: capability probe
+-> kernel -> XLA fallback (:func:`paged_decode_reference`, which IS the
+pre-kernel math, so CPU tier-1 stays bit-identical), plus explicit
+``interpret=`` plumbing so the kernel's numerics are pinned on CPU in
+tier-1 and on real hardware in tests_tpu/.
+
+Layouts: q / k_new / v_new are ``[B, 1, H(kv), D]`` (decode is one
+token per slot per step); the page pool is one layer's
+``[pages, P, Hkv, D]`` slice; ``page_tables`` is ``[B, MP]`` int32 into
+the pool (padded rows point at trash page 0); ``seq_lens`` ``[B]`` is
+each slot's REAL context length (the fresh token sits at position
+``seq_lens[b]``, always visible to itself).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF, cached_attention
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover — pallas-less backend
+    pl = None
+    pltpu = None
+
+# one decode chunk = this many pages DMA'd + attended per grid step;
+# the (slot, page) buckets ride a power-of-two ladder (engine/serve.py
+# BucketLadder), so any larger MP is divisible and smaller MPs run as
+# a single chunk
+PAGES_PER_CHUNK = 8
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _chunk_pages(mp: int) -> int:
+    """Largest power-of-two divisor of ``mp`` capped at PAGES_PER_CHUNK."""
+    c = 1
+    while c < PAGES_PER_CHUNK and mp % (c * 2) == 0:
+        c *= 2
+    return c
+
+
+def _online_update(s, v, valid, acc_ref, m_ref, l_ref, *,
+                   new_token: bool = False):
+    """Streaming-softmax accumulate: ``acc`` holds the UNNORMALIZED
+    weighted sum (the division by ``l`` happens once, at finalize),
+    ``m``/``l`` the running max / normalizer per (kv head, group row).
+    ``p`` is re-zeroed under the mask so a fully-dead chunk contributes
+    exact zeros — the blockwise_attention convention."""
+    m_prev = m_ref[...][..., :1]                         # [Hkv, G, 1]
+    l_prev = l_ref[...][..., :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(valid, p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if new_token:
+        # p [Hkv, G, 1] x v [Hkv, 1, D] -> outer product per kv head
+        pv = p * v
+    else:
+        # pv[h, g, d] = sum_t p[h, g, t] * v[t, h, d]
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+
+def _decode_kernel(page_tables_ref, seq_lens_ref,   # scalar prefetch
+                   q_ref, k_pages_ref, v_pages_ref, k_new_ref, v_new_ref,
+                   o_ref,
+                   k_buf, v_buf, acc_ref, m_ref, l_ref, sem,
+                   *, pages_per_chunk: int, page_size: int,
+                   n_chunks: int, n_kv_heads: int, group: int,
+                   scale: float):
+    """One (batch row, context chunk) grid step of the fused decode.
+
+    Grid is ``(B, n_chunks + 1)``: the first ``n_chunks`` steps DMA
+    ``pages_per_chunk`` pages of this row's table and fold them into
+    the running online softmax (f32 ``m``/``l``/unnormalized ``acc``
+    persist in VMEM scratch across the sequential grid); the FINAL step
+    appends the fresh (k_new, v_new) column — the token being decoded,
+    not yet in the pool — and writes ``acc / l``. Chunks wholly past
+    ``seq_lens[b]`` skip both the DMA and the math (the bucket-padded
+    tail of a short sequence costs nothing but the grid iteration).
+    """
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    seq_len = seq_lens_ref[b]
+    chunk = pages_per_chunk * page_size
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale             # [Hq, D]
+    qg = q.reshape(n_kv_heads, group, q.shape[-1])       # [Hkv, G, D]
+
+    @pl.when(jnp.logical_and(i < n_chunks, i * chunk < seq_len))
+    def _context_chunk():
+        # gather exactly the pages the table names for this chunk
+        for j in range(pages_per_chunk):
+            page = page_tables_ref[b, i * pages_per_chunk + j]
+            pltpu.make_async_copy(
+                k_pages_ref.at[page], k_buf.at[j], sem.at[0]).start()
+            pltpu.make_async_copy(
+                v_pages_ref.at[page], v_buf.at[j], sem.at[1]).start()
+        for j in range(pages_per_chunk):
+            pltpu.make_async_copy(
+                k_pages_ref.at[0], k_buf.at[j], sem.at[0]).wait()
+            pltpu.make_async_copy(
+                v_pages_ref.at[0], v_buf.at[j], sem.at[1]).wait()
+        k = k_buf[...].astype(jnp.float32).reshape(chunk, n_kv_heads, -1)
+        v = v_buf[...].astype(jnp.float32).reshape(chunk, n_kv_heads, -1)
+        # s[h, g, t] = q[h, g, :] . k[t, h, :]
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)          # [Hkv, G, T]
+        pos = i * chunk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        valid = pos < seq_len                            # dead pages masked
+        s = jnp.where(valid, s, NEG_INF)
+        _online_update(s, v, valid, acc_ref, m_ref, l_ref)
+
+    @pl.when(i == n_chunks)
+    def _append_fresh_and_finalize():
+        kn = k_new_ref[0].astype(jnp.float32)            # [Hkv, D]
+        vn = v_new_ref[0].astype(jnp.float32)
+        s = jnp.einsum("hgd,hd->hg", qg, kn,
+                       preferred_element_type=jnp.float32)[..., None]
+        valid = jnp.ones(s.shape, dtype=jnp.bool_)
+        _online_update(s, vn[:, None, :], valid, acc_ref, m_ref, l_ref,
+                       new_token=True)
+        l = l_ref[...][..., :1]                          # l >= exp(0) > 0
+        o = acc_ref[...] / l
+        o_ref[0] = o.reshape(o_ref.shape[1:]).astype(o_ref.dtype)
+
+
+def _build_call(B, Hq, Hkv, D, P, MP, q_dtype, page_dtype,
+                interpret: bool):
+    """Construct the pallas_call for one shape signature."""
+    group = Hq // Hkv
+    ppc = _chunk_pages(MP)
+    n_chunks = MP // ppc
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,      # page_tables, seq_lens
+        grid=(B, n_chunks + 1),
+        in_specs=[
+            pl.BlockSpec((1, Hq, D), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),     # k_pages (HBM)
+            pl.BlockSpec(memory_space=pltpu.ANY),     # v_pages (HBM)
+            pl.BlockSpec((1, Hkv, D), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, Hkv, D), lambda b, i, *_: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, i, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((ppc, P, Hkv, D), page_dtype),     # k chunk
+            pltpu.VMEM((ppc, P, Hkv, D), page_dtype),     # v chunk
+            pltpu.VMEM((Hkv, group, D), jnp.float32),     # acc
+            pltpu.VMEM((Hkv, group, 128), jnp.float32),   # running max
+            pltpu.VMEM((Hkv, group, 128), jnp.float32),   # running sum
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, pages_per_chunk=ppc, page_size=P,
+        n_chunks=n_chunks, n_kv_heads=Hkv, group=group,
+        scale=D ** -0.5)
+    return pl.pallas_call(  # devprof: exempt (attributed under serve.decode in-step; standalone A/Bs wrap it as serve.decode_attn in bench._time_decode_attn_kernel)
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q_dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )
+
+
+@functools.cache
+def _probe_ok() -> bool:
+    """One-time capability probe: compile+run the kernel EAGERLY at a
+    tiny representative shape on this backend. A Mosaic lowering failure
+    inside a caller's jit would surface at the OUTER compile — past any
+    try/except around the traced call (the flash_attention caveat) — so
+    the decision to use the kernel at all is made here, once, where the
+    failure is catchable. False = decline forever, XLA fallback."""
+    if pl is None or not _on_tpu():
+        return False
+    try:
+        B, Hq, Hkv, D, P, MP = 1, 2, 1, 64, 8, 1
+        z = jnp.zeros((B, 1, Hq, D), jnp.float32)
+        zp = jnp.zeros((3, P, Hkv, D), jnp.float32)
+        zn = jnp.zeros((B, 1, Hkv, D), jnp.float32)
+        call = _build_call(B, Hq, Hkv, D, P, MP, z.dtype, zp.dtype, False)
+        out = call(jnp.ones((B, MP), jnp.int32), jnp.ones((B,), jnp.int32),
+                   z[:, 0], zp, zp, zn[:, 0], zn[:, 0])
+        jax.block_until_ready(out)
+        return True
+    except Exception:  # pragma: no cover — hardware-dependent
+        return False
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_tables: jax.Array,
+                           seq_lens: jax.Array, k_new: jax.Array,
+                           v_new: jax.Array, *,
+                           interpret: bool | None = None
+                           ) -> Optional[jax.Array]:
+    """The fused kernel, or None to decline (caller falls back).
+
+    q/k_new/v_new: ``[B, 1, Hq/Hkv/Hkv, D]``; k_pages/v_pages: one
+    layer's ``[pages, P, Hkv, D]`` pool; page_tables ``[B, MP]`` int32;
+    seq_lens ``[B]`` int32. Returns ``[B, 1, Hq, D]``.
+
+    ``interpret=None`` declines off-TPU (tier-1 CPU rides the XLA
+    fallback); ``interpret=True`` forces the interpreter so the KERNEL
+    math is pinned on CPU (tests, bench's degraded A/B).
+    """
+    if pl is None:
+        return None
+    if interpret is None:
+        if not _probe_ok():
+            return None
+        interpret = False
+    B, Tq, Hq, D = q.shape
+    if Tq != 1:
+        return None                  # decode is one token per step
+    pool, P, Hkv, Dk = k_pages.shape
+    if Dk != D or Hq % Hkv:
+        return None
+    MP = page_tables.shape[1]
+    try:
+        call = _build_call(B, Hq, Hkv, D, P, MP, q.dtype, k_pages.dtype,
+                           interpret)
+        out = call(page_tables.astype(jnp.int32),
+                   seq_lens.astype(jnp.int32),
+                   q[:, 0], k_pages, v_pages, k_new[:, 0], v_new[:, 0])
+    except Exception:
+        return None                  # unsupported shape/backend
+    return out[:, None].astype(q.dtype)
+
+
+def paged_decode_reference(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_tables: jax.Array,
+                           seq_lens: jax.Array, k_new: jax.Array,
+                           v_new: jax.Array) -> jax.Array:
+    """The XLA spelling the kernel replaces — gather the table's pages
+    into a padded context, append the fresh column, broadcast GQA heads,
+    and run :func:`ops.attention.cached_attention` (whose context-length
+    mask is an iota compare fused into the scores, not a materialized
+    boolean buffer). This is the production CPU path AND the parity
+    oracle the kernel is pinned against."""
+    B, Tq, Hq, D = q.shape
+    pool, P, Hkv, _ = k_pages.shape
+    MP = page_tables.shape[1]
+    k_ctx = k_pages[page_tables].reshape(B, MP * P, Hkv, D)
+    v_ctx = v_pages[page_tables].reshape(B, MP * P, Hkv, D)
+    k_full = jnp.concatenate([k_ctx, k_new], axis=1)
+    v_full = jnp.concatenate([v_ctx, v_new], axis=1)
+    if Hkv != Hq:
+        rep = Hq // Hkv
+        k_full = jnp.repeat(k_full, rep, axis=2)
+        v_full = jnp.repeat(v_full, rep, axis=2)
+    return cached_attention(q, k_full, v_full, seq_lens)
+
+
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    page_tables: jax.Array, seq_lens: jax.Array,
+                    k_new: jax.Array, v_new: jax.Array) -> jax.Array:
+    """Model-facing entry (gpt2/llama decode blocks): the kernel when
+    the backend supports it, the XLA reference otherwise — identical
+    numerics either way (parity pinned in tests/test_paged_attention.py
+    and tests_tpu/test_paged_attention_tpu.py)."""
+    out = paged_decode_attention(q, k_pages, v_pages, page_tables,
+                                 seq_lens, k_new, v_new)
+    if out is not None:
+        return out
+    return paged_decode_reference(q, k_pages, v_pages, page_tables,
+                                  seq_lens, k_new, v_new)

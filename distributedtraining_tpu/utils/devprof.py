@@ -89,10 +89,16 @@ PROGRAMS: dict[str, str] = {
     "delta.screen": "fused dense cohort screen (delta.py)",
     "delta.screen_packed": "fused packed-wire cohort screen (delta.py)",
     "delta.accumulate": "scatter-add delta accumulation (delta.py)",
+    "delta.dequant_scatter": "fused dequant->scatter-add packed "
+                             "accumulate via the Pallas kernel "
+                             "(delta.py / ops/dequant_scatter.py)",
     "delta.densify": "host densify of packed wire entries (delta.py)",
     "serve.prefill": "per-T-bucket prefill program (engine/serve.py)",
     "serve.decode": "per-(slot,page)-bucket decode step "
                     "(engine/serve.py)",
+    "serve.decode_attn": "standalone fused paged-attention decode "
+                         "program (ops/paged_attention.py; the in-step "
+                         "copy is attributed under serve.decode)",
 }
 
 

@@ -265,6 +265,7 @@ def format_table(rep: dict) -> str:
     interesting = ("miner.step_ms.p50", "miner.data_wait_ms.p50",
                    "compile.ms.count", "compile.ms.p95",
                    "ingest.cache_hits", "ingest.cache_misses",
+                   "delta.densify_fallbacks",
                    "health.beats", "fleet.heartbeats",
                    "device.mem_peak_bytes",
                    "serve.tokens", "serve.tokens_per_sec",

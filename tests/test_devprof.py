@@ -255,13 +255,16 @@ def test_anatomy_fields_join_step_and_device():
 # The tier-1 registration lint (flight.EVENT_KINDS discipline, source level)
 # ---------------------------------------------------------------------------
 
-# the five hot-path modules the observatory must cover
+# the hot-path modules the observatory must cover (the round-20 kernel
+# modules included: a Pallas hot path must not ship unobserved either)
 _HOT_MODULES = (
     "distributedtraining_tpu/engine/train.py",
     "distributedtraining_tpu/engine/batched_eval.py",
     "distributedtraining_tpu/parallel/collectives.py",
     "distributedtraining_tpu/delta.py",
     "distributedtraining_tpu/engine/serve.py",
+    "distributedtraining_tpu/ops/paged_attention.py",
+    "distributedtraining_tpu/ops/dequant_scatter.py",
 )
 
 
@@ -270,10 +273,11 @@ def _repo_root() -> str:
 
 
 def test_every_jit_in_hot_modules_is_registered_or_exempt():
-    """Every ``jax.jit(...)`` call in the five hot-path modules must be
-    wrapped in ``devprof.wrap(...)`` (so it reports cost/exec under a
-    closed-vocabulary name) or carry a ``# devprof: exempt(<reason>)``
-    comment on the jit line — a new hot path cannot ship unobserved."""
+    """Every ``jax.jit(...)`` AND ``pl.pallas_call(...)`` call in the
+    hot-path modules must be wrapped in ``devprof.wrap(...)`` (so it
+    reports cost/exec under a closed-vocabulary name) or carry a
+    ``# devprof: exempt(<reason>)`` comment on the call line — a new
+    hot path (XLA or Pallas) cannot ship unobserved."""
     import ast
 
     for rel in _HOT_MODULES:
@@ -289,9 +293,11 @@ def test_every_jit_in_hot_modules_is_registered_or_exempt():
         for node in ast.walk(tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "jit"
                     and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "jax"):
+                    and ((node.func.attr == "jit"
+                          and node.func.value.id == "jax")
+                         or (node.func.attr == "pallas_call"
+                             and node.func.value.id == "pl"))):
                 continue
             # wrapped: some ancestor is a devprof.wrap(...) call
             wrapped = False
@@ -311,8 +317,8 @@ def test_every_jit_in_hot_modules_is_registered_or_exempt():
                 continue
             offenders.append(f"{rel}:{node.lineno}")
         assert not offenders, (
-            f"jax.jit sites neither devprof.wrap()-registered nor "
-            f"'# devprof: exempt'-annotated: {offenders}")
+            f"jax.jit/pl.pallas_call sites neither devprof.wrap()-"
+            f"registered nor '# devprof: exempt'-annotated: {offenders}")
 
 
 def test_every_wrap_name_in_hot_modules_is_in_vocabulary():
@@ -327,7 +333,8 @@ def test_every_wrap_name_in_hot_modules_is_in_vocabulary():
     assert not unknown, f"names outside devprof.PROGRAMS: {unknown}"
     # and the engine hot paths the ISSUE names are all present
     assert {"train.step", "eval.cohort", "merge.sharded", "delta.screen",
-            "delta.densify", "serve.prefill", "serve.decode"} <= names
+            "delta.densify", "serve.prefill", "serve.decode",
+            "delta.dequant_scatter"} <= names
 
 
 # ---------------------------------------------------------------------------

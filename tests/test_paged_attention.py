@@ -1,0 +1,208 @@
+"""Fused paged-attention decode kernel (ops/paged_attention.py).
+
+The correctness spine of round 20's serving half: the Pallas kernel
+(run INTERPRETED here — tier-1 forces the CPU platform; the real-chip
+variants live in tests_tpu/test_paged_attention_tpu.py) must match the
+XLA gather+attend reference to 1e-6 at every shape class the engine
+produces — GQA llama heads, ragged ``seq_lens``, page-boundary lengths,
+trash-page-0 padded lanes — and the reference itself must match the
+pre-kernel ``cached_attention`` spelling exactly, so the engine-level
+greedy-parity pins (tests/test_serve.py) transfer to the kernel path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu.ops import paged_attention as pa
+from distributedtraining_tpu.ops.attention import cached_attention
+
+
+def _case(B, Hq, Hkv, D, P, MP, lens, *, pool=None, seed=0,
+          tables=None):
+    rng = np.random.default_rng(seed)
+    pool = pool or (1 + B * MP)
+    q = jnp.asarray(rng.standard_normal((B, 1, Hq, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((pool, P, Hkv, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((pool, P, Hkv, D)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, 1, Hkv, D)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, 1, Hkv, D)), jnp.float32)
+    if tables is None:
+        tables = rng.integers(1, pool, (B, MP))
+    pt = jnp.asarray(tables, jnp.int32)
+    sl = jnp.asarray(lens, jnp.int32)
+    return q, kp, vp, pt, sl, kn, vn
+
+
+def _parity(args, atol=1e-6):
+    out = pa.paged_decode_attention(*args, interpret=True)
+    assert out is not None, "kernel declined a supported shape"
+    ref = pa.paged_decode_reference(*args)
+    err = float(jnp.max(jnp.abs(out - ref)))
+    assert err < atol, f"kernel/reference divergence {err}"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Kernel vs reference (interpret mode)
+# ---------------------------------------------------------------------------
+
+def test_kernel_matches_reference_gqa_ragged():
+    """GQA llama heads (Hq=8 over Hkv=2) with ragged per-slot lengths —
+    the llama serving shape class."""
+    _parity(_case(3, 8, 2, 64, 8, 4, [13, 27, 5]))
+
+
+def test_kernel_matches_reference_mha():
+    """GPT-2 heads: Hkv == Hq (group size 1)."""
+    _parity(_case(2, 4, 4, 32, 8, 4, [30, 2]))
+
+
+def test_kernel_matches_reference_page_boundary_lengths():
+    """Lengths at exact page multiples (0, P, MP*P-1): the mask edge
+    sits on a DMA chunk edge; off-by-one here reads a dead page."""
+    _parity(_case(4, 4, 2, 64, 8, 4, [0, 8, 16, 31]))
+
+
+def test_kernel_matches_reference_multi_chunk():
+    """MP > PAGES_PER_CHUNK: the online softmax crosses chunk
+    boundaries (the grid's streaming dimension actually streams)."""
+    assert 16 > pa.PAGES_PER_CHUNK
+    _parity(_case(2, 4, 2, 64, 8, 16, [127, 64]))
+
+
+def test_trash_page_zero_lanes():
+    """Padded batch lanes: table all-zeros (the trash page), seq_len 0.
+    The lane's output must be attention over ONLY its fresh token —
+    trash-page garbage must not leak (the engine's dead-lane
+    contract)."""
+    q, kp, vp, pt, sl, kn, vn = _case(2, 4, 2, 64, 8, 4, [0, 0])
+    # poison the trash page to make leakage loud
+    kp = kp.at[0].set(1e3)
+    vp = vp.at[0].set(1e3)
+    pt = jnp.zeros_like(pt)
+    out = _parity((q, kp, vp, pt, sl, kn, vn))
+    # seq_len 0: softmax over the single fresh column = exactly v_new
+    vn_heads = jnp.repeat(vn, 2, axis=2)     # broadcast kv -> q heads
+    np.testing.assert_allclose(np.asarray(out), np.asarray(vn_heads),
+                               atol=1e-6)
+
+
+def test_kernel_under_jit():
+    """The engine calls through jit: trace-time decline/accept must be
+    stable and the jitted output identical to eager."""
+    args = _case(2, 4, 2, 64, 8, 4, [13, 27])
+    eager = pa.paged_decode_attention(*args, interpret=True)
+    jitted = jax.jit(
+        lambda *a: pa.paged_decode_attention(*a, interpret=True))(*args)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                               atol=1e-6)
+
+
+def test_kernel_declines_cleanly():
+    """Off-TPU with no interpret override the kernel declines (tier-1
+    production path is the XLA reference); multi-token queries decline
+    everywhere (decode is one token per step)."""
+    args = _case(2, 4, 2, 64, 8, 4, [13, 27])
+    assert pa.paged_decode_attention(*args) is None      # CPU backend
+    q, kp, vp, pt, sl, kn, vn = args
+    q3 = jnp.concatenate([q, q, q], axis=1)
+    assert pa.paged_decode_attention(q3, kp, vp, pt, sl, kn, vn,
+                                     interpret=True) is None
+
+
+# ---------------------------------------------------------------------------
+# The reference vs the pre-kernel spelling (satellite: folded mask)
+# ---------------------------------------------------------------------------
+
+def _cached_attention_materialized_mask(q, k, v, ctx_lens):
+    """The pre-round-20 cached_attention spelling: concatenated
+    broadcast boolean mask + dot_product_attention — kept here as the
+    oracle that the folded-iota rewrite changed no semantics."""
+    from distributedtraining_tpu.ops.attention import \
+        dot_product_attention
+    B, Tq, _, _ = q.shape
+    S = k.shape[1] - Tq
+    ctx_valid = jnp.arange(S)[None, :] < ctx_lens[:, None]
+    new_mask = jnp.tril(jnp.ones((Tq, Tq), bool))
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(ctx_valid[:, None, :], (B, Tq, S)),
+         jnp.broadcast_to(new_mask[None], (B, Tq, Tq))], axis=-1)
+    return dot_product_attention(q, k, v, mask[:, None, :, :])
+
+
+@pytest.mark.parametrize("Tq", [1, 3])
+def test_cached_attention_folded_mask_matches_old_spelling(Tq):
+    """The iota-compare mask fold is bit-for-bit the old concatenated
+    mask: context valid below ctx_lens (0 and S included), trailing Tq
+    causal among themselves and self-visible."""
+    rng = np.random.default_rng(0)
+    B, S, H, D = 3, 24, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, Tq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S + Tq, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S + Tq, H, D)), jnp.float32)
+    ctx_lens = jnp.asarray([0, 7, S], jnp.int32)
+    new = cached_attention(q, k, v, ctx_lens)
+    old = _cached_attention_materialized_mask(q, k, v, ctx_lens)
+    np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+
+def test_cached_attention_hlo_has_no_mask_concatenate():
+    """The satellite's actual claim: the decode mask no longer exists
+    as a concatenated broadcast buffer — no concatenate op over the
+    mask shape in the lowered HLO (the k/v inputs still concatenate in
+    the CALLER, not here)."""
+    B, Tq, S, H, D = 4, 1, 64, 2, 16
+    q = jnp.zeros((B, Tq, H, D), jnp.float32)
+    k = jnp.zeros((B, S + Tq, H, D), jnp.float32)
+    v = jnp.zeros((B, S + Tq, H, D), jnp.float32)
+    lens = jnp.zeros((B,), jnp.int32)
+    hlo = jax.jit(cached_attention).lower(q, k, v, lens).as_text()
+    assert f"pred[{B},{Tq},{S + Tq}]" not in hlo
+
+
+# ---------------------------------------------------------------------------
+# Model wiring: the paged path is the gathered path, relocated
+# ---------------------------------------------------------------------------
+
+def test_model_kv_pages_matches_kv_ctx_gpt2():
+    """One gpt2 decode step via the NEW kv_pages hook vs the legacy
+    pre-gathered kv_ctx hook: same logits, same sown (k, v) — paging
+    through the model is a memory-layout change, not a math change."""
+    from distributedtraining_tpu.models import gpt2
+    model, cfg = gpt2.make_model(gpt2.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=2, n_head=2,
+        dtype="float32", vocab_multiple=64))
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=8)
+    L, P, MP, B = cfg.n_layer, 8, 2, 2
+    pool = 1 + B * MP
+    rng = np.random.default_rng(1)
+    kp = jnp.asarray(rng.standard_normal(
+        (L, pool, P, cfg.n_head, cfg.head_dim)) * 0.1, jnp.float32)
+    vp = jnp.asarray(rng.standard_normal(
+        (L, pool, P, cfg.n_head, cfg.head_dim)) * 0.1, jnp.float32)
+    tables = jnp.asarray(1 + np.arange(B * MP).reshape(B, MP), jnp.int32)
+    seq_lens = jnp.asarray([5, 11], jnp.int32)
+    tokens = jnp.asarray([[3], [7]], jnp.int32)
+
+    paged, muts_p = model.apply(
+        {"params": params}, tokens, position_ids=seq_lens[:, None],
+        kv_pages=tuple((kp[i], vp[i]) for i in range(L)),
+        page_tables=tables, kv_lens=seq_lens,
+        sow_kv=True, mutable=["intermediates"])
+    k_ctx = kp[:, tables].reshape(L, B, MP * P, cfg.n_head, cfg.head_dim)
+    v_ctx = vp[:, tables].reshape(L, B, MP * P, cfg.n_head, cfg.head_dim)
+    gathered, muts_g = model.apply(
+        {"params": params}, tokens, position_ids=seq_lens[:, None],
+        kv_ctx=tuple((k_ctx[i], v_ctx[i]) for i in range(L)),
+        kv_lens=seq_lens, sow_kv=True, mutable=["intermediates"])
+    np.testing.assert_allclose(np.asarray(paged), np.asarray(gathered),
+                               atol=1e-6)
+    for name in muts_p["intermediates"]:
+        kp_s, vp_s = muts_p["intermediates"][name]["kv_cache"][0]
+        kg_s, vg_s = muts_g["intermediates"][name]["kv_cache"][0]
+        np.testing.assert_allclose(np.asarray(kp_s), np.asarray(kg_s),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vp_s), np.asarray(vg_s),
+                                   atol=1e-6)

@@ -1,0 +1,60 @@
+"""Dequant->scatter-add kernel numerics on the real chip.
+
+The on-device half of tests/test_dequant_scatter.py: the real Mosaic
+lowering of the in-place RMW scatter loop (VMEM-resident accumulator,
+``input_output_aliases``) against the XLA scatter-add, and the
+kernel-routed ``accumulate_delta`` against the densify reference. If
+the probe declines here, ingest silently rides the XLA spelling — that
+is a supported degrade, but this lane makes it LOUD.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedtraining_tpu import delta as dl
+from distributedtraining_tpu.ops import dequant_scatter as dsc
+
+
+def test_probe_decision_is_explicit():
+    """Surface the probe verdict: xfail (not silent-pass) when Mosaic
+    declines the scatter kernel on this chip/toolchain."""
+    if not dsc._probe_ok():
+        pytest.xfail("dequant-scatter kernel probe declined on this "
+                     "TPU toolchain — ingest rides the XLA fallback")
+
+
+def test_kernel_matches_xla_on_chip():
+    if not dsc.enabled():
+        pytest.skip("kernel probe declined")
+    rng = np.random.default_rng(0)
+    n, k = 1 << 16, 1024
+    flat = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, n, k), jnp.int32)
+    for q in (jnp.asarray(rng.integers(-127, 128, k), jnp.int8),
+              jnp.asarray(rng.standard_normal(k), jnp.float32)):
+        out = dsc.dequant_scatter_add(flat, idx, q, 0.37)
+        assert out is not None
+        ref = flat.at[idx].add(q.astype(jnp.float32) * 0.37)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+
+def test_accumulate_delta_kernel_route_on_chip():
+    if not dsc.enabled():
+        pytest.skip("kernel probe declined")
+    rng = np.random.default_rng(1)
+    d = {"w": jnp.asarray(rng.standard_normal((512, 256)), jnp.float32),
+         "b": jnp.asarray(rng.standard_normal((64,)), jnp.float32)}
+    template = jax.tree_util.tree_map(
+        lambda x: np.zeros(np.shape(x), np.float32), d)
+    packed, _ = dl.pack_delta_v2(d, density=1.0 / 32.0)
+    acc0 = jax.tree_util.tree_map(
+        lambda x: jnp.zeros(np.shape(x), jnp.float32), template)
+    got = dl.accumulate_delta(acc0, packed, 0.7)   # kernel route on TPU
+    dense = dl.densify_packed_v2(packed, template)
+    ref = dl.accumulate_delta(acc0, dense, 0.7)
+    for k_ in d:
+        np.testing.assert_allclose(np.asarray(got[k_]),
+                                   np.asarray(ref[k_]), atol=1e-6)
