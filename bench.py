@@ -1037,6 +1037,58 @@ def _time_serve(*, n_requests: int = 8, prompt_len: int = 16,
         swap_ms = reg.histogram("serve.swap_stall_ms").percentiles(
             (95.0,))["p95"]
         engine.close()
+
+        # sampled-decode lane (round-16): a mixed greedy/sampled batch
+        # through the sampled program family, run TWICE — wave 2 must
+        # add zero fresh compiles (the (slot,page) ladder is shared and
+        # temperature rides as data, not as a program variant), greedy
+        # lanes must still match the oracle, and the sampled lanes must
+        # be bit-identical across waves (seeded per-request PRNG)
+        def mixed_run(eng):
+            reqs = [eng.submit(p, gen_tokens) if i % 2 == 0 else
+                    eng.submit(p, gen_tokens, temperature=0.8,
+                               top_p=0.95, seed=17 + i)
+                    for i, p in enumerate(prompts)]
+            while not all(r.done_evt.is_set() for r in reqs):
+                eng.step()
+            return [list(r.tokens) for r in reqs]
+
+        s_eng = GenerationEngine(model, params, revision="r1",
+                                 max_slots=n_requests, page_size=16,
+                                 max_seq_len=((T + 15) // 16) * 16)
+        wave1 = mixed_run(s_eng)                 # warm the sampled family
+        before = reg.histogram("compile.ms").count
+        wave2 = mixed_run(s_eng)
+        sampled_fresh = reg.histogram("compile.ms").count - before
+        s_eng.close()
+        sampled_greedy_parity = all(wave1[i] == ref[i]
+                                    for i in range(0, n_requests, 2))
+
+        # warm-prefix lane (round-16): every request shares a system
+        # prompt two pages long; request 1 prefills it cold, the rest
+        # reuse the cached pages (suffix-only prefill). Parity-pinned
+        # against a cache-off engine over the same prompts.
+        sys_prompt = list(rng.randint(0, cfg.vocab_size, size=32))
+        tails = [list(rng.randint(0, cfg.vocab_size, size=8))
+                 for _ in range(n_requests)]
+        pfx_prompts = [sys_prompt + t for t in tails]
+        pfx_T = len(sys_prompt) + 8 + gen_tokens   # own geometry: the
+        pfx_seq = ((pfx_T + 15) // 16) * 16        # shared prompt is
+        plain = GenerationEngine(model, params, max_slots=n_requests,
+                                 page_size=16,     # longer than the A/B's
+                                 max_seq_len=pfx_seq)
+        pfx_ref = plain.generate(pfx_prompts, gen_tokens)
+        plain.close()
+        pfx_eng = GenerationEngine(model, params, max_slots=n_requests,
+                                   page_size=16, prefix_cache=True,
+                                   max_seq_len=pfx_seq)
+        cold = pfx_eng.generate(pfx_prompts[:1], gen_tokens)   # seeds cache
+        warm = pfx_eng.generate(pfx_prompts[1:], gen_tokens)
+        pfx_parity = (cold + warm) == pfx_ref
+        pfx_hit_rate = pfx_eng.prefix_hit_rate
+        pfx_saved = pfx_eng.prefix_tokens_saved
+        pfx_eng.close()
+
         # the decode-attention kernel-vs-XLA micro A/B rides in the serve
         # record (round-20 tentpole): the engine-level numbers above
         # already RUN the kernel on TPU — this isolates its contribution
@@ -1057,6 +1109,12 @@ def _time_serve(*, n_requests: int = 8, prompt_len: int = 16,
             "serve_swap_under_step_p95": bool(swap_ms < step_p["p95"]),
             "serve_steady_fresh_compiles": int(fresh_compiles),
             "serve_parity": True,
+            "serve_sampled_steady_fresh_compiles": int(sampled_fresh),
+            "serve_sampled_deterministic": bool(wave1 == wave2),
+            "serve_sampled_greedy_parity": bool(sampled_greedy_parity),
+            "serve_prefix_hit_rate": round(pfx_hit_rate, 3),
+            "serve_prefill_tokens_saved": int(pfx_saved),
+            "serve_prefix_parity": bool(pfx_parity),
         }
     finally:
         obs.reset()

@@ -127,6 +127,8 @@ class RunConfig:
     serve_kv_pages: int = 0                  # page-pool size (0 = auto)
     serve_max_new: int = 64                  # default max_new_tokens
     serve_max_seq: int = 0                   # cache len cap (0 = model max)
+    serve_max_queue: int = 0                 # shed past this depth (0 = off)
+    serve_prefix_cache: bool = True          # shared-prefix KV page reuse
     swap_policy: str = "drain"               # drain | restart
     swap_poll: float = 15.0                  # base-revision poll (seconds)
 
@@ -591,6 +593,19 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                        help="cache capacity per sequence in tokens "
                             "(0 = the model's position cap; rounded "
                             "down to a page multiple)")
+        g.add_argument("--max-queue", dest="serve_max_queue", type=int,
+                       default=d.serve_max_queue,
+                       help="admission bound: past this queue depth the "
+                            "HTTP frontend sheds with 429 + Retry-After "
+                            "instead of queueing into the latency knee "
+                            "(0 = queue without bound)")
+        g.add_argument("--no-prefix-cache", dest="serve_prefix_cache",
+                       action="store_false",
+                       default=d.serve_prefix_cache,
+                       help="disable shared-prefix KV page reuse "
+                            "(refcounted pages + copy-on-write; on by "
+                            "default — common system prompts prefill "
+                            "once per server, not once per request)")
         g.add_argument("--swap-policy", dest="swap_policy",
                        choices=("drain", "restart"),
                        default=d.swap_policy,

@@ -1440,6 +1440,11 @@ DEFAULT_GATES = {
     "quality_drift_breaches_max": 0,
     "serve_min_load_points": 3,
     "serve_ttft_p99_budget_ms": 400.0,   # at the LOWEST offered rate
+    # routed load phase (--router-servers): admitted-request ttft p99
+    # at the BASELINE's knee rate (its highest common rate) must beat
+    # the single-server baseline by at least this factor — the
+    # FLEETSIM_r01 collapse curve is the regression test
+    "router_knee_ttft_gain_min": 2.0,
     # baseline-relative regression caps (only applied with --baseline)
     "baseline_parity_ratio_max": 1.5,
     "baseline_pr_drop_max": 0.05,
@@ -1714,6 +1719,10 @@ def evaluate_gates(card: dict, *, gates: dict | None = None,
             "lowest_rate_ttft_p99_ms": p99,
             "budget_ms": g["serve_ttft_p99_budget_ms"],
         }
+        if any(p.get("router") for p in pts):
+            out["serving"]["router"] = True
+            out["serving"]["shed_total"] = int(
+                sum(p.get("shed", 0) for p in pts))
     if baseline is not None:
         out["baseline"] = _baseline_gate(card, baseline, g)
     return out
@@ -1752,7 +1761,9 @@ def _baseline_gate(card: dict, baseline: dict, g: dict) -> dict:
                "base_fetch_bytes_per_round")
     cur_pts = {p["rate_rps"]: p
                for p in card.get("serving", {}).get("load_points", ())}
-    for p in baseline.get("serving", {}).get("load_points", ()):
+    base_pts = {p["rate_rps"]: p
+                for p in baseline.get("serving", {}).get("load_points", ())}
+    for p in base_pts.values():
         cp = cur_pts.get(p["rate_rps"])
         if cp is None:
             continue
@@ -1760,7 +1771,33 @@ def _baseline_gate(card: dict, baseline: dict, g: dict) -> dict:
                p.get("ttft_ms", {}).get("p99", 0.0),
                g["baseline_ttft_p99_ratio_max"],
                f"ttft p99 @ {p['rate_rps']} rps")
-    return {"ok": not problems, "problems": problems}
+    out = {"ok": not problems, "problems": problems}
+    gain_min = g.get("router_knee_ttft_gain_min", 0.0)
+    common = [r for r, p in cur_pts.items()
+              if p.get("router") and r in base_pts]
+    if common and gain_min > 0:
+        # the knee is the baseline's WORST measured point — its highest
+        # rate the routed run also offered; the routed admitted-only
+        # p99 there must beat the single-server collapse by gain_min×
+        knee = max(common)
+        cur_p99 = cur_pts[knee].get("ttft_ms", {}).get("p99", float("inf"))
+        base_p99 = base_pts[knee].get("ttft_ms", {}).get("p99", 0.0)
+        gain = base_p99 / max(cur_p99, 1e-9) if base_p99 else 0.0
+        out["router_knee"] = {
+            "rate_rps": knee,
+            "baseline_ttft_p99_ms": base_p99,
+            "routed_ttft_p99_ms": cur_p99,
+            "gain": round(gain, 3),
+            "gain_min": gain_min,
+            "shed": int(cur_pts[knee].get("shed", 0)),
+        }
+        if gain < gain_min:
+            problems.append(
+                f"router knee ttft p99 gain {gain:.2f}x @ {knee} rps "
+                f"< required {gain_min:g}x (baseline {base_p99:.1f}ms, "
+                f"routed {cur_p99:.1f}ms)")
+            out["ok"] = False
+    return out
 
 
 def scorecard_id(card: dict) -> str:

@@ -49,6 +49,32 @@ Design, in the order it matters on TPU:
   in-flight prompts on the new revision. A torn or failed revision fetch
   degrades to the current base — the batch never stalls on the Hub.
 
+Round 16 adds the under-load story on top (docs/serving.md):
+
+- **Sampled decode.** Per-request ``temperature`` / ``top_p`` / ``seed``
+  ride the SAME paged-KV programs and (slot, page) bucket ladder as
+  greedy decode: an all-greedy batch dispatches the original
+  ``serve.decode`` program (the parity-pinned path, byte-identical to
+  before), any sampled lane switches the whole batch to
+  ``serve.decode_sample`` — greedy lanes inside it still argmax. PRNG
+  keys are derived IN-JIT as ``fold_in(PRNGKey(seed), token_index)``,
+  so a request's stream depends only on (seed, position), never on
+  batch layout — bit-identical across runs and across greedy/sampled
+  mixes.
+- **Prefix-cache page sharing.** Prompt pages are content-hashed at
+  page granularity into a refcounted index (:class:`PrefixCache` over
+  :class:`PagePool`): a repeated system prompt costs ONE prefill
+  fleet-wide; later requests map the cached pages read-only, suffix-
+  prefill only their divergent tail (``serve.prefill_ctx``), and
+  copy-on-write the first diverging page before any scatter lands in
+  shared memory. Pages free only at refcount 0; eviction is LRU over
+  cache-only pages, tried before preemption.
+- **Admission control.** ``max_queue`` bounds the queue; the HTTP
+  frontend sheds with 429 + ``Retry-After`` at the bound and 503 while
+  a drain-policy swap is in flight — open-loop overload is refused
+  BEFORE the queueing knee instead of manufacturing ttft collapse
+  (engine/router.py spreads and sheds across N such servers).
+
 Everything is exposed through the PR-3 obs registry as ``serve.*`` and
 scraped by the PR-5 exporter as ``dt_serve_*`` gauges.
 """
@@ -56,9 +82,11 @@ scraped by the PR-5 exporter as ``dt_serve_*`` gauges.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 import json
 import logging
+import os
 import re
 import threading
 import time
@@ -104,6 +132,9 @@ class ServeRequest:
     the drain policy; the post-restart revision under restart)."""
     prompt: list
     max_new_tokens: int
+    temperature: float = 0.0    # 0 = greedy (the parity-pinned path)
+    top_p: float = 1.0          # nucleus mass; 1.0 = full distribution
+    seed: int = 0               # per-request PRNG stream root
     rid: int = dataclasses.field(default_factory=lambda: next(_RID))
     tokens: list = dataclasses.field(default_factory=list)
     status: str = "queued"      # queued | active | done | truncated
@@ -173,6 +204,235 @@ class BucketLadder:
         fresh = b not in self.seen
         self.seen.add(b)
         return fresh
+
+
+# ---------------------------------------------------------------------------
+# Refcounted page pool + content-addressed prefix cache
+# ---------------------------------------------------------------------------
+
+class PagePool:
+    """Refcounted page accounting over pool indices ``1..pool_pages-1``
+    (page 0 is the trash page and is never allocated). Every owner of a
+    page — an active slot's page table, or a :class:`PrefixCache`
+    entry — holds exactly one reference; a page returns to the free
+    list only when its refcount reaches 0, so shared prompt pages
+    survive the slots that mapped them. ``check`` is the debug-flag
+    invariant the accounting contract rests on: free pages + referenced
+    pages == total, and the refcounts exactly match the owners the
+    engine can enumerate."""
+
+    def __init__(self, pool_pages: int):
+        self.total = pool_pages - 1          # trash page excluded
+        self._free: list[int] = list(range(1, pool_pages))
+        self._refs: dict[int, int] = {}
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        if len(self._free) < n:
+            return None
+        out = self._free[:n]
+        del self._free[:n]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def incref(self, page: int) -> None:
+        self._refs[page] += 1
+
+    def decref(self, page: int) -> None:
+        left = self._refs[page] - 1
+        if left:
+            self._refs[page] = left
+        else:
+            del self._refs[page]
+            self._free.append(page)
+
+    def refs(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def check(self, expected: dict[int, int] | None = None) -> None:
+        """The conservation invariant (engine ``debug_invariants``
+        flag): every allocatable page is either free or referenced,
+        never both, never neither — and when the engine passes the
+        refcounts it can derive from its slots + cache, they must
+        match the pool's exactly."""
+        assert len(self._free) + len(self._refs) == self.total, (
+            f"page leak: {len(self._free)} free + {len(self._refs)} "
+            f"referenced != {self.total} total")
+        assert all(r >= 1 for r in self._refs.values()), \
+            f"non-positive refcount in {self._refs}"
+        assert not set(self._free) & set(self._refs), \
+            "page simultaneously free and referenced"
+        if expected is not None:
+            assert expected == self._refs, (
+                f"refcount drift: engine expects {expected}, "
+                f"pool holds {self._refs}")
+
+
+class PrefixCache:
+    """Content-addressed prompt-prefix index over the page pool.
+
+    Pages are keyed by CHAIN digest: page *i* of a prompt is stored
+    under ``(digest(pages[:i]), tokens(page i))`` where the parent
+    digest folds every earlier page's tokens — a page is reusable only
+    when everything before it matched too. Entries come in two flavors
+    sharing one table: FULL pages (``page_size`` tokens — the chain
+    walks through them) and PARTIAL tail pages (fewer tokens —
+    terminal; a later prompt may reuse the overlapping head rows, the
+    stale tail rows stay masked behind ``kv_lens`` until copy-on-write
+    makes the page private). Each entry holds ONE pool reference, so
+    cached pages survive the slots that wrote them; eviction (LRU, on
+    allocation pressure) only ever frees a page whose cache reference
+    is the LAST one — refcount-0 discipline, never a live slot's page.
+
+    Matching is capped one token short of the prompt on purpose: at
+    least one suffix token must run through prefill to produce the
+    request's first next-token logits."""
+
+    ROOT = b"pfx-root"
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.P = page_size
+        # key = (parent_digest, token_tuple) -> page id; dict order IS
+        # the LRU order (hits re-insert at the back)
+        self._entries: dict[tuple, int] = {}
+        self._kids: dict[bytes, list[tuple]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.tokens_saved = 0
+        self.pages_shared = 0
+
+    @staticmethod
+    def _digest(parent: bytes, tokens: tuple) -> bytes:
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(np.asarray(tokens, np.int64).tobytes())
+        return h.digest()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def pages(self) -> list[int]:
+        return list(self._entries.values())
+
+    def _touch(self, key: tuple) -> None:
+        self._entries[key] = self._entries.pop(key)
+
+    def match(self, prompt: list) -> tuple[list[int], int]:
+        """Longest reusable page run for ``prompt``: ``(pages, matched
+        tokens)`` with ``matched`` capped at ``len(prompt) - 1``. The
+        LAST page of the run may be partially matched (``matched %
+        page_size != 0`` — its remaining rows hold some other
+        continuation's kv, masked by ``kv_lens`` and copy-on-written
+        before any write). Takes NO references — the caller increfs
+        exactly what it admits."""
+        P = self.P
+        limit = len(prompt) - 1
+        pages: list[int] = []
+        matched = 0
+        h = self.ROOT
+        while matched < limit:
+            want = prompt[matched:matched + min(P, limit - matched)]
+            best_key, best_overlap = None, 0
+            for key in self._kids.get(h, ()):
+                if key not in self._entries:
+                    continue
+                n = 0
+                for a, b in zip(want, key[1]):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best_overlap:
+                    best_key, best_overlap = key, n
+            if best_key is None:
+                break
+            pages.append(self._entries[best_key])
+            self._touch(best_key)
+            matched += best_overlap
+            if best_overlap == P == len(best_key[1]):
+                h = self._digest(h, best_key[1])
+                continue
+            break   # partial page use is terminal
+        return pages, matched
+
+    def register(self, prompt: list, slot_pages: list) -> None:
+        """Index a freshly prefilled prompt's pages (full pages by
+        chain digest, the partial tail by its token tuple). Each NEW
+        entry takes one pool reference; a page already cached under the
+        same key is skipped — the identical-prompt case keeps finding
+        the original entry, not the admitting slot's CoW copy."""
+        P = self.P
+        h = self.ROOT
+        for i in range(0, len(prompt), P):
+            toks = tuple(prompt[i:i + P])
+            key = (h, toks)
+            if key in self._entries:
+                self._touch(key)
+            else:
+                page = slot_pages[i // P]
+                self._entries[key] = page
+                self._kids.setdefault(h, []).append(key)
+                self.pool.incref(page)
+            if len(toks) < P:
+                break
+            h = self._digest(h, toks)
+
+    def evict_one(self) -> bool:
+        """Drop the least-recently-used entry whose cache reference is
+        the LAST reference — a page still mapped by any slot (or
+        reachable only through it) is never touched. Descendants of an
+        evicted chain link become unreachable and age out the same
+        way."""
+        for key, page in self._entries.items():
+            if self.pool.refs(page) == 1:
+                del self._entries[key]
+                kids = self._kids[key[0]]
+                kids.remove(key)
+                if not kids:
+                    del self._kids[key[0]]
+                self.pool.decref(page)
+                obs.count("serve.prefix_evictions")
+                return True
+        return False
+
+    def flush(self) -> None:
+        """Drop every entry and release its pool reference. Cached KV
+        is a pure function of (params, tokens) — a base-revision swap
+        invalidates all of it at once; pages still mapped by live slots
+        survive on their slot references and free when those release."""
+        for page in self._entries.values():
+            self.pool.decref(page)
+        self._entries.clear()
+        self._kids.clear()
+        obs.count("serve.prefix_flushes")
+
+
+def _sample_from_logits(logits, temps, top_ps, seeds, tok_idx):
+    """Seeded temperature / top-p sampling over a ``[B, V]`` logits
+    block — the one sampling spelling shared by ``serve.decode_sample``
+    and ``serve.sample_tok``. The PRNG key for lane *b* is
+    ``fold_in(PRNGKey(seeds[b]), tok_idx[b])``: token *t* of a request
+    depends ONLY on (seed, t), never on batch composition or slot
+    index, which is what makes sampled streams bit-reproducible across
+    runs and across greedy/sampled mixed batches. ``temps[b] == 0``
+    lanes take the argmax (greedy) branch."""
+    greedy = jnp.argmax(logits, axis=-1)
+    keys = jax.vmap(lambda s, t: jax.random.fold_in(
+        jax.random.PRNGKey(s), t))(seeds, tok_idx)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(-scaled, axis=-1)
+    ranked = jnp.take_along_axis(scaled, order, axis=-1)
+    probs = jax.nn.softmax(ranked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps[:, None]   # mass BEFORE each token;
+    #                                          the top token always stays
+    ranked = jnp.where(keep, ranked, -jnp.inf)
+    pick = jax.vmap(jax.random.categorical)(keys, ranked)
+    sampled = jnp.take_along_axis(order, pick[:, None], axis=-1)[:, 0]
+    return jnp.where(temps > 0.0, sampled, greedy).astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -366,7 +626,10 @@ class GenerationEngine:
                  eos_id: int | None = None,
                  prefer_compiled: bool = True,
                  swap_policy: str = "drain",
-                 watcher: BaseRevisionWatcher | None = None):
+                 watcher: BaseRevisionWatcher | None = None,
+                 max_queue: int = 0,
+                 prefix_cache: bool = False,
+                 debug_invariants: bool = False):
         if swap_policy not in ("drain", "restart"):
             raise ValueError(f"swap_policy must be drain|restart, "
                              f"got {swap_policy!r}")
@@ -414,6 +677,20 @@ class GenerationEngine:
 
         self._decode_progs: dict[tuple[int, int], Callable] = {}
         self._prefill_progs: dict[int, Callable] = {}
+        # sampled-decode twins of the decode program family, plus the
+        # suffix-prefill family the prefix cache dispatches (both ride
+        # their own (bucket, bucket) keys so greedy steady-state compile
+        # pins never see them)
+        self._decode_sample_progs: dict[tuple[int, int], Callable] = {}
+        self._prefill_ctx_progs: dict[tuple[int, int], Callable] = {}
+        self._pctx_t_ladder = BucketLadder(self.pages_per_slot,
+                                           prefer_compiled=prefer_compiled)
+        self._pctx_p_ladder = BucketLadder(self.pages_per_slot,
+                                           prefer_compiled=prefer_compiled)
+        self._sample_tok_prog_: Callable | None = None
+        self._sample_tok_warm = False
+        self._page_copy_prog_: Callable | None = None
+        self._page_copy_warm = False
         # donation lets XLA update the page pool in place (it is the
         # dominant buffer); CPU ignores donation with a warning, so skip
         self._donate = jax.default_backend() not in ("cpu",)
@@ -422,13 +699,22 @@ class GenerationEngine:
         self.revision: str | None = None
         self._layers: list[str] | None = None
         self._kv: tuple[jax.Array, jax.Array] | None = None
-        self._free_pages: list[int] = []
+        self.pool: PagePool | None = None
+        self._prefix_cache = prefix_cache
+        self._cache: PrefixCache | None = None
+        self.max_queue = max_queue
+        self.debug_invariants = debug_invariants or bool(
+            os.environ.get("DT_SERVE_DEBUG"))
+        self.shed_count = 0          # frontend-counted 429 rejections
+        self.cow_copies = 0
         self._active: list[_Slot] = []
         self._queue: deque[ServeRequest] = deque()
         self._qlock = threading.Lock()
         self._work_evt = threading.Event()
         self._pending_swap: tuple[str | None, Params] | None = None
         self._decode_seen: set[tuple[int, int]] = set()
+        self._decode_sample_seen: set[tuple[int, int]] = set()
+        self._pctx_seen: set[tuple[int, int]] = set()
         # set on preemption, cleared when a slot finishes: admission
         # would otherwise immediately re-take the pages growth just
         # freed and the pool would livelock at 100% churn
@@ -461,23 +747,37 @@ class GenerationEngine:
                  hkv, cfg.head_dim)
         dt = cfg.compute_dtype()
         self._kv = (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
-        self._free_pages = list(range(1, self.pool_pages))
+        self.pool = PagePool(self.pool_pages)
+        if self._prefix_cache:
+            self._cache = PrefixCache(self.pool, self.page_size)
 
     # -- submission ---------------------------------------------------------
     def submit(self, prompt: Sequence[int],
-               max_new_tokens: int | None = None) -> ServeRequest:
+               max_new_tokens: int | None = None, *,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> ServeRequest:
         """Queue one generation request (thread-safe). Prompts longer
-        than the cache capacity are rejected up front."""
+        than the cache capacity are rejected up front.
+        ``temperature=0`` (the default) is greedy argmax — the
+        parity-pinned path; ``temperature>0`` samples the scaled
+        distribution truncated to ``top_p`` nucleus mass under the
+        request's seeded PRNG stream."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         n_new = max_new_tokens if max_new_tokens is not None \
             else self.max_new_tokens
         if len(prompt) + n_new > self.max_seq_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({n_new}) "
                 f"exceeds max_seq_len {self.max_seq_len}")
-        req = ServeRequest(prompt=prompt, max_new_tokens=n_new)
+        req = ServeRequest(prompt=prompt, max_new_tokens=n_new,
+                           temperature=float(temperature),
+                           top_p=float(top_p), seed=int(seed))
         with self._qlock:
             self._queue.append(req)
         obs.count("serve.requests")
@@ -510,6 +810,49 @@ class GenerationEngine:
     @property
     def tokens_per_sec(self) -> float:
         return self._tok_rate_ema or 0.0
+
+    @property
+    def prefix_hits(self) -> int:
+        return self._cache.hits if self._cache is not None else 0
+
+    @property
+    def prefix_misses(self) -> int:
+        return self._cache.misses if self._cache is not None else 0
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        total = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / total if total else 0.0
+
+    @property
+    def prefix_tokens_saved(self) -> int:
+        return self._cache.tokens_saved if self._cache is not None else 0
+
+    # -- admission control --------------------------------------------------
+    def admission_state(self) -> tuple[str, float]:
+        """Admission-control verdict for frontends, decided BEFORE a
+        request queues: ``("ok", 0)`` admits; ``("drain", s)`` — a
+        staged drain-policy swap is finishing in-flight sequences, so
+        new work would stall behind the drain (503); ``("shed", s)`` —
+        the queue sits at ``max_queue`` and further open-loop arrivals
+        would only manufacture ttft collapse past the queueing knee
+        (429). The second element is the Retry-After estimate in
+        seconds."""
+        if self.swap_policy == "drain" and self._pending_swap is not None \
+                and self._active:
+            return "drain", self._retry_after()
+        if self.max_queue and self.queue_depth >= self.max_queue:
+            return "shed", self._retry_after()
+        return "ok", 0.0
+
+    def _retry_after(self) -> float:
+        """Seconds until the queue plausibly has room: queued token
+        work over the observed throughput, clamped to a range a client
+        backoff can actually use."""
+        depth = max(self.queue_depth, 1)
+        tps = self.tokens_per_sec
+        est = depth * self.max_new_tokens / tps if tps > 0 else 1.0
+        return min(max(est, 1.0), 30.0)
 
     def wait_for_work(self, timeout: float) -> bool:
         """Block until a request arrives (ServeLoop's idle parking)."""
@@ -546,8 +889,12 @@ class GenerationEngine:
             v = v[:, 0].reshape(v.shape[0], mp, P, *v.shape[-2:])
             k_pages = k_pages.at[:, page_row].set(k)
             v_pages = v_pages.at[:, page_row].set(v)
-            nxt = jnp.argmax(logits[0, prompt_len - 1, :vocab])
-            return nxt.astype(jnp.int32), k_pages, v_pages
+            row = logits[0, prompt_len - 1, :vocab]
+            nxt = jnp.argmax(row)
+            # the logits row rides out so sampled requests can draw
+            # their FIRST token through serve.sample_tok (greedy ones
+            # take nxt and never touch it)
+            return nxt.astype(jnp.int32), row, k_pages, v_pages
 
         prog = devprof.wrap(
             "serve.prefill",
@@ -594,15 +941,146 @@ class GenerationEngine:
         self._decode_progs[(n_slots, n_pages)] = prog
         return prog
 
-    def _decode_bucket(self, need_slots: int,
-                       need_pages: int) -> tuple[int, int]:
+    def _decode_sample_prog(self, n_slots: int, n_pages: int) -> Callable:
+        """The sampled twin of :meth:`_decode_prog`: identical forward,
+        scatter, and (slot, page) bucketing — only the token pick
+        differs (seeded temperature/top-p via
+        :func:`_sample_from_logits`; ``temps == 0`` lanes still argmax,
+        so greedy requests inside a mixed batch stay greedy)."""
+        prog = self._decode_sample_progs.get((n_slots, n_pages))
+        if prog is not None:
+            return prog
+        model, P, vocab = self.model, self.page_size, self.cfg.vocab_size
+        L = len(self._layers)
+        stack_kv = self._stack_kv
+
+        def step_sample(params, k_pages, v_pages, page_tables, seq_lens,
+                        tokens, temps, top_ps, seeds, tok_idx):
+            kv_pages = tuple((k_pages[i], v_pages[i]) for i in range(L))
+            logits, muts = model.apply(
+                {"params": params}, tokens[:, None],
+                position_ids=seq_lens[:, None],
+                kv_pages=kv_pages, page_tables=page_tables,
+                kv_lens=seq_lens,
+                sow_kv=True, mutable=["intermediates"])
+            new_k, new_v = stack_kv(muts["intermediates"])
+            page_idx = jnp.take_along_axis(
+                page_tables, (seq_lens // P)[:, None], axis=1)[:, 0]
+            off = seq_lens % P
+            k_pages = k_pages.at[:, page_idx, off].set(new_k[:, :, 0])
+            v_pages = v_pages.at[:, page_idx, off].set(new_v[:, :, 0])
+            nxt = _sample_from_logits(logits[:, -1, :vocab], temps,
+                                      top_ps, seeds, tok_idx)
+            return nxt, k_pages, v_pages
+
+        prog = devprof.wrap(
+            "serve.decode_sample",
+            jax.jit(step_sample,
+                    donate_argnums=(1, 2) if self._donate else ()),
+            bucket=f"{n_slots}x{n_pages}")
+        self._decode_sample_progs[(n_slots, n_pages)] = prog
+        return prog
+
+    def _prefill_ctx_prog(self, t_bucket: int, pb: int) -> Callable:
+        """Suffix prefill over shared context: the prefix cache mapped
+        ``ctx_len`` prompt tokens to cached KV pages, so only the
+        divergent tail runs the model — ``t_bucket`` fresh tokens
+        attend the paged context (the model's ``kv_pages`` hook; Tq>1
+        rides the XLA reference path of ops/paged_attention.py) and
+        their kv scatters into this slot's pages at arbitrary offsets
+        (padded tail rows land on trash page 0)."""
+        prog = self._prefill_ctx_progs.get((t_bucket, pb))
+        if prog is not None:
+            return prog
+        model, P, vocab = self.model, self.page_size, self.cfg.vocab_size
+        L = len(self._layers)
+        cap = self.max_seq_len
+        stack_kv = self._stack_kv
+
+        def prefill_ctx(params, tokens, ctx_len, suffix_len,
+                        k_pages, v_pages, page_table):
+            kv_pages = tuple((k_pages[i], v_pages[i]) for i in range(L))
+            pos = ctx_len + jnp.arange(t_bucket)
+            logits, muts = model.apply(
+                {"params": params}, tokens,
+                position_ids=jnp.minimum(pos, cap - 1)[None, :],
+                kv_pages=kv_pages, page_tables=page_table,
+                kv_lens=jnp.reshape(ctx_len, (1,)),
+                sow_kv=True, mutable=["intermediates"])
+            k, v = stack_kv(muts["intermediates"])      # [L, 1, T, H, D]
+            valid = jnp.arange(t_bucket) < suffix_len
+            page_idx = jnp.where(
+                valid, page_table[0, jnp.minimum(pos // P, pb - 1)], 0)
+            off = pos % P
+            k_pages = k_pages.at[:, page_idx, off].set(k[:, 0])
+            v_pages = v_pages.at[:, page_idx, off].set(v[:, 0])
+            row = logits[0, suffix_len - 1, :vocab]
+            nxt = jnp.argmax(row)
+            return nxt.astype(jnp.int32), row, k_pages, v_pages
+
+        prog = devprof.wrap(
+            "serve.prefill_ctx",
+            jax.jit(prefill_ctx,
+                    donate_argnums=(4, 5) if self._donate else ()),
+            bucket=f"{t_bucket}x{pb}")
+        self._prefill_ctx_progs[(t_bucket, pb)] = prog
+        return prog
+
+    def _sample_tok(self, row, req: ServeRequest, idx: int) -> int:
+        """Draw one token from a prefill logits row through the shared
+        sampling math (``serve.sample_tok`` — one bucket-free program,
+        compiled once at the first sampled admission)."""
+        prog = self._sample_tok_prog_
+        if prog is None:
+            def sample_tok(row, temp, top_p, seed, tok_idx):
+                return _sample_from_logits(
+                    row[None, :], temp[None], top_p[None], seed[None],
+                    tok_idx[None])[0]
+
+            prog = devprof.wrap("serve.sample_tok", jax.jit(sample_tok),
+                                bucket=1)
+            self._sample_tok_prog_ = prog
+        args = (row, np.float32(req.temperature), np.float32(req.top_p),
+                np.int32(req.seed & 0x7FFFFFFF), np.int32(idx))
+        if not self._sample_tok_warm:
+            self._sample_tok_warm = True
+            return int(_timed_compile(prog, *args))
+        return int(prog(*args))
+
+    def _copy_page(self, src: int, dst: int) -> None:
+        """Whole-page KV copy (``serve.page_copy``) — the copy-on-write
+        primitive: garbage rows beyond the valid length copy too, but
+        they stay masked behind ``kv_lens`` until overwritten."""
+        prog = self._page_copy_prog_
+        if prog is None:
+            def page_copy(k_pages, v_pages, src, dst):
+                return (k_pages.at[:, dst].set(k_pages[:, src]),
+                        v_pages.at[:, dst].set(v_pages[:, src]))
+
+            prog = devprof.wrap(
+                "serve.page_copy",
+                jax.jit(page_copy,
+                        donate_argnums=(0, 1) if self._donate else ()),
+                bucket=1)
+            self._page_copy_prog_ = prog
+        k_pages, v_pages = self._kv
+        if not self._page_copy_warm:
+            self._page_copy_warm = True
+            self._kv = _timed_compile(prog, k_pages, v_pages,
+                                      np.int32(src), np.int32(dst))
+        else:
+            self._kv = prog(k_pages, v_pages, np.int32(src), np.int32(dst))
+
+    def _decode_bucket(self, need_slots: int, need_pages: int,
+                       progs: dict | None = None) -> tuple[int, int]:
+        progs = self._decode_progs if progs is None else progs
         sb = self._slot_ladder.bucket_for(need_slots)
         pb = self._page_ladder.bucket_for(need_pages)
-        if self.prefer_compiled and (sb, pb) not in self._decode_progs:
+        if self.prefer_compiled and (sb, pb) not in progs:
             # joint pad-up: a compiled (bigger, bigger) program beats a
             # fresh exact-fit compile on BOTH axes (the per-dimension
             # ladders only see their own axis)
-            cands = [k for k in self._decode_progs
+            cands = [k for k in progs
                      if k[0] >= need_slots and k[1] >= need_pages]
             if cands:
                 return min(cands, key=lambda k: k[0] * k[1])
@@ -610,14 +1088,22 @@ class GenerationEngine:
 
     # -- paging -------------------------------------------------------------
     def _alloc_pages(self, n: int) -> list | None:
-        if len(self._free_pages) < n:
-            return None
-        out = self._free_pages[:n]
-        del self._free_pages[:n]
-        return out
+        """Allocate ``n`` fresh pages (refcount 1 each). When the pool
+        runs dry, evict unreferenced prefix-cache entries LRU-first —
+        cached pages some live slot still shares are never reclaimed
+        (refcount > 1 pins them)."""
+        pages = self.pool.alloc(n)
+        while pages is None:
+            if self._cache is None or not self._cache.evict_one():
+                return None
+            pages = self.pool.alloc(n)
+        return pages
 
     def _release(self, slot: _Slot) -> None:
-        self._free_pages.extend(slot.pages)
+        # decref, not free: pages the prefix cache (or a sibling slot)
+        # still holds survive this slot's exit
+        for p in slot.pages:
+            self.pool.decref(p)
         slot.pages = []
 
     def _finish(self, slot: _Slot, status: str) -> None:
@@ -670,12 +1156,32 @@ class GenerationEngine:
         self._params = placed
         self.revision = rev
         self._pending_swap = None
+        if self._cache is not None:
+            # cached KV was computed under the OLD params — every entry
+            # is stale the instant the revision lands
+            self._cache.flush()
         obs.observe("serve.swap_stall_ms",
                     (time.perf_counter() - t0) * 1e3)
         obs.count("serve.swaps")
         flight.record("swap", outcome="swapped", revision=rev or "",
                       policy=self.swap_policy)
         logger.info("hot-swapped base to revision %s", rev)
+
+    def _cow_page(self, slot: _Slot, idx: int) -> bool:
+        """Copy-on-write: give ``slot`` a private copy of its
+        ``idx``-th page before a write would bleed into sequences
+        sharing it. Returns False when the pool can't supply the copy
+        target (caller preempts or truncates)."""
+        got = self._alloc_pages(1)
+        if got is None:
+            return False
+        src = slot.pages[idx]
+        self._copy_page(src, got[0])
+        self.pool.decref(src)
+        slot.pages[idx] = got[0]
+        self.cow_copies += 1
+        obs.count("serve.cow_copies")
+        return True
 
     # -- scheduling ---------------------------------------------------------
     def _admit(self) -> None:
@@ -685,12 +1191,59 @@ class GenerationEngine:
             req = self._pop_queued()
             if req is None:
                 return
-            n0 = len(req.prompt) // self.page_size + 1
-            pages = self._alloc_pages(n0)
-            if pages is None:
-                self._requeue_front(req)
+            if not self._admit_one(req):
                 return
+
+    def _admit_one(self, req: ServeRequest) -> bool:
+        """Admit one request: consult the prefix cache for shared
+        context pages (increfs them), allocate the rest fresh, then
+        run full or suffix prefill. On pool exhaustion the request
+        goes back to the queue front with its increfs rolled back."""
+        P = self.page_size
+        plen = len(req.prompt)
+        shared: list[int] = []
+        matched = 0
+        if self._cache is not None:
+            shared, matched = self._cache.match(list(req.prompt))
+            if matched:
+                for p in shared:
+                    self.pool.incref(p)
+                self._cache.hits += 1
+                self._cache.tokens_saved += matched
+                self._cache.pages_shared += len(shared)
+                obs.count("serve.prefix_hits")
+                obs.count("serve.prefix_tokens_saved", matched)
+                obs.count("serve.prefix_pages_shared", len(shared))
+            else:
+                self._cache.misses += 1
+                obs.count("serve.prefix_misses")
+        need = plen // P + 1 - len(shared)
+        fresh = self._alloc_pages(need)
+        if fresh is None:
+            for p in shared:
+                self.pool.decref(p)
+            self._requeue_front(req)
+            return False
+        pages = shared + fresh
+        if matched and matched % P:
+            # the suffix's first write lands mid-way into the last
+            # matched page — it must be private before prefill scatters
+            # into it
+            idx = matched // P
+            slot_stub = _Slot(req=req, pages=pages, seq_len=0, last_tok=0,
+                              order=-1)
+            if self.pool.refs(pages[idx]) > 1 and \
+                    not self._cow_page(slot_stub, idx):
+                for p in pages:
+                    self.pool.decref(p)
+                self._requeue_front(req)
+                return False
+            pages = slot_stub.pages
+        if matched:
+            self._prefill_shared(req, pages, matched)
+        else:
             self._prefill(req, pages)
+        return True
 
     def _prefill(self, req: ServeRequest, pages: list) -> None:
         P = self.page_size
@@ -708,20 +1261,64 @@ class GenerationEngine:
         t0 = time.perf_counter()
         if self._prefill_ladder.mark(t_bucket // P):
             obs.count("serve.prefill_bucket_compiles")
-            nxt, k_pages, v_pages = _timed_compile(
+            nxt, logit_row, k_pages, v_pages = _timed_compile(
                 prog, self._params, toks, np.int32(plen),
                 k_pages, v_pages, page_row)
         else:
-            nxt, k_pages, v_pages = prog(
+            nxt, logit_row, k_pages, v_pages = prog(
                 self._params, toks, np.int32(plen), k_pages, v_pages,
                 page_row)
         self._kv = (k_pages, v_pages)
-        nxt = int(nxt)
         obs.observe("serve.prefill_ms", (time.perf_counter() - t0) * 1e3)
         obs.count("serve.prefills")
+        if self._cache is not None:
+            self._cache.register(list(req.prompt), pages)
+        self._activate(req, pages, self._first_token(req, nxt, logit_row))
+
+    def _prefill_shared(self, req: ServeRequest, pages: list,
+                        ctx_len: int) -> None:
+        """Suffix prefill: ``ctx_len`` prompt tokens already live in
+        shared cache pages; only the tail runs the model."""
+        P = self.page_size
+        plen = len(req.prompt)
+        suffix = plen - ctx_len
+        t_bucket = self._pctx_t_ladder.bucket_for(
+            (suffix + P - 1) // P) * P
+        pb = self._pctx_p_ladder.bucket_for(plen // P + 1)
+        toks = np.zeros((1, t_bucket), np.int32)
+        toks[0, :suffix] = req.prompt[ctx_len:]
+        table = np.zeros((1, pb), np.int32)
+        table[0, :len(pages)] = pages
+        prog = self._prefill_ctx_prog(t_bucket, pb)
+        k_pages, v_pages = self._kv
+        t0 = time.perf_counter()
+        key = (t_bucket, pb)
+        self._pctx_t_ladder.mark(t_bucket // P)
+        self._pctx_p_ladder.mark(pb)
+        if key not in self._pctx_seen:
+            self._pctx_seen.add(key)
+            obs.count("serve.prefill_bucket_compiles")
+            nxt, logit_row, k_pages, v_pages = _timed_compile(
+                prog, self._params, toks, np.int32(ctx_len),
+                np.int32(suffix), k_pages, v_pages, table)
+        else:
+            nxt, logit_row, k_pages, v_pages = prog(
+                self._params, toks, np.int32(ctx_len), np.int32(suffix),
+                k_pages, v_pages, table)
+        self._kv = (k_pages, v_pages)
+        obs.observe("serve.prefill_ms", (time.perf_counter() - t0) * 1e3)
+        obs.count("serve.prefills")
+        self._activate(req, pages, self._first_token(req, nxt, logit_row))
+
+    def _first_token(self, req: ServeRequest, nxt, logit_row) -> int:
+        if req.temperature > 0.0:
+            return self._sample_tok(logit_row, req, 0)
+        return int(nxt)
+
+    def _activate(self, req: ServeRequest, pages: list, nxt: int) -> None:
         req.status = "active"
-        slot = _Slot(req=req, pages=pages, seq_len=plen, last_tok=nxt,
-                     order=next(self._order))
+        slot = _Slot(req=req, pages=pages, seq_len=len(req.prompt),
+                     last_tok=nxt, order=next(self._order))
         self._active.append(slot)
         self._emit(slot, nxt)
 
@@ -753,7 +1350,9 @@ class GenerationEngine:
 
     def _grow(self) -> None:
         """Ensure every active slot owns the page its next write lands
-        in; preempt the youngest sequence when the pool runs dry."""
+        in — exclusively: a shared (refcount > 1) write page is
+        copy-on-write'd before the decode scatter touches it. Preempt
+        the youngest sequence when the pool runs dry."""
         for slot in list(self._active):
             if slot not in self._active:
                 continue   # preempted by an earlier slot's growth
@@ -767,13 +1366,24 @@ class GenerationEngine:
                     # nothing left to steal from: cut this one short
                     self._finish(slot, "truncated")
                     break
+            if slot not in self._active:
+                continue
+            wp = slot.seq_len // self.page_size
+            while wp < len(slot.pages) and self.pool.refs(slot.pages[wp]) > 1:
+                if self._cow_page(slot, wp):
+                    break
+                if not self._preempt_one(protect=slot):
+                    self._finish(slot, "truncated")
+                    break
 
     def _decode(self) -> int:
         active = self._active
         if not active:
             return 0
+        sampled = any(s.req.temperature > 0.0 for s in active)
+        progs = self._decode_sample_progs if sampled else self._decode_progs
         need_pages = max(s.seq_len // self.page_size + 1 for s in active)
-        sb, pb = self._decode_bucket(len(active), need_pages)
+        sb, pb = self._decode_bucket(len(active), need_pages, progs)
         tables = np.zeros((sb, pb), np.int32)
         seq_lens = np.zeros((sb,), np.int32)
         tokens = np.zeros((sb,), np.int32)
@@ -782,19 +1392,42 @@ class GenerationEngine:
             tables[i, :len(row)] = row
             seq_lens[i] = slot.seq_len
             tokens[i] = slot.last_tok
-        prog = self._decode_prog(sb, pb)
         k_pages, v_pages = self._kv
         self._slot_ladder.mark(sb)
         self._page_ladder.mark(pb)
-        if (sb, pb) not in self._decode_seen:
-            self._decode_seen.add((sb, pb))
-            obs.count("serve.decode_bucket_compiles")
-            nxt, k_pages, v_pages = _timed_compile(
-                prog, self._params, k_pages, v_pages, tables, seq_lens,
-                tokens)
+        if sampled:
+            # one program serves any greedy/sampled mix: temperature 0
+            # lanes argmax inside the jitted sampler, so batch
+            # composition never forces a recompile
+            temps = np.zeros((sb,), np.float32)
+            top_ps = np.ones((sb,), np.float32)
+            seeds = np.zeros((sb,), np.int32)
+            tok_idx = np.zeros((sb,), np.int32)
+            for i, slot in enumerate(active):
+                temps[i] = slot.req.temperature
+                top_ps[i] = slot.req.top_p
+                seeds[i] = slot.req.seed & 0x7FFFFFFF
+                tok_idx[i] = len(slot.req.tokens)
+            prog = self._decode_sample_prog(sb, pb)
+            args = (self._params, k_pages, v_pages, tables, seq_lens,
+                    tokens, temps, top_ps, seeds, tok_idx)
+            if (sb, pb) not in self._decode_sample_seen:
+                self._decode_sample_seen.add((sb, pb))
+                obs.count("serve.decode_bucket_compiles")
+                nxt, k_pages, v_pages = _timed_compile(prog, *args)
+            else:
+                nxt, k_pages, v_pages = prog(*args)
         else:
-            nxt, k_pages, v_pages = prog(self._params, k_pages, v_pages,
-                                         tables, seq_lens, tokens)
+            prog = self._decode_prog(sb, pb)
+            if (sb, pb) not in self._decode_seen:
+                self._decode_seen.add((sb, pb))
+                obs.count("serve.decode_bucket_compiles")
+                nxt, k_pages, v_pages = _timed_compile(
+                    prog, self._params, k_pages, v_pages, tables, seq_lens,
+                    tokens)
+            else:
+                nxt, k_pages, v_pages = prog(self._params, k_pages, v_pages,
+                                             tables, seq_lens, tokens)
         self._kv = (k_pages, v_pages)
         nxt = np.asarray(jax.device_get(nxt))
         emitted = 0
@@ -828,18 +1461,36 @@ class GenerationEngine:
             obs.gauge("serve.tokens_per_sec", self._tok_rate_ema)
         obs.gauge("serve.queue_depth", self.queue_depth)
         obs.gauge("serve.active_slots", len(self._active))
-        obs.gauge("serve.free_pages", len(self._free_pages))
+        obs.gauge("serve.free_pages", self.pool.free)
+        if self.debug_invariants:
+            self._check_invariants()
         return {"emitted": emitted, "active": len(self._active),
                 "queued": self.queue_depth, "step_ms": dur * 1e3,
                 "revision": self.revision}
 
+    def _check_invariants(self) -> None:
+        """Page-pool accounting audit (debug flag / DT_SERVE_DEBUG):
+        every referenced page must be explained by exactly its holders —
+        active slots plus prefix-cache entries — and free + referenced
+        must tile the pool."""
+        expected: dict[int, int] = {}
+        for slot in self._active:
+            for p in slot.pages:
+                expected[p] = expected.get(p, 0) + 1
+        if self._cache is not None:
+            for p in self._cache.pages():
+                expected[p] = expected.get(p, 0) + 1
+        self.pool.check(expected)
+
     # -- conveniences -------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int | None = None,
-                 *, max_steps: int = 100_000) -> list[list[int]]:
+                 *, max_steps: int = 100_000, temperature: float = 0.0,
+                 top_p: float = 1.0, seed: int = 0) -> list[list[int]]:
         """Submit a batch and drive the scheduler to completion (tests,
         bench, one-shot CLI use)."""
-        reqs = [self.submit(p, max_new_tokens) for p in prompts]
+        reqs = [self.submit(p, max_new_tokens, temperature=temperature,
+                            top_p=top_p, seed=seed) for p in prompts]
         for _ in range(max_steps):
             if all(r.done_evt.is_set() for r in reqs):
                 break
@@ -936,28 +1587,61 @@ class ServeHTTPFrontend:
             def log_message(self, fmt, *args):
                 logger.debug("serve_http: " + fmt, *args)
 
-            def _send(self, code: int, obj) -> None:
+            def _send(self, code: int, obj,
+                      headers: dict | None = None) -> None:
                 body = (json.dumps(obj) + "\n").encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 if self.path.split("?", 1)[0] == "/healthz":
                     e = fe.engine
-                    self._send(200, {
+                    reg = obs.registry()
+                    names = reg.names()
+                    out = {
                         "ok": True, "queue_depth": e.queue_depth,
                         "active": e.active_count,
                         "revision": e.revision,
-                        "tokens_per_sec": e.tokens_per_sec})
+                        "tokens_per_sec": e.tokens_per_sec,
+                        "max_queue": e.max_queue,
+                        "shed": e.shed_count}
+                    if e.prefix_hits + e.prefix_misses > 0:
+                        out["prefix_hit_rate"] = e.prefix_hit_rate
+                    for key, metric in (("ttft_ms_p95", "serve.ttft_ms"),
+                                        ("tpot_ms_p95", "serve.tpot_ms")):
+                        if metric in names and \
+                                reg.histogram(metric).count:
+                            out[key] = reg.histogram(metric).percentiles(
+                                (95.0,))["p95"]
+                    self._send(200, out)
                 else:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):  # noqa: N802
                 if self.path.split("?", 1)[0] != "/generate":
                     self._send(404, {"error": "not found"})
+                    return
+                # admission control BEFORE parsing: a saturated server
+                # answers cheaply and immediately instead of queueing
+                # the caller into the latency knee
+                state, retry = fe.engine.admission_state()
+                if state == "shed":
+                    fe.engine.shed_count += 1
+                    obs.count("serve.shed")
+                    self._send(429, {"error": "overloaded",
+                                     "retry_after_s": retry},
+                               {"Retry-After": str(max(1, int(retry)))})
+                    return
+                if state == "drain":
+                    obs.count("serve.drain_rejects")
+                    self._send(503, {"error": "draining for base swap",
+                                     "retry_after_s": retry},
+                               {"Retry-After": str(max(1, int(retry)))})
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
@@ -973,7 +1657,10 @@ class ServeHTTPFrontend:
                         raise ValueError("need a non-empty 'tokens' list "
                                          "or 'text'")
                     req = fe.engine.submit(
-                        toks, payload.get("max_new_tokens"))
+                        toks, payload.get("max_new_tokens"),
+                        temperature=float(payload.get("temperature", 0.0)),
+                        top_p=float(payload.get("top_p", 1.0)),
+                        seed=int(payload.get("seed", 0)))
                 except (ValueError, TypeError, json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
                     return

@@ -99,6 +99,17 @@ PROGRAMS: dict[str, str] = {
     "serve.decode_attn": "standalone fused paged-attention decode "
                          "program (ops/paged_attention.py; the in-step "
                          "copy is attributed under serve.decode)",
+    "serve.decode_sample": "sampled (temperature/top-p, seeded PRNG) "
+                           "twin of serve.decode — same forward, "
+                           "scatter, and (slot,page) buckets "
+                           "(engine/serve.py)",
+    "serve.prefill_ctx": "suffix prefill over shared prefix-cache "
+                         "pages, per (T,page)-bucket (engine/serve.py)",
+    "serve.sample_tok": "single-row seeded sampler for the first "
+                        "token after prefill (engine/serve.py)",
+    "serve.page_copy": "whole-page KV copy — the copy-on-write "
+                       "primitive behind prefix sharing "
+                       "(engine/serve.py)",
 }
 
 

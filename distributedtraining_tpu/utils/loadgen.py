@@ -333,3 +333,105 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
         "tpot_ms": _pcts(tpot_ms) if tpot_ms else
         {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
     }
+
+
+def run_open_loop_routed(engines, spec: OpenLoopSpec, *,
+                         max_backend_queue: int = 6) -> dict:
+    """One load point through N engines behind the router policy —
+    the same virtual-clock discipline as :func:`run_open_loop` (every
+    tick steps ALL engines; one tick is ``step_ms``), with the real
+    :class:`~..engine.router.RouterPolicy` making the per-arrival
+    spread/shed decision from each engine's live queue/active state.
+
+    Shed arrivals are counted (``shed``) and EXCLUDED from the latency
+    percentiles: the admission controller's contract is that admitted
+    requests stay off the collapse curve, and a 429'd open-loop caller
+    never waited in any queue. The offered/shed split plus the
+    admitted-only p99 is exactly the curve FLEETSIM_r04 gates against
+    the single-server r01 baseline."""
+    from ..engine.router import BackendState, RouterPolicy
+    from .obs import percentile
+
+    policy = RouterPolicy(max_queue_depth=max_backend_queue)
+    arrivals = sample_arrivals(spec)
+    now = 0.0
+    i = 0
+    steps = 0
+    shed = 0
+    tracked: list[dict] = []
+    ttft_ms: list[float] = []
+    tpot_ms: list[float] = []
+    states = [BackendState(url=f"engine://{n}", healthy=True)
+              for n in range(len(engines))]
+
+    def _submit_due() -> None:
+        nonlocal i, shed
+        while i < len(arrivals) and arrivals[i][0] <= now:
+            t_arr, prompt = arrivals[i]
+            i += 1
+            for n, e in enumerate(engines):
+                states[n].queue_depth = e.queue_depth
+                states[n].active = e.active_count
+            b = policy.choose(states)
+            if b is None:
+                shed += 1
+                continue
+            eng = engines[int(b.url.rsplit("/", 1)[-1])]
+            req = eng.submit(prompt, spec.max_new_tokens)
+            tracked.append({"req": req, "arrival_s": t_arr,
+                            "seen": 0, "last_emit": None})
+
+    def _account() -> None:
+        for rec in tracked:
+            n = len(rec["req"].tokens)
+            if n <= rec["seen"]:
+                continue
+            for _ in range(n - rec["seen"]):
+                if rec["last_emit"] is None:
+                    ttft_ms.append((now - rec["arrival_s"]) * 1e3)
+                else:
+                    tpot_ms.append((now - rec["last_emit"]) * 1e3)
+                rec["last_emit"] = now
+            rec["seen"] = n
+
+    while (i < len(arrivals)
+           or not all(e.idle for e in engines)) and steps < spec.max_steps:
+        if all(e.idle for e in engines) and i < len(arrivals):
+            now = max(now, arrivals[i][0])
+            _submit_due()
+            continue
+        _submit_due()
+        for e in engines:
+            if not e.idle:
+                e.step()
+        steps += 1
+        now += spec.step_ms / 1e3
+        _account()
+
+    completed = sum(1 for r in tracked if r["req"].done_evt.is_set())
+    unfinished = len(tracked) - completed
+
+    def _pcts(vals: list[float]) -> dict:
+        s = sorted(vals)
+        return {"p50": round(percentile(s, 50.0), 3),
+                "p95": round(percentile(s, 95.0), 3),
+                "p99": round(percentile(s, 99.0), 3)}
+
+    return {
+        "rate_rps": spec.rate_rps,
+        "duration_s": spec.duration_s,
+        "router": True,
+        "servers": len(engines),
+        "offered": len(arrivals),
+        "routed": len(tracked),
+        "shed": shed,
+        "completed": completed,
+        "unfinished": unfinished,
+        "steps": steps,
+        "virtual_s": round(now, 4),
+        "tokens": int(sum(r["seen"] for r in tracked)),
+        "ttft_ms": _pcts(ttft_ms) if ttft_ms else
+        {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
+        "tpot_ms": _pcts(tpot_ms) if tpot_ms else
+        {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
+    }

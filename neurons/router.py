@@ -1,0 +1,87 @@
+"""Router entry point: spread ``/generate`` across N serving hosts.
+
+The admission tier in front of the serving fleet (engine/router.py):
+polls each backend's ``/healthz`` for the load signals the heartbeat
+plane already defines (queue depth, active slots, ``ttft_ms_p95`` /
+``tpot_ms_p95``, served revision), routes every request to the
+least-loaded backend on the majority revision, and sheds with
+``429`` + ``Retry-After`` once every backend sits at its admission
+bound — BEFORE the queueing knee FLEETSIM_r01 measured, not after.
+
+The router holds no model state; run several behind DNS round-robin if
+the router itself needs redundancy. Example:
+
+    python neurons/router.py --port 8800 \
+        --backend http://10.0.0.1:8900 --backend http://10.0.0.2:8900
+
+    curl -d '{"tokens": [1, 2, 3]}' http://127.0.0.1:8800/generate
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distributedtraining_tpu.engine.router import (            # noqa: E402
+    RouterHTTPFrontend, RouterPolicy)
+
+logger = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--backend", action="append", dest="backends",
+                    required=True,
+                    help="serving backend base URL (repeatable), e.g. "
+                         "http://10.0.0.1:8900")
+    ap.add_argument("--port", type=int, default=8800)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--max-queue", type=int, default=6,
+                    help="per-backend admission bound (queued + active) "
+                         "before the router sheds with 429")
+    ap.add_argument("--shed-ttft-ms", type=float, default=0.0,
+                    help="also shed a backend whose observed ttft p95 "
+                         "exceeds this (0 = queue-bound only)")
+    ap.add_argument("--no-prefer-revision", dest="prefer_revision",
+                    action="store_false",
+                    help="do not prefer backends on the majority base "
+                         "revision")
+    ap.add_argument("--poll-interval", type=float, default=1.0,
+                    help="seconds between /healthz sweeps")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-request backend timeout (seconds)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO if args.verbose
+                        else logging.WARNING)
+    policy = RouterPolicy(max_queue_depth=args.max_queue,
+                          shed_ttft_ms=args.shed_ttft_ms,
+                          prefer_revision=args.prefer_revision)
+    fe = RouterHTTPFrontend(args.backends, args.port, host=args.host,
+                            policy=policy,
+                            poll_interval_s=args.poll_interval,
+                            timeout_s=args.timeout)
+    port = fe.start()
+    print(f"router: http://{args.host}:{port}/generate -> "
+          f"{len(args.backends)} backends (max queue {args.max_queue})",
+          file=sys.stderr)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        fe.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,7 +97,9 @@ def main(argv=None) -> int:
         pool_pages=cfg.serve_kv_pages, max_seq_len=cfg.serve_max_seq,
         max_new_tokens=cfg.serve_max_new,
         eos_id=getattr(c.tokenizer, "eos_id", None),
-        swap_policy=cfg.swap_policy, watcher=watcher)
+        swap_policy=cfg.swap_policy, watcher=watcher,
+        max_queue=cfg.serve_max_queue,
+        prefix_cache=cfg.serve_prefix_cache)
     watcher.start()
 
     # health plane: the server heartbeats its SERVED revision (the
@@ -110,7 +112,12 @@ def main(argv=None) -> int:
     def _serve_counters():
         out = {"tokens_per_sec": engine.tokens_per_sec,
                "queue_depth": float(engine.queue_depth),
-               "tokens": float(engine.tokens_emitted)}
+               "tokens": float(engine.tokens_emitted),
+               "shed": float(engine.shed_count)}
+        # prefix-cache effectiveness rides the heartbeat only once the
+        # cache has seen traffic — fleet_report renders "-" otherwise
+        if engine.prefix_hits + engine.prefix_misses > 0:
+            out["prefix_hit_rate"] = engine.prefix_hit_rate
         # request-level latency percentiles (engine/serve.py observes
         # serve.ttft_ms / serve.tpot_ms per token): ride the heartbeat
         # as numeric extras so fleet_report's ttft95/tpot95 columns show

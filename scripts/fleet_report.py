@@ -38,9 +38,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
-           "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "published",
-           "accepted", "declined", "stale_rounds", "wire_b", "base_b",
-           "mirror_hit", "score", "credit", "quar", "slo")
+           "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "shed",
+           "pfx_hit", "published", "accepted", "declined", "stale_rounds",
+           "wire_b", "base_b", "mirror_hit", "score", "credit", "quar",
+           "slo")
 
 
 def _human_bytes(v) -> str:
@@ -163,6 +164,17 @@ def _cell(node: dict, col: str) -> str:
         # which tok_s alone cannot show
         v = node.get("ttft_ms_p95" if col == "ttft95" else "tpot_ms_p95")
         return "-" if v is None else f"{v:.1f}"
+    if col == "shed":
+        # admission-control rejections (429 + Retry-After) this server
+        # or router answered instead of queueing into the latency knee
+        # (engine/serve.py admission_state / engine/router.py)
+        v = node.get("shed")
+        return "-" if v is None else str(int(v))
+    if col == "pfx_hit":
+        # prefix-cache hit rate: the fraction of admissions that reused
+        # shared prompt-prefix KV pages (engine/serve.py PrefixCache)
+        v = node.get("prefix_hit_rate")
+        return "-" if not isinstance(v, (int, float)) else f"{v:.2f}"
     if col == "wire_b":
         # transport bytes the monitor role fetched staging this miner
         # (engine/health.py ledger) — human-scaled: the whole point of

@@ -87,10 +87,16 @@ def build_spec(args) -> "FleetSpec":
     return spec
 
 
-def run_load_phase(rates, *, seed: int, duration_s: float) -> list[dict]:
+def run_load_phase(rates, *, seed: int, duration_s: float,
+                   servers: int = 0,
+                   max_backend_queue: int = 6) -> list[dict]:
     """The open-loop latency curve: one real GenerationEngine per rate
     (a fresh engine per point keeps the points independent — no warm
-    queue bleeding between rates)."""
+    queue bleeding between rates). With ``servers > 0`` each point runs
+    ``servers`` engines behind the router policy + admission bound
+    instead (prefix cache on — the routed fleet is the optimized
+    serving plane): percentiles then cover ADMITTED requests and the
+    shed count is reported per point."""
     import jax
 
     from distributedtraining_tpu.engine.serve import GenerationEngine
@@ -103,18 +109,31 @@ def run_load_phase(rates, *, seed: int, duration_s: float) -> list[dict]:
     params = model.init_params(jax.random.PRNGKey(0))
     points = []
     for rate in rates:
-        engine = GenerationEngine(model, params, max_slots=4, page_size=8)
-        try:
-            points.append(loadgen.run_open_loop(
-                engine, loadgen.OpenLoopSpec(rate_rps=float(rate),
-                                             duration_s=duration_s,
-                                             seed=seed)))
-        finally:
-            engine.close()
+        spec = loadgen.OpenLoopSpec(rate_rps=float(rate),
+                                    duration_s=duration_s, seed=seed)
+        if servers > 0:
+            engines = [GenerationEngine(model, params, max_slots=4,
+                                        page_size=8, prefix_cache=True)
+                       for _ in range(servers)]
+            try:
+                points.append(loadgen.run_open_loop_routed(
+                    engines, spec, max_backend_queue=max_backend_queue))
+            finally:
+                for e in engines:
+                    e.close()
+        else:
+            engine = GenerationEngine(model, params, max_slots=4,
+                                      page_size=8)
+            try:
+                points.append(loadgen.run_open_loop(engine, spec))
+            finally:
+                engine.close()
         p = points[-1]
+        extra = (f" shed {p['shed']}" if p.get("router") else "")
         print(f"  load {rate:g} rps: offered {p['offered']} "
               f"completed {p['completed']} unfinished {p['unfinished']} "
-              f"ttft p99 {p['ttft_ms']['p99']:.1f}ms", file=sys.stderr)
+              f"ttft p99 {p['ttft_ms']['p99']:.1f}ms{extra}",
+              file=sys.stderr)
     return points
 
 
@@ -141,6 +160,12 @@ def main(argv=None) -> int:
                     help="comma-separated offered request rates (rps)")
     ap.add_argument("--load-duration", type=float, default=6.0,
                     help="virtual seconds of arrivals per load point")
+    ap.add_argument("--router-servers", type=int, default=0,
+                    help="run the load phase through the router policy "
+                         "across N engines (0 = single-server direct)")
+    ap.add_argument("--router-max-queue", type=int, default=6,
+                    help="per-backend admission bound (queued + active) "
+                         "before the router sheds")
     ap.add_argument("--out", default="FLEETSIM.json",
                     help="scorecard output path")
     ap.add_argument("--baseline",
@@ -196,8 +221,10 @@ def main(argv=None) -> int:
             rates = [float(r) for r in args.rates.split(",") if r]
             print(f"fleetsim: open-loop serving at {rates} rps",
                   file=sys.stderr)
-            load_points = run_load_phase(rates, seed=spec.seed,
-                                         duration_s=args.load_duration)
+            load_points = run_load_phase(
+                rates, seed=spec.seed, duration_s=args.load_duration,
+                servers=args.router_servers,
+                max_backend_queue=args.router_max_queue)
 
         card = fs.assemble_scorecard(result, control, load_points,
                                      gates=gates)

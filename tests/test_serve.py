@@ -592,3 +592,293 @@ def test_run_config_serving_flags():
     for role in ("miner", "validator", "averager"):
         c = RunConfig.from_args(role, ["--compile-cache-dir", "/tmp/cc"])
         assert c.compile_cache_dir == "/tmp/cc"
+
+
+# ---------------------------------------------------------------------------
+# Sampled decode (round 16): seeded determinism + compile discipline
+# ---------------------------------------------------------------------------
+
+SAMPLE_KW = dict(temperature=0.9, top_p=0.95, seed=42)
+
+
+def test_sampled_decode_deterministic_across_runs(setup):
+    """Same seed + same batch composition => bit-identical sampled
+    streams across engine instances (the PRNG key is
+    fold_in(PRNGKey(seed), token_index) — a pure function of the
+    request, never of wall clock or slot layout)."""
+    model, cfg, params, _, prompts = setup
+    outs = []
+    for _ in range(2):
+        eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+        try:
+            outs.append(eng.generate(prompts[:2], GEN, **SAMPLE_KW))
+        finally:
+            eng.close()
+    assert outs[0] == outs[1]
+    # and sampling actually sampled: not the greedy stream
+    assert outs[0] != refs_for(model, params, prompts[:2])
+
+
+def test_sampled_stream_independent_of_batch_mix(setup):
+    """A request's sampled stream is identical whether its batch
+    neighbors are greedy or sampled — and the greedy lane inside a
+    mixed batch stays bit-identical to the reference oracle (both lanes
+    run the ONE sampled program; temperature rides as data)."""
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+    try:
+        pure = eng.generate(prompts[:2], GEN, **SAMPLE_KW)
+    finally:
+        eng.close()
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+    try:
+        r_greedy = eng.submit(prompts[0], GEN)
+        r_sampled = eng.submit(prompts[1], GEN, **SAMPLE_KW)
+        while not (r_greedy.done_evt.is_set()
+                   and r_sampled.done_evt.is_set()):
+            eng.step()
+        assert list(r_greedy.tokens) == refs_for(
+            model, params, prompts[:1])[0]
+        assert list(r_sampled.tokens) == pure[1]
+    finally:
+        eng.close()
+
+
+def test_sampled_decode_zero_fresh_compiles(setup, sink):
+    """The mixed greedy/sampled acceptance pin: after one warm mixed
+    batch, an identical second wave adds ZERO fresh compiles — the
+    sampled program family rides the same (slot, page) BucketLadder and
+    sampling parameters are arguments, not trace constants."""
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=4, page_size=8)
+
+    def wave():
+        reqs = [eng.submit(p, GEN) if i % 2 == 0
+                else eng.submit(p, GEN, **SAMPLE_KW)
+                for i, p in enumerate(prompts)]
+        while not all(r.done_evt.is_set() for r in reqs):
+            eng.step()
+        return [list(r.tokens) for r in reqs]
+
+    try:
+        w1 = wave()                                   # warm
+        reg = obs.registry()
+        before = (reg.histogram("compile.ms").count,
+                  reg.counter("serve.decode_bucket_compiles").value,
+                  reg.counter("serve.prefill_bucket_compiles").value)
+        w2 = wave()                                   # steady state
+        after = (reg.histogram("compile.ms").count,
+                 reg.counter("serve.decode_bucket_compiles").value,
+                 reg.counter("serve.prefill_bucket_compiles").value)
+        assert after == before, \
+            f"sampled steady state compiled: {before} -> {after}"
+        assert w1 == w2                               # seeded determinism
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache: shared pages, refcounts, copy-on-write
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_shared_prefill_parity(setup, sink):
+    """Requests sharing a two-page system prompt reuse its cached KV
+    pages (suffix-only prefill) and still decode token-identical to the
+    full-recompute oracle; the cache counts hits and prefill tokens
+    saved."""
+    model, cfg, params, _, _ = setup
+    rng = np.random.RandomState(11)
+    sysp = [int(t) for t in rng.randint(0, cfg.vocab_size, size=16)]
+    prompts = [sysp + [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                                   size=4)]
+               for _ in range(3)]
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8,
+                           prefix_cache=True, debug_invariants=True)
+    try:
+        assert eng.generate(prompts, GEN) == refs_for(model, params,
+                                                      prompts)
+        assert eng.prefix_hits >= 1
+        assert eng.prefix_tokens_saved >= 16
+        assert obs.registry().counter("serve.prefix_hits").value >= 1
+    finally:
+        eng.close()
+
+
+def test_prefix_cache_cow_divergent_continuations(setup):
+    """Copy-on-write correctness: a shared prefix ending mid-page is
+    copied before the diverging request writes into it — every
+    continuation matches its unshared reference exactly (a stronger pin
+    than the 1e-6 budget), and the engine actually took the CoW path."""
+    model, cfg, params, _, _ = setup
+    rng = np.random.RandomState(13)
+    # 12 shared tokens = 1 full page + half a page on page_size=8:
+    # the second admission's suffix starts mid-page => admit-time CoW
+    sysp = [int(t) for t in rng.randint(0, cfg.vocab_size, size=12)]
+    prompts = [sysp + [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                                   size=5)]
+               for _ in range(2)]
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8,
+                           prefix_cache=True, debug_invariants=True)
+    try:
+        assert eng.generate(prompts, GEN) == refs_for(model, params,
+                                                      prompts)
+        assert eng.cow_copies >= 1
+    finally:
+        eng.close()
+
+
+def test_page_pool_invariant_preempt_readmit_exhaustion(setup, sink):
+    """The round-16 accounting regression: preempted-then-readmitted
+    slots release and re-acquire pages through the refcount discipline.
+    ``debug_invariants`` audits free + referenced == total (with exact
+    per-holder refcounts) after EVERY step, through preemption,
+    readmission, and pool exhaustion, with the prefix cache holding
+    references of its own."""
+    model, cfg, params, _, _ = setup
+    rng = np.random.RandomState(17)
+    sysp = [int(t) for t in rng.randint(0, cfg.vocab_size, size=8)]
+    prompts = [sysp + [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                                   size=2 + i)]
+               for i in range(3)]
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8,
+                           max_seq_len=32, pool_pages=7,
+                           prefix_cache=True, debug_invariants=True)
+    try:
+        assert eng.generate(prompts, 16) == refs_for(model, params,
+                                                     prompts, 16)
+        assert obs.registry().counter("serve.preempted").value >= 1
+        eng._check_invariants()
+    finally:
+        eng.close()
+
+
+def test_page_pool_check_catches_drift():
+    """PagePool.check is a real audit: a refcount the engine cannot
+    explain fails loudly."""
+    from distributedtraining_tpu.engine.serve import PagePool
+    pool = PagePool(5)
+    pages = pool.alloc(2)
+    pool.check({pages[0]: 1, pages[1]: 1})       # honest books balance
+    pool.incref(pages[0])
+    with pytest.raises(AssertionError):
+        pool.check({pages[0]: 1, pages[1]: 1})   # drifted books do not
+    pool.decref(pages[0])
+    pool.decref(pages[0])
+    pool.decref(pages[1])
+    pool.check({})
+
+
+# ---------------------------------------------------------------------------
+# HTTP admission control: 429 on shed, 503 on drain
+# ---------------------------------------------------------------------------
+
+def test_http_shed_429_with_retry_after(setup):
+    """Past --max-queue the frontend sheds with 429 + Retry-After
+    instead of queueing the caller into the latency knee."""
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8,
+                           max_queue=1)
+    fe = ServeHTTPFrontend(eng, 0, timeout_s=30.0)
+    port = fe.start()
+    try:
+        eng.submit(prompts[0], 4)        # no loop running: stays queued
+        body = json.dumps({"tokens": prompts[1]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 429
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        assert eng.shed_count == 1
+    finally:
+        fe.close()
+        eng.close()
+
+
+def test_http_drain_503_during_swap(setup):
+    """While a drain-policy swap waits on in-flight sequences, new HTTP
+    requests get 503 + Retry-After (come back on the new revision), not
+    an indefinite queue slot."""
+    model, cfg, params, params2, prompts = setup
+    eng = GenerationEngine(model, params, revision="r1", max_slots=2,
+                           page_size=8, swap_policy="drain")
+    fe = ServeHTTPFrontend(eng, 0, timeout_s=30.0)
+    port = fe.start()
+    try:
+        eng.submit(prompts[0], GEN)
+        eng.step()                       # admit: one sequence in flight
+        eng._pending_swap = ("r2", jax.device_put(params2))
+        body = json.dumps({"tokens": prompts[1]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+    finally:
+        fe.close()
+        eng.close()
+
+
+def test_http_sampling_params_round_trip(setup):
+    """temperature/top_p/seed ride the POST body; the same seed returns
+    the same stream on a second identical request."""
+    model, cfg, params, _, prompts = setup
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8)
+    loop = ServeLoop(eng, idle_poll_s=0.02).start()
+    fe = ServeHTTPFrontend(eng, 0, timeout_s=60.0)
+    port = fe.start()
+    try:
+        body = json.dumps({"tokens": prompts[0], "max_new_tokens": 8,
+                           "temperature": 0.9, "top_p": 0.95,
+                           "seed": 7}).encode()
+        outs = []
+        for _ in range(2):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                outs.append(json.loads(resp.read())["tokens"])
+        assert outs[0] == outs[1]
+        assert outs[0] != reference_generate(model, params, prompts[0], 8)
+    finally:
+        fe.close()
+        loop.close()
+        eng.close()
+
+
+def test_prefix_cache_flushed_on_hot_swap(setup, sink):
+    """A base-revision swap invalidates the prefix cache: cached KV is a
+    function of the params that produced it, so post-swap shared-prefix
+    requests must re-prefill under the NEW params and match the new
+    revision's oracle exactly — never reuse revision-1 pages."""
+    model, cfg, params1, params2, _ = setup
+    rng = np.random.RandomState(17)
+    sysp = [int(t) for t in rng.randint(0, cfg.vocab_size, size=16)]
+    prompts = [sysp + [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                                   size=4)]
+               for _ in range(2)]
+    eng = GenerationEngine(model, params1, revision="r1", max_slots=2,
+                           page_size=8, prefix_cache=True,
+                           debug_invariants=True)
+    try:
+        # warm the cache under params1 (second request hits the prefix)
+        assert eng.generate(prompts, GEN) == refs_for(model, params1,
+                                                      prompts)
+        assert eng.prefix_hits >= 1
+        assert len(eng._cache) > 0
+        eng._pending_swap = ("r2", jax.device_put(params2))
+        eng.step()                          # idle engine: swap lands now
+        assert eng.revision == "r2"
+        assert len(eng._cache) == 0         # stale entries flushed...
+        assert obs.registry().counter("serve.prefix_flushes").value == 1
+        # ...and their pool references released (books still balance)
+        eng._check_invariants()
+        # the same shared-prefix traffic now decodes on params2 exactly
+        assert eng.generate(prompts, GEN) == refs_for(model, params2,
+                                                      prompts)
+        assert eng.prefix_hits >= 2         # cache rebuilt and hit again
+    finally:
+        eng.close()
