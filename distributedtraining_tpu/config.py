@@ -129,6 +129,9 @@ class RunConfig:
     serve_max_seq: int = 0                   # cache len cap (0 = model max)
     serve_max_queue: int = 0                 # shed past this depth (0 = off)
     serve_prefix_cache: bool = True          # shared-prefix KV page reuse
+    serve_speculative: bool = False          # draft-verify speculative decode
+    serve_draft_k: int = 4                   # drafted tokens per slot/step
+    serve_draft_repo: str = ""               # draft base: "preset@work_dir"
     swap_policy: str = "drain"               # drain | restart
     swap_poll: float = 15.0                  # base-revision poll (seconds)
 
@@ -606,6 +609,29 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                             "(refcounted pages + copy-on-write; on by "
                             "default — common system prompts prefill "
                             "once per server, not once per request)")
+        g.add_argument("--speculative", dest="serve_speculative",
+                       action="store_true",
+                       default=d.serve_speculative,
+                       help="speculative decoding: a small fleet-trained "
+                            "draft proposes --draft-k tokens per slot per "
+                            "step and one batched verify pass scores them "
+                            "(provably lossless — output is bit-identical "
+                            "to plain decode; off by default)")
+        g.add_argument("--no-speculative", dest="serve_speculative",
+                       action="store_false",
+                       help="force speculative decoding off")
+        g.add_argument("--draft-k", dest="serve_draft_k", type=int,
+                       default=d.serve_draft_k,
+                       help="drafted tokens per slot per speculative "
+                            "step (tokens per verify ≈ 1 + accept_rate·K)")
+        g.add_argument("--draft-repo", dest="serve_draft_repo",
+                       default=d.serve_draft_repo,
+                       help="draft base source as 'preset@work_dir' — a "
+                            "second transport watching that deployment's "
+                            "fleet-averaged revisions feeds the drafter's "
+                            "hot-swap lane (empty: self-draft from the "
+                            "serving transport, only useful for smoke "
+                            "tests)")
         g.add_argument("--swap-policy", dest="swap_policy",
                        choices=("drain", "restart"),
                        default=d.swap_policy,

@@ -1445,6 +1445,11 @@ DEFAULT_GATES = {
     # the single-server baseline by at least this factor — the
     # FLEETSIM_r01 collapse curve is the regression test
     "router_knee_ttft_gain_min": 2.0,
+    # speculative load phase (--speculative): admitted-request tpot p95
+    # at the baseline's knee rate must improve by at least this factor
+    # over the non-speculating baseline scorecard — drafting must buy
+    # real per-token latency, not just an acceptance-rate vanity number
+    "spec_tpot_gain_min": 1.2,
     # baseline-relative regression caps (only applied with --baseline)
     "baseline_parity_ratio_max": 1.5,
     "baseline_pr_drop_max": 0.05,
@@ -1723,6 +1728,12 @@ def evaluate_gates(card: dict, *, gates: dict | None = None,
             out["serving"]["router"] = True
             out["serving"]["shed_total"] = int(
                 sum(p.get("shed", 0) for p in pts))
+        if any(p.get("speculative") for p in pts):
+            out["serving"]["speculative"] = True
+            accs = [p["spec_accept_rate"] for p in pts
+                    if p.get("spec_accept_rate") is not None]
+            if accs:
+                out["serving"]["spec_accept_rate_min"] = round(min(accs), 4)
     if baseline is not None:
         out["baseline"] = _baseline_gate(card, baseline, g)
     return out
@@ -1773,8 +1784,13 @@ def _baseline_gate(card: dict, baseline: dict, g: dict) -> dict:
                f"ttft p99 @ {p['rate_rps']} rps")
     out = {"ok": not problems, "problems": problems}
     gain_min = g.get("router_knee_ttft_gain_min", 0.0)
+    # the knee gain is ROUTED vs SINGLE-SERVER: once the baseline is
+    # itself a routed scorecard the collapse curve is already gone and
+    # there is nothing to beat — the per-rate ttft ratio caps above
+    # still guard routed-vs-routed regressions
     common = [r for r, p in cur_pts.items()
-              if p.get("router") and r in base_pts]
+              if p.get("router") and r in base_pts
+              and not base_pts[r].get("router")]
     if common and gain_min > 0:
         # the knee is the baseline's WORST measured point — its highest
         # rate the routed run also offered; the routed admitted-only
@@ -1796,6 +1812,34 @@ def _baseline_gate(card: dict, baseline: dict, g: dict) -> dict:
                 f"router knee ttft p99 gain {gain:.2f}x @ {knee} rps "
                 f"< required {gain_min:g}x (baseline {base_p99:.1f}ms, "
                 f"routed {cur_p99:.1f}ms)")
+            out["ok"] = False
+    spec_gain_min = g.get("spec_tpot_gain_min", 0.0)
+    # speculative knee: like router_knee but on tpot p95 — drafting is
+    # a per-token-latency optimization, so the gated number is the
+    # admitted inter-token gap at the baseline's worst common rate,
+    # against a baseline that was NOT speculating
+    spec_common = [r for r, p in cur_pts.items()
+                   if p.get("speculative") and r in base_pts
+                   and not base_pts[r].get("speculative")]
+    if spec_common and spec_gain_min > 0:
+        knee = max(spec_common)
+        cur_tpot = cur_pts[knee].get("tpot_ms", {}).get("p95",
+                                                        float("inf"))
+        base_tpot = base_pts[knee].get("tpot_ms", {}).get("p95", 0.0)
+        gain = base_tpot / max(cur_tpot, 1e-9) if base_tpot else 0.0
+        out["spec_knee"] = {
+            "rate_rps": knee,
+            "baseline_tpot_p95_ms": base_tpot,
+            "spec_tpot_p95_ms": cur_tpot,
+            "gain": round(gain, 3),
+            "gain_min": spec_gain_min,
+            "accept_rate": cur_pts[knee].get("spec_accept_rate"),
+        }
+        if gain < spec_gain_min:
+            problems.append(
+                f"speculative knee tpot p95 gain {gain:.2f}x @ {knee} "
+                f"rps < required {spec_gain_min:g}x (baseline "
+                f"{base_tpot:.2f}ms, speculative {cur_tpot:.2f}ms)")
             out["ok"] = False
     return out
 

@@ -75,6 +75,20 @@ Round 16 adds the under-load story on top (docs/serving.md):
   BEFORE the queueing knee instead of manufacturing ttft collapse
   (engine/router.py spreads and sheds across N such servers).
 
+Round 17 adds **speculative decoding** (engine/speculative.py + the
+``draft=``/``draft_k=`` engine knobs): a small fleet-trained drafter
+proposes K tokens per slot per step, ONE batched ``serve.verify`` pass
+scores all K+1 positions per slot (the multi-token twin of
+``serve.decode`` — same model ``kv_pages`` hook, same paged-attention
+path ``serve.prefill_ctx`` rides, same (slot, page) bucket keys), and
+each slot commits the longest proposal prefix matching the target's own
+per-position picks. Because the sampler is a counter PRNG
+(``fold_in(seed, token_index)``), those picks ARE the tokens the plain
+path would emit — speculative output is provably lossless and
+bit-identical to spec-off streams, for greedy and sampled lanes alike.
+Rollback is length bookkeeping, the drafter has its own hot-swap lane,
+and a missing/stale/broken drafter degrades to plain decode.
+
 Everything is exposed through the PR-3 obs registry as ``serve.*`` and
 scraped by the PR-5 exporter as ``dt_serve_*`` gauges.
 """
@@ -156,6 +170,9 @@ class _Slot:
     order: int           # admission order (preemption picks the youngest)
     last_emit_t: float = 0.0   # perf_counter at the last emitted token
     #                            (drives the per-token serve.tpot_ms)
+    spec_window: int = 0  # drafts allowed THIS step (set by _grow: the
+    #                       pages for seq_len..seq_len+spec_window are
+    #                       owned exclusively; 0 = plain-decode lane)
 
 
 # ---------------------------------------------------------------------------
@@ -629,7 +646,9 @@ class GenerationEngine:
                  watcher: BaseRevisionWatcher | None = None,
                  max_queue: int = 0,
                  prefix_cache: bool = False,
-                 debug_invariants: bool = False):
+                 debug_invariants: bool = False,
+                 draft=None,
+                 draft_k: int = 4):
         if swap_policy not in ("drain", "restart"):
             raise ValueError(f"swap_policy must be drain|restart, "
                              f"got {swap_policy!r}")
@@ -674,6 +693,27 @@ class GenerationEngine:
         self._prefill_ladder = BucketLadder(self.pages_per_slot,
                                             prefer_compiled=prefer_compiled)
         self.prefer_compiled = prefer_compiled
+
+        # speculative decoding (engine/speculative.py): a drafter
+        # proposes up to draft_k tokens per slot per step and ONE
+        # serve.verify pass scores all K+1 positions; W = draft_k + 1
+        # is baked static into the verify program family so mixed
+        # drafting/non-drafting batches ride the same (slot, page) keys
+        if draft is not None:
+            if draft_k < 1:
+                raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+            if hasattr(draft, "model"):
+                from . import speculative as _spec
+                reason = _spec.compat_reason(draft.model, cfg)
+                if reason:
+                    raise ValueError(f"incompatible draft model: {reason}")
+        self._draft = draft
+        self.draft_k = int(draft_k)
+        self._verify_progs: dict[tuple[int, int], Callable] = {}
+        self._verify_seen: set[tuple[int, int]] = set()
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_rounds = 0
 
         self._decode_progs: dict[tuple[int, int], Callable] = {}
         self._prefill_progs: dict[int, Callable] = {}
@@ -827,6 +867,22 @@ class GenerationEngine:
     @property
     def prefix_tokens_saved(self) -> int:
         return self._cache.tokens_saved if self._cache is not None else 0
+
+    @property
+    def speculative(self) -> bool:
+        return self._draft is not None
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Cumulative fraction of drafted tokens the verify pass
+        accepted — the single number that decides whether speculation
+        pays (tokens per verify ≈ 1 + rate·K)."""
+        return (self._spec_accepted / self._spec_proposed
+                if self._spec_proposed else 0.0)
+
+    @property
+    def spec_rounds(self) -> int:
+        return self._spec_rounds
 
     # -- admission control --------------------------------------------------
     def admission_state(self) -> tuple[str, float]:
@@ -1026,6 +1082,72 @@ class GenerationEngine:
         self._prefill_ctx_progs[(t_bucket, pb)] = prog
         return prog
 
+    def _verify_prog(self, n_slots: int, n_pages: int) -> Callable:
+        """The speculative verify pass: score W = draft_k + 1 positions
+        per slot in ONE batched forward — position 0 consumes
+        ``last_tok`` (exactly what plain decode would), positions
+        1..k_i consume that slot's draft proposals, padded lanes beyond
+        ``n_input`` scatter to trash page 0. The multi-token forward is
+        the same suffix-prefill machinery ``serve.prefill_ctx`` uses
+        (the model's ``kv_pages`` hook; Tq>1 rides the XLA twin of the
+        Pallas paged-attention kernel — no new attention path), batched
+        over slots on the SAME (slot, page) buckets as serve.decode.
+
+        The pick at window position w is the token the PLAIN path would
+        emit at stream index ``tok_idx0 + w`` given the tokens before
+        it: greedy lanes argmax, sampled lanes run the identical seeded
+        top-p draw at the identical counter index. Acceptance on the
+        host is therefore prefix-matching proposals against these picks
+        — the accept/resample rule under a counter PRNG whose draw is a
+        pure function of (seed, index), which is what makes speculative
+        output BIT-identical to the spec-off stream, not merely
+        same-distribution."""
+        prog = self._verify_progs.get((n_slots, n_pages))
+        if prog is not None:
+            return prog
+        model, P, vocab = self.model, self.page_size, self.cfg.vocab_size
+        L = len(self._layers)
+        W = self.draft_k + 1
+        cap = self.max_seq_len
+        stack_kv = self._stack_kv
+
+        def verify(params, k_pages, v_pages, page_tables, seq_lens,
+                   tokens, n_input, temps, top_ps, seeds, tok_idx0):
+            kv_pages = tuple((k_pages[i], v_pages[i]) for i in range(L))
+            pos = seq_lens[:, None] + jnp.arange(W)[None, :]   # [B, W]
+            logits, muts = model.apply(
+                {"params": params}, tokens,
+                position_ids=jnp.minimum(pos, cap - 1),
+                kv_pages=kv_pages, page_tables=page_tables,
+                kv_lens=seq_lens,
+                sow_kv=True, mutable=["intermediates"])
+            new_k, new_v = stack_kv(muts["intermediates"])  # [L,B,W,H,D]
+            valid = jnp.arange(W)[None, :] < n_input[:, None]
+            page_idx = jnp.where(
+                valid,
+                jnp.take_along_axis(
+                    page_tables, jnp.minimum(pos // P, n_pages - 1),
+                    axis=1),
+                0)                                          # [B, W]
+            off = pos % P
+            k_pages = k_pages.at[:, page_idx, off].set(new_k)
+            v_pages = v_pages.at[:, page_idx, off].set(new_v)
+            flat = logits[:, :, :vocab].reshape(n_slots * W, vocab)
+            tok_idx = (tok_idx0[:, None]
+                       + jnp.arange(W)[None, :]).reshape(-1)
+            picks = _sample_from_logits(
+                flat, jnp.repeat(temps, W), jnp.repeat(top_ps, W),
+                jnp.repeat(seeds, W), tok_idx)
+            return picks.reshape(n_slots, W), k_pages, v_pages
+
+        prog = devprof.wrap(
+            "serve.verify",
+            jax.jit(verify,
+                    donate_argnums=(1, 2) if self._donate else ()),
+            bucket=f"{n_slots}x{n_pages}")
+        self._verify_progs[(n_slots, n_pages)] = prog
+        return prog
+
     def _sample_tok(self, row, req: ServeRequest, idx: int) -> int:
         """Draw one token from a prefill logits row through the shared
         sampling math (``serve.sample_tok`` — one bucket-free program,
@@ -1105,6 +1227,12 @@ class GenerationEngine:
         for p in slot.pages:
             self.pool.decref(p)
         slot.pages = []
+        if self._draft is not None:
+            # every slot exit — finish, preemption, restart-swap
+            # requeue — drops the drafter's per-request state with it:
+            # draft KV for a stream that is no longer committed must
+            # never survive to propose against a different future
+            self._draft.drop(slot.req.rid)
 
     def _finish(self, slot: _Slot, status: str) -> None:
         self._admit_hold = False
@@ -1134,7 +1262,30 @@ class GenerationEngine:
         return True
 
     # -- hot swap -----------------------------------------------------------
+    def _maybe_swap_draft(self) -> None:
+        """The drafter's own hot-swap lane: a new fleet-averaged draft
+        revision installs between steps. ``install_params`` flushes ALL
+        draft KV (it is a pure function of draft params, exactly like
+        the prefix cache under a target swap); live requests re-prefill
+        their draft context at the next propose. No drain needed —
+        proposals never cross a step boundary, and a flushed drafter
+        can only lower acceptance, never correctness."""
+        draft = self._draft
+        watcher = getattr(draft, "watcher", None) \
+            if draft is not None else None
+        if watcher is None:
+            return
+        staged = watcher.take_pending()
+        if staged is None:
+            return
+        rev, placed = staged
+        draft.install_params(placed, revision=rev)
+        obs.count("serve.spec_draft_swaps")
+        flight.record("swap", outcome="draft_swapped", revision=rev or "")
+        logger.info("hot-swapped draft to revision %s", rev)
+
     def _maybe_swap(self) -> None:
+        self._maybe_swap_draft()
         if self.watcher is not None:
             staged = self.watcher.take_pending()
             if staged is not None:
@@ -1145,6 +1296,13 @@ class GenerationEngine:
             # in-flight sequences restart from their prompts on the new
             # revision; their pages go back to the pool first
             for slot in list(self._active):
+                if self._draft is not None:
+                    # mid-speculation target swap: this slot's draft
+                    # state (and any proposal it would seed) was built
+                    # against output of the OLD params — _release drops
+                    # it; counted so the swap/spec interaction is
+                    # observable
+                    obs.count("serve.spec_invalidations")
                 self._release(slot)
                 self._active.remove(slot)
                 self._requeue_front(slot.req)
@@ -1348,35 +1506,176 @@ class GenerationEngine:
             # length check makes this unreachable, kept as a hard stop
             self._finish(slot, "truncated")
 
+    def _spec_horizon(self, slot: _Slot) -> int:
+        """How many tokens this slot may draft this step: capped by
+        draft_k, by the tokens it still owes (drafting past
+        max_new_tokens is wasted verify work — the run stops at the
+        budget anyway), and by cache capacity (the verify window writes
+        rows seq_len..seq_len+k, all of which must exist)."""
+        if self._draft is None or not getattr(self._draft, "ready", False):
+            return 0
+        rem = slot.req.max_new_tokens - len(slot.req.tokens) - 1
+        cap = self.max_seq_len - 1 - slot.seq_len
+        return max(0, min(self.draft_k, rem, cap))
+
+    def _grow_for_window(self, slot: _Slot, window: int) -> bool:
+        """Pages + write exclusivity for the rows this step scatters:
+        positions seq_len..seq_len+window (window 0 = the plain decode
+        write, the pre-speculation contract verbatim). Every page in
+        the window that is still shared (refcount > 1) is
+        copy-on-write'd BEFORE any multi-token commit can bleed into a
+        sibling's or the prefix cache's rows. False on pool exhaustion
+        — no preemption here, the caller decides how hard to push."""
+        P = self.page_size
+        need = (slot.seq_len + window) // P + 1
+        while len(slot.pages) < need:
+            got = self._alloc_pages(1)
+            if got is None:
+                return False
+            slot.pages.extend(got)
+        for wp in range(slot.seq_len // P, need):
+            while self.pool.refs(slot.pages[wp]) > 1:
+                if not self._cow_page(slot, wp):
+                    return False
+        return True
+
     def _grow(self) -> None:
-        """Ensure every active slot owns the page its next write lands
-        in — exclusively: a shared (refcount > 1) write page is
-        copy-on-write'd before the decode scatter touches it. Preempt
-        the youngest sequence when the pool runs dry."""
+        """Ensure every active slot owns the pages this step's writes
+        land in — exclusively. Speculative slots ask for their whole
+        draft window first; under pool pressure the window shrinks to 0
+        (that slot rides the verify pass as a plain-decode lane) before
+        anyone gets preempted — losing speculation for a step is free,
+        losing a sequence's pages is not. Preemption of the youngest
+        remains the final escape hatch, exactly as before."""
         for slot in list(self._active):
             if slot not in self._active:
                 continue   # preempted by an earlier slot's growth
-            need = slot.seq_len // self.page_size + 1
-            while len(slot.pages) < need:
-                got = self._alloc_pages(1)
-                if got is not None:
-                    slot.pages.extend(got)
+            slot.spec_window = self._spec_horizon(slot)
+            while slot in self._active:
+                if self._grow_for_window(slot, slot.spec_window):
+                    break
+                if slot.spec_window:
+                    slot.spec_window = 0
                     continue
                 if not self._preempt_one(protect=slot):
                     # nothing left to steal from: cut this one short
                     self._finish(slot, "truncated")
                     break
-            if slot not in self._active:
-                continue
-            wp = slot.seq_len // self.page_size
-            while wp < len(slot.pages) and self.pool.refs(slot.pages[wp]) > 1:
-                if self._cow_page(slot, wp):
-                    break
-                if not self._preempt_one(protect=slot):
-                    self._finish(slot, "truncated")
-                    break
 
     def _decode(self) -> int:
+        if not self._active:
+            return 0
+        if self._draft is not None:
+            if getattr(self._draft, "ready", False):
+                return self._decode_spec()
+            # stale or missing draft (e.g. the fleet has not published
+            # a draft base yet): degrade to plain decode — never to
+            # wrong output
+            obs.count("serve.spec_fallbacks")
+        return self._decode_plain()
+
+    def _decode_spec(self) -> int:
+        """One speculative round: the drafter proposes up to
+        ``spec_window`` tokens per slot, ONE ``serve.verify`` dispatch
+        scores every slot's K+1 window, and each slot commits the
+        longest prefix of its proposals that matches the target's own
+        picks plus the target's pick at the first divergence (the plain
+        decode token when nothing was drafted or nothing matched — a
+        zero-accept round IS a plain decode step). Commit is pure
+        length bookkeeping: ``seq_len += accepted + 1``; the verify
+        rows past it hold rejected-input KV, stay masked behind
+        ``kv_lens``, and are overwritten when those positions are fed
+        again."""
+        active = self._active
+        draft = self._draft
+        t0 = time.perf_counter()
+        proposals: dict[int, list] = {}
+        if any(s.spec_window > 0 for s in active):
+            try:
+                proposals = draft.propose(active) or {}
+            except Exception:
+                # a broken drafter must never break serving: this round
+                # verifies an empty window (= plain decode)
+                logger.exception("draft propose failed; "
+                                 "plain-decoding this step")
+                obs.count("serve.spec_fallbacks")
+                proposals = {}
+        obs.observe("serve.spec_draft_ms",
+                    (time.perf_counter() - t0) * 1e3)
+        plan = {s.req.rid: [int(t) for t in
+                            proposals.get(s.req.rid, [])][:s.spec_window]
+                for s in active}
+        W = self.draft_k + 1
+        t1 = time.perf_counter()
+        P = self.page_size
+        need_pages = max(
+            (s.seq_len + len(plan[s.req.rid])) // P + 1 for s in active)
+        sb, pb = self._decode_bucket(len(active), need_pages,
+                                     self._verify_progs)
+        tables = np.zeros((sb, pb), np.int32)
+        seq_lens = np.zeros((sb,), np.int32)
+        tokens = np.zeros((sb, W), np.int32)
+        n_input = np.zeros((sb,), np.int32)
+        temps = np.zeros((sb,), np.float32)
+        top_ps = np.ones((sb,), np.float32)
+        seeds = np.zeros((sb,), np.int32)
+        tok_idx0 = np.zeros((sb,), np.int32)
+        for i, slot in enumerate(active):
+            props = plan[slot.req.rid]
+            row = slot.pages[:pb]
+            tables[i, :len(row)] = row
+            seq_lens[i] = slot.seq_len
+            tokens[i, 0] = slot.last_tok
+            if props:
+                tokens[i, 1:1 + len(props)] = props
+            n_input[i] = 1 + len(props)
+            temps[i] = slot.req.temperature
+            top_ps[i] = slot.req.top_p
+            seeds[i] = slot.req.seed & 0x7FFFFFFF
+            tok_idx0[i] = len(slot.req.tokens)
+        prog = self._verify_prog(sb, pb)
+        k_pages, v_pages = self._kv
+        self._slot_ladder.mark(sb)
+        self._page_ladder.mark(pb)
+        args = (self._params, k_pages, v_pages, tables, seq_lens, tokens,
+                n_input, temps, top_ps, seeds, tok_idx0)
+        if (sb, pb) not in self._verify_seen:
+            self._verify_seen.add((sb, pb))
+            obs.count("serve.decode_bucket_compiles")
+            picks, k_pages, v_pages = _timed_compile(prog, *args)
+        else:
+            picks, k_pages, v_pages = prog(*args)
+        self._kv = (k_pages, v_pages)
+        picks = np.asarray(jax.device_get(picks))
+        obs.observe("serve.spec_verify_ms",
+                    (time.perf_counter() - t1) * 1e3)
+        emitted = 0
+        for i, slot in enumerate(list(active)):
+            props = plan[slot.req.rid]
+            j = 0
+            while j < len(props) and props[j] == int(picks[i, j]):
+                j += 1
+            if props:
+                self._spec_proposed += len(props)
+                self._spec_accepted += j
+                obs.count("serve.spec_proposed_tokens", len(props))
+                obs.count("serve.spec_accepted_tokens", j)
+            for tok in props[:j] + [int(picks[i, j])]:
+                slot.seq_len += 1
+                slot.last_tok = tok
+                self._emit(slot, tok)
+                emitted += 1
+                if slot.req.status != "active":
+                    break   # eos/budget hit inside the accepted run
+            if slot.req.status == "active":
+                draft.commit(slot.req.rid,
+                             list(slot.req.prompt) + list(slot.req.tokens))
+        self._spec_rounds += 1
+        if self._spec_proposed:
+            obs.gauge("serve.spec_accept_rate", self.spec_accept_rate)
+        return emitted
+
+    def _decode_plain(self) -> int:
         active = self._active
         if not active:
             return 0
@@ -1481,6 +1780,8 @@ class GenerationEngine:
             for p in self._cache.pages():
                 expected[p] = expected.get(p, 0) + 1
         self.pool.check(expected)
+        if self._draft is not None:
+            self._draft.check()
 
     # -- conveniences -------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]],
@@ -1503,6 +1804,8 @@ class GenerationEngine:
     def close(self) -> None:
         if self.watcher is not None:
             self.watcher.close()
+        if self._draft is not None:
+            self._draft.close()
         for slot in list(self._active):
             self._finish(slot, "truncated")
         with self._qlock:
@@ -1612,6 +1915,11 @@ class ServeHTTPFrontend:
                         "shed": e.shed_count}
                     if e.prefix_hits + e.prefix_misses > 0:
                         out["prefix_hit_rate"] = e.prefix_hit_rate
+                    if e.speculative:
+                        # drafter-aware health: the router scales a
+                        # backend's effective speed by its acceptance
+                        out["spec_accept_rate"] = e.spec_accept_rate
+                        out["spec_k"] = e.draft_k
                     for key, metric in (("ttft_ms_p95", "serve.ttft_ms"),
                                         ("tpot_ms_p95", "serve.tpot_ms")):
                         if metric in names and \

@@ -332,6 +332,22 @@ def make_model(preset_or_cfg) -> tuple[GPT2, GPT2Config]:
     return GPT2(cfg), cfg
 
 
+def draft_compat(cfg: GPT2Config, target_cfg) -> str | None:
+    """Speculative-serving hook (engine/speculative.py): why a GPT-2
+    with this config cannot DRAFT for a target with ``target_cfg``
+    (None = compatible). Proposals are raw token ids the target scores
+    verbatim, so the REAL vocabularies must match exactly — the padded
+    device vocab may differ freely (sampling slices to ``vocab_size``).
+    The drafter's position capacity is a soft limit (the draft engine
+    stops proposing past it), not a compatibility failure."""
+    tv = getattr(target_cfg, "vocab_size", None)
+    if cfg.vocab_size != tv:
+        return (f"draft vocab_size {cfg.vocab_size} != target "
+                f"vocab_size {tv}: proposal ids would not name the "
+                "same tokens")
+    return None
+
+
 def stack_blocks(params, n_layer: int, *, prefix: str = "h_",
                  scan_key: str = "h"):
     """Unrolled layout (``h_0..h_{L-1}``) -> scan layout (``h/block`` with a

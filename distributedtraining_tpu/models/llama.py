@@ -284,6 +284,21 @@ def make_model(preset_or_cfg) -> tuple[Llama, LlamaConfig]:
     return Llama(cfg), cfg
 
 
+def draft_compat(cfg: LlamaConfig, target_cfg) -> str | None:
+    """Speculative-serving hook (engine/speculative.py): why a Llama
+    with this config cannot DRAFT for a target with ``target_cfg``
+    (None = compatible). Token-id spaces must coincide — the fleet's
+    small GPT-2 base can draft for a Llama target exactly when both
+    were trained over the same tokenizer (equal REAL ``vocab_size``;
+    padded device vocab is irrelevant, sampling slices it off)."""
+    tv = getattr(target_cfg, "vocab_size", None)
+    if cfg.vocab_size != tv:
+        return (f"draft vocab_size {cfg.vocab_size} != target "
+                f"vocab_size {tv}: proposal ids would not name the "
+                "same tokens")
+    return None
+
+
 def stack_blocks(params, n_layer: int):
     """Unrolled ``layer_0..layer_{L-1}`` -> scan layout (``layers/block``)."""
     from .gpt2 import stack_blocks as _stack

@@ -110,6 +110,12 @@ PROGRAMS: dict[str, str] = {
     "serve.page_copy": "whole-page KV copy — the copy-on-write "
                        "primitive behind prefix sharing "
                        "(engine/serve.py)",
+    "serve.draft": "draft-model propose step / context prefill over "
+                   "the drafter's own paged KV pool "
+                   "(engine/speculative.py)",
+    "serve.verify": "speculative K+1-position batched verify pass — "
+                    "the multi-token twin of serve.decode on the same "
+                    "(slot,page) buckets (engine/serve.py)",
 }
 
 

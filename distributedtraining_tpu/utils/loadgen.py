@@ -290,12 +290,19 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
             n = len(rec["req"].tokens)
             if n <= rec["seen"]:
                 continue
-            for _ in range(n - rec["seen"]):
+            # a speculative step commits up to K+1 tokens in one tick;
+            # spread their emission times evenly across the step so tpot
+            # reflects the per-token pace, not a burst artifact. burst=1
+            # (plain decode) reduces to the old single-emit bookkeeping.
+            burst = n - rec["seen"]
+            pace = spec.step_ms / 1e3 / burst
+            for j in range(burst):
+                t_emit = now - (burst - 1 - j) * pace
                 if rec["last_emit"] is None:
-                    ttft_ms.append((now - rec["arrival_s"]) * 1e3)
+                    ttft_ms.append((t_emit - rec["arrival_s"]) * 1e3)
                 else:
-                    tpot_ms.append((now - rec["last_emit"]) * 1e3)
-                rec["last_emit"] = now
+                    tpot_ms.append((t_emit - rec["last_emit"]) * 1e3)
+                rec["last_emit"] = t_emit
             rec["seen"] = n
 
     while (i < len(arrivals) or not engine.idle) \
@@ -386,12 +393,19 @@ def run_open_loop_routed(engines, spec: OpenLoopSpec, *,
             n = len(rec["req"].tokens)
             if n <= rec["seen"]:
                 continue
-            for _ in range(n - rec["seen"]):
+            # a speculative step commits up to K+1 tokens in one tick;
+            # spread their emission times evenly across the step so tpot
+            # reflects the per-token pace, not a burst artifact. burst=1
+            # (plain decode) reduces to the old single-emit bookkeeping.
+            burst = n - rec["seen"]
+            pace = spec.step_ms / 1e3 / burst
+            for j in range(burst):
+                t_emit = now - (burst - 1 - j) * pace
                 if rec["last_emit"] is None:
-                    ttft_ms.append((now - rec["arrival_s"]) * 1e3)
+                    ttft_ms.append((t_emit - rec["arrival_s"]) * 1e3)
                 else:
-                    tpot_ms.append((now - rec["last_emit"]) * 1e3)
-                rec["last_emit"] = now
+                    tpot_ms.append((t_emit - rec["last_emit"]) * 1e3)
+                rec["last_emit"] = t_emit
             rec["seen"] = n
 
     while (i < len(arrivals)

@@ -71,6 +71,58 @@ def _await_base(cfg: RunConfig, c, watcher: BaseRevisionWatcher):
         time.sleep(cfg.swap_poll)
 
 
+def _build_drafter(cfg: RunConfig, c):
+    """Speculative drafter (``--speculative``): a :class:`DraftEngine`
+    around the small fleet-trained base named by ``--draft-repo``
+    ("preset@work_dir" — a second transport watches that deployment's
+    averaged revisions and feeds the drafter's hot-swap lane). Empty
+    ``--draft-repo`` self-drafts from the serving transport (smoke
+    only: a draft the target's own size saves nothing). Every failure
+    degrades to plain decode — a misconfigured drafter must never keep
+    the server from serving."""
+    if not cfg.serve_speculative:
+        return None
+    from distributedtraining_tpu.engine import speculative as _spec
+    from distributedtraining_tpu.models import gpt2, llama
+    try:
+        if cfg.serve_draft_repo:
+            preset, _, work_dir = cfg.serve_draft_repo.partition("@")
+            family = llama if preset in llama.PRESETS else gpt2
+            if preset not in family.PRESETS:
+                raise ValueError(f"unknown draft preset {preset!r}")
+            dmodel, _ = family.make_model(preset)
+            from distributedtraining_tpu.transport import LocalFSTransport
+            tr = LocalFSTransport(os.path.join(work_dir, "artifacts"))
+        else:
+            dmodel, tr = c.model, c.transport
+        reason = _spec.compat_reason(dmodel, c.model_cfg)
+        if reason:
+            logger.warning("drafter incompatible (%s); serving plain",
+                           reason)
+            return None
+        dwatcher = BaseRevisionWatcher(
+            tr, lambda: host_param_template(dmodel),
+            poll_s=max(cfg.swap_poll, 0.1))
+        draft = _spec.DraftEngine(
+            dmodel, max_slots=cfg.serve_slots,
+            page_size=cfg.serve_page_size, watcher=dwatcher)
+        # synchronous first pull so a draft base that is already
+        # published speculates from step one; otherwise the watcher
+        # thread installs it whenever it lands (plain decode until then)
+        if dwatcher.poll_once():
+            staged = dwatcher.take_pending()
+            if staged is not None:
+                draft.install_params(staged[1], revision=staged[0])
+        dwatcher.start()
+        logger.info("speculative decoding on: draft=%s k=%d ready=%s",
+                    cfg.serve_draft_repo or "<self>", cfg.serve_draft_k,
+                    draft.ready)
+        return draft
+    except Exception:
+        logger.exception("drafter construction failed; serving plain")
+        return None
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -99,7 +151,8 @@ def main(argv=None) -> int:
         eos_id=getattr(c.tokenizer, "eos_id", None),
         swap_policy=cfg.swap_policy, watcher=watcher,
         max_queue=cfg.serve_max_queue,
-        prefix_cache=cfg.serve_prefix_cache)
+        prefix_cache=cfg.serve_prefix_cache,
+        draft=_build_drafter(cfg, c), draft_k=cfg.serve_draft_k)
     watcher.start()
 
     # health plane: the server heartbeats its SERVED revision (the
@@ -118,6 +171,10 @@ def main(argv=None) -> int:
         # cache has seen traffic — fleet_report renders "-" otherwise
         if engine.prefix_hits + engine.prefix_misses > 0:
             out["prefix_hit_rate"] = engine.prefix_hit_rate
+        # speculative acceptance rides the heartbeat once drafting has
+        # actually verified tokens — fleet_report's acc_rate column
+        if engine.speculative and engine.spec_rounds > 0:
+            out["spec_accept_rate"] = engine.spec_accept_rate
         # request-level latency percentiles (engine/serve.py observes
         # serve.ttft_ms / serve.tpot_ms per token): ride the heartbeat
         # as numeric extras so fleet_report's ttft95/tpot95 columns show

@@ -39,7 +39,8 @@ import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
            "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "shed",
-           "pfx_hit", "published", "accepted", "declined", "stale_rounds",
+           "pfx_hit", "acc_rate", "published", "accepted", "declined",
+           "stale_rounds",
            "wire_b", "base_b", "mirror_hit", "score", "credit", "quar",
            "slo")
 
@@ -174,6 +175,12 @@ def _cell(node: dict, col: str) -> str:
         # prefix-cache hit rate: the fraction of admissions that reused
         # shared prompt-prefix KV pages (engine/serve.py PrefixCache)
         v = node.get("prefix_hit_rate")
+        return "-" if not isinstance(v, (int, float)) else f"{v:.2f}"
+    if col == "acc_rate":
+        # speculative acceptance: fraction of drafted tokens the target
+        # verified and committed (engine/speculative.py); "-" on servers
+        # that are not drafting or have not verified anything yet
+        v = node.get("spec_accept_rate")
         return "-" if not isinstance(v, (int, float)) else f"{v:.2f}"
     if col == "wire_b":
         # transport bytes the monitor role fetched staging this miner

@@ -89,14 +89,19 @@ def build_spec(args) -> "FleetSpec":
 
 def run_load_phase(rates, *, seed: int, duration_s: float,
                    servers: int = 0,
-                   max_backend_queue: int = 6) -> list[dict]:
+                   max_backend_queue: int = 6,
+                   speculative: bool = False,
+                   draft_k: int = 4) -> list[dict]:
     """The open-loop latency curve: one real GenerationEngine per rate
     (a fresh engine per point keeps the points independent — no warm
     queue bleeding between rates). With ``servers > 0`` each point runs
     ``servers`` engines behind the router policy + admission bound
     instead (prefix cache on — the routed fleet is the optimized
     serving plane): percentiles then cover ADMITTED requests and the
-    shed count is reported per point."""
+    shed count is reported per point. With ``speculative`` each engine
+    self-drafts through a DraftEngine on the same tiny model+params
+    (acceptance ~1.0 — this measures the multi-token commit plumbing,
+    gated by ``spec_tpot_gain_min`` against a plain baseline)."""
     import jax
 
     from distributedtraining_tpu.engine.serve import GenerationEngine
@@ -107,29 +112,51 @@ def run_load_phase(rates, *, seed: int, duration_s: float,
                           n_head=2, n_layer=2)
     model, cfg = gpt2.make_model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
+
+    def _engine(**kw):
+        if speculative:
+            from distributedtraining_tpu.engine.speculative import (
+                DraftEngine)
+            kw["draft"] = DraftEngine(model, params, max_slots=4,
+                                      page_size=8)
+            kw["draft_k"] = draft_k
+        return GenerationEngine(model, params, max_slots=4,
+                                page_size=8, **kw)
+
     points = []
     for rate in rates:
         spec = loadgen.OpenLoopSpec(rate_rps=float(rate),
                                     duration_s=duration_s, seed=seed)
         if servers > 0:
-            engines = [GenerationEngine(model, params, max_slots=4,
-                                        page_size=8, prefix_cache=True)
+            engines = [_engine(prefix_cache=True)
                        for _ in range(servers)]
             try:
                 points.append(loadgen.run_open_loop_routed(
                     engines, spec, max_backend_queue=max_backend_queue))
             finally:
+                if speculative:
+                    prop = sum(e._spec_proposed for e in engines)
+                    acc = sum(e._spec_accepted for e in engines)
                 for e in engines:
                     e.close()
         else:
-            engine = GenerationEngine(model, params, max_slots=4,
-                                      page_size=8)
+            engine = _engine()
             try:
                 points.append(loadgen.run_open_loop(engine, spec))
             finally:
+                if speculative:
+                    prop = engine._spec_proposed
+                    acc = engine._spec_accepted
                 engine.close()
         p = points[-1]
+        if speculative:
+            p["speculative"] = True
+            p["spec_k"] = draft_k
+            p["spec_accept_rate"] = round(acc / prop, 4) if prop else 0.0
         extra = (f" shed {p['shed']}" if p.get("router") else "")
+        if p.get("speculative"):
+            extra += (f" acc {p['spec_accept_rate']:.2f} "
+                      f"tpot p95 {p['tpot_ms']['p95']:.2f}ms")
         print(f"  load {rate:g} rps: offered {p['offered']} "
               f"completed {p['completed']} unfinished {p['unfinished']} "
               f"ttft p99 {p['ttft_ms']['p99']:.1f}ms{extra}",
@@ -166,6 +193,12 @@ def main(argv=None) -> int:
     ap.add_argument("--router-max-queue", type=int, default=6,
                     help="per-backend admission bound (queued + active) "
                          "before the router sheds")
+    ap.add_argument("--speculative", action="store_true",
+                    help="load-phase engines speculate through a "
+                         "self-draft DraftEngine (gates admitted tpot "
+                         "p95 vs a non-speculating --baseline)")
+    ap.add_argument("--draft-k", type=int, default=4,
+                    help="draft tokens proposed per speculative step")
     ap.add_argument("--out", default="FLEETSIM.json",
                     help="scorecard output path")
     ap.add_argument("--baseline",
@@ -224,7 +257,8 @@ def main(argv=None) -> int:
             load_points = run_load_phase(
                 rates, seed=spec.seed, duration_s=args.load_duration,
                 servers=args.router_servers,
-                max_backend_queue=args.router_max_queue)
+                max_backend_queue=args.router_max_queue,
+                speculative=args.speculative, draft_k=args.draft_k)
 
         card = fs.assemble_scorecard(result, control, load_points,
                                      gates=gates)
