@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import gc
 import json
 import os
 import time
@@ -1089,6 +1090,78 @@ def _time_serve(*, n_requests: int = 8, prompt_len: int = 16,
         pfx_saved = pfx_eng.prefix_tokens_saved
         pfx_eng.close()
 
+        # request-trace overhead lane (round-18 tentpole): ONE engine,
+        # with its TraceBook toggled every OTHER STEP. Two separately
+        # constructed engines disagree by ±6% from heap/dispatch-cache
+        # placement alone (a two-engine null test shows it), and even
+        # per-wave pairing wanders ±5% on a shared rig — so the A/B
+        # interleaves at the finest grain the workload has: adjacent
+        # full-batch steps, one traced, one not, inside the SAME
+        # generation (every trace site guards ``if self.trace is not
+        # None``, so mid-flight toggling is safe and output-invariant).
+        # Adjacent steps share the rig's instantaneous state; the
+        # median over a few hundred adjacent-pair ratios nulls to
+        # 1.000±0.01 on the same rig where wave medians read ±7%.
+        # Contract: <2% step-cost shift, ZERO fresh compiles in the
+        # timed window, bit-identical output with tracing on.
+        seq = ((T + 15) // 16) * 16
+        tr_eng = GenerationEngine(model, params, max_slots=n_requests,
+                                  page_size=16, max_seq_len=seq,
+                                  trace=True)
+        tr_book = tr_eng.trace
+        trace_parity = tr_eng.generate(prompts, gen_tokens) == ref
+        tr_eng.trace = None
+        trace_parity &= tr_eng.generate(prompts, gen_tokens) == ref
+        tr_eng.trace = tr_book
+
+        tr_ratios: list[float] = []
+        before = reg.histogram("compile.ms").count
+        gc_was_on = gc.isenabled()
+        try:
+            for w in range(24 * trials):
+                gc.collect()
+                gc.disable()
+                reqs = [tr_eng.submit(p, gen_tokens) for p in prompts]
+                i, prev = 0, None   # prev = (was_traced, duration)
+                while not all(r.done_evt.is_set() for r in reqs):
+                    # phase flips per wave so neither lane always
+                    # follows the admit/drain edges
+                    use_on = (i + w) % 2 == 1
+                    tr_eng.trace = tr_book if use_on else None
+                    full = len(tr_eng._active) == n_requests
+                    done0 = sum(r.done_evt.is_set() for r in reqs)
+                    t0 = time.perf_counter()
+                    tr_eng.step()
+                    d = time.perf_counter() - t0
+                    # only saturated steady-state decode steps are
+                    # comparable: admit/prefill and finish steps carry
+                    # per-REQUEST work that amortizes to ~0.15% of a
+                    # request's compute but would be sampled here as
+                    # one fat step in ~24
+                    pure = (full and done0 ==
+                            sum(r.done_evt.is_set() for r in reqs))
+                    if pure:
+                        if prev is not None and prev[0] != use_on:
+                            off_d, on_d = ((prev[1], d) if use_on
+                                           else (d, prev[1]))
+                            if off_d > 0:
+                                tr_ratios.append(on_d / off_d)
+                            prev = None
+                        else:
+                            prev = (use_on, d)
+                    else:
+                        prev = None
+                    i += 1
+                gc.enable()
+        finally:
+            if gc_was_on:
+                gc.enable()
+            tr_eng.trace = tr_book
+        trace_fresh = reg.histogram("compile.ms").count - before
+        tr_eng.close()
+        trace_overhead = (float(np.median(tr_ratios)) - 1.0
+                          if tr_ratios else 0.0)
+
         # the decode-attention kernel-vs-XLA micro A/B rides in the serve
         # record (round-20 tentpole): the engine-level numbers above
         # already RUN the kernel on TPU — this isolates its contribution
@@ -1115,6 +1188,9 @@ def _time_serve(*, n_requests: int = 8, prompt_len: int = 16,
             "serve_prefix_hit_rate": round(pfx_hit_rate, 3),
             "serve_prefill_tokens_saved": int(pfx_saved),
             "serve_prefix_parity": bool(pfx_parity),
+            "serve_trace_overhead_frac": round(trace_overhead, 4),
+            "serve_trace_fresh_compiles": int(trace_fresh),
+            "serve_trace_parity": bool(trace_parity),
         }
     finally:
         obs.reset()

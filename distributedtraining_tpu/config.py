@@ -132,6 +132,9 @@ class RunConfig:
     serve_speculative: bool = False          # draft-verify speculative decode
     serve_draft_k: int = 4                   # drafted tokens per slot/step
     serve_draft_repo: str = ""               # draft base: "preset@work_dir"
+    serve_trace: bool = True                 # request-scoped stage traces
+    serve_trace_exemplars: int = 4           # K slowest frozen per window
+    serve_trace_window: float = 30.0         # exemplar window (seconds)
     swap_policy: str = "drain"               # drain | restart
     swap_poll: float = 15.0                  # base-revision poll (seconds)
 
@@ -632,6 +635,20 @@ def build_parser(role: str) -> argparse.ArgumentParser:
                             "hot-swap lane (empty: self-draft from the "
                             "serving transport, only useful for smoke "
                             "tests)")
+        g.add_argument("--no-serve-trace", dest="serve_trace",
+                       action="store_false", default=d.serve_trace,
+                       help="disable request-scoped stage traces "
+                            "(utils/reqtrace.py: per-request lifecycle "
+                            "timelines, tail-exemplar freezes into the "
+                            "flight recorder, SLO burn-rate feed; on by "
+                            "default — host-side only, <2%% overhead)")
+        g.add_argument("--trace-exemplars", dest="serve_trace_exemplars",
+                       type=int, default=d.serve_trace_exemplars,
+                       help="K slowest ttft/tpot requests whose full "
+                            "timelines freeze per trace window")
+        g.add_argument("--trace-window", dest="serve_trace_window",
+                       type=_nonneg_float, default=d.serve_trace_window,
+                       help="tail-exemplar reservoir window, seconds")
         g.add_argument("--swap-policy", dest="swap_policy",
                        choices=("drain", "restart"),
                        default=d.swap_policy,

@@ -80,7 +80,7 @@ from ..transport.chaos import ChaosError, ChaosSpec, ChaosTransport
 from ..transport.memory import InMemoryTransport
 from ..utils import loadgen, obs
 from ..utils.flight import FlightRecorder, fetch_bundle
-from .health import FleetMonitor, build_heartbeat
+from .health import BurnRateMonitor, FleetMonitor, build_heartbeat
 from .lineage import (LineageError, QualityDriftDetector, build_record,
                       fetch_record, publish_record)
 from .remediate import (LeaseManager, RemediationEngine, StandbyAverager,
@@ -404,6 +404,16 @@ class FleetSpec:
     # scenario): fetchers must fail over to origin with no round loss.
     base_wire_v2: bool = True
     mirror_kill_round: int = 0
+    # injected serving-latency regression (the burn-rate alerting
+    # scenario, engine/health.py BurnRateMonitor): from this round on
+    # every server's synthetic request outcomes slow by
+    # ``latency_regression_factor`` — healthy ttft sits comfortably
+    # inside the 250ms objective, regressed ttft blows through it, and
+    # the gate asserts the multi-window rules PAGE within
+    # ``slo_burn_detect_rounds_max`` rounds with zero alerts on the
+    # clean control twin. 0 = never regress.
+    latency_regression_round: int = 0
+    latency_regression_factor: float = 4.0
     # chaos transport (per-actor ChaosTransport over the hub)
     chaos: bool = True
     publish_error_rate: float = 0.02
@@ -437,6 +447,11 @@ class FleetSpec:
         if self.mirror_kill_round < 0 or \
                 self.mirror_kill_round > self.rounds:
             raise ValueError("mirror_kill_round outside the run")
+        if self.latency_regression_round < 0 or \
+                self.latency_regression_round > self.rounds:
+            raise ValueError("latency_regression_round outside the run")
+        if self.latency_regression_factor <= 1.0:
+            raise ValueError("latency_regression_factor must be > 1")
         if self.round_s <= 0:
             raise ValueError("round_s must be > 0")
 
@@ -457,7 +472,8 @@ class FleetSpec:
         return dataclasses.replace(self, chaos=False, kills=0,
                                    kill_primary_round=0,
                                    partitions_per_round=0,
-                                   mirror_kill_round=0)
+                                   mirror_kill_round=0,
+                                   latency_regression_round=0)
 
     @classmethod
     def from_json(cls, text: str) -> "FleetSpec":
@@ -710,19 +726,51 @@ class ServerActor(Actor):
     real server role publishes (engine/serve.py); the open-loop latency
     HARNESS drives one real GenerationEngine separately
     (utils/loadgen.run_open_loop) — a thousand live decode engines in
-    one process would measure the host, not the fleet."""
+    one process would measure the host, not the fleet.
+
+    Runs the REAL :class:`~.health.BurnRateMonitor` on the sim clock,
+    fed one synthetic request outcome per simulated request: healthy
+    ttft sits at ~80-90ms against the 250ms objective, and from
+    ``spec.latency_regression_round`` on every outcome slows by
+    ``latency_regression_factor`` — the injected-latency-regression
+    scenario the ``slo_burn`` gate scores."""
+
+    # synthetic request outcomes folded into the burn monitor per
+    # round — enough that every export window clears min_samples
+    REQUESTS_PER_ROUND = 16
+
+    def __init__(self, sim: "FleetSim", role: str, hotkey: str,
+                 index: int):
+        super().__init__(sim, role, hotkey, index)
+        self.burn = BurnRateMonitor(clock=self.clock.now)
+        self.first_burn_round = 0
+        self.peak_burn = 0.0
 
     def step(self, round_no: int) -> None:
         if not self.alive:
             return
+        spec = self.spec
+        regressed = (spec.latency_regression_round
+                     and round_no >= spec.latency_regression_round)
+        factor = spec.latency_regression_factor if regressed else 1.0
+        now = self.clock.now()
+        for _ in range(self.REQUESTS_PER_ROUND):
+            j = abs(float(self.rng.standard_normal()))
+            self.burn.observe(now, ttft_ms=(80.0 + 4.0 * j) * factor,
+                              tpot_ms=(9.0 + 0.5 * j) * factor)
+        new = self.burn.evaluate(now, round_num=round_no)
+        if new and not self.first_burn_round:
+            self.first_burn_round = round_no
+        self.peak_burn = max(self.peak_burn, self.burn.max_burn(now))
         jitter = float(self.rng.standard_normal())
         self.publish_heartbeat(
             steps=float(round_no),
             step_rate=1.0 / self.spec.round_s,
-            ttft_ms_p95=80.0 + 4.0 * abs(jitter),
-            tpot_ms_p95=9.0 + 0.5 * abs(jitter),
+            ttft_ms_p95=(80.0 + 4.0 * abs(jitter)) * factor,
+            tpot_ms_p95=(9.0 + 0.5 * abs(jitter)) * factor,
             tokens_per_sec=900.0 - 20.0 * abs(jitter),
             queue_depth=float(self.index % 3),
+            slo_burn=self.burn.max_burn(now),
             base_revision=self.sim.hub.base_revision())
 
 
@@ -1120,6 +1168,11 @@ class FleetResult:
     base_sharded_pulls: int = 0
     base_fallback_pulls: int = 0
     base_mirror_shard_hits: int = 0
+    # SLO burn-rate alerting (engine/health.py BurnRateMonitor on the
+    # sim clock, fed by every ServerActor's synthetic request outcomes)
+    burn_alerts: list[dict] = dataclasses.field(default_factory=list)
+    burn_first_fire_round: int = 0
+    burn_peak: float = 0.0
 
 
 class FleetSim:
@@ -1400,7 +1453,14 @@ class FleetSim:
             base_fallback_pulls=sum(a.base_fetcher.fallbacks_total
                                     for a in self.miners),
             base_mirror_shard_hits=sum(a.base_fetcher.mirror_hits_total
-                                       for a in self.miners))
+                                       for a in self.miners),
+            burn_alerts=[dict(a) for s in self.servers
+                         for a in s.burn.alerts],
+            burn_first_fire_round=min(
+                (s.first_burn_round for s in self.servers
+                 if s.first_burn_round), default=0),
+            burn_peak=round(max((s.peak_burn for s in self.servers),
+                                default=0.0), 4))
 
     def close(self) -> None:
         if self.closed:
@@ -1440,6 +1500,11 @@ DEFAULT_GATES = {
     "quality_drift_breaches_max": 0,
     "serve_min_load_points": 3,
     "serve_ttft_p99_budget_ms": 400.0,   # at the LOWEST offered rate
+    # SLO burn-rate alerting (engine/health.py BurnRateMonitor): an
+    # injected latency regression must PAGE within this many rounds of
+    # arriving (counting the injection round), and the clean control
+    # twin must fire zero alerts — both halves of an alerting claim
+    "slo_burn_detect_rounds_max": 3,
     # routed load phase (--router-servers): admitted-request ttft p99
     # at the BASELINE's knee rate (its highest common rate) must beat
     # the single-server baseline by at least this factor — the
@@ -1593,6 +1658,23 @@ def assemble_scorecard(result: FleetResult,
                              if result.quality_trace else None),
         },
     }
+    if spec.servers:
+        detect = None
+        if spec.latency_regression_round and result.burn_first_fire_round:
+            detect = (result.burn_first_fire_round
+                      - spec.latency_regression_round + 1)
+        card["slo_burn"] = {
+            "injected_round": spec.latency_regression_round,
+            "factor": spec.latency_regression_factor,
+            "alerts": len(result.burn_alerts),
+            "alert_names": sorted({f"{a['slo_burn']}.{a['window']}"
+                                   for a in result.burn_alerts}),
+            "first_fire_round": result.burn_first_fire_round,
+            "detect_rounds": detect,
+            "peak_burn": result.burn_peak,
+        }
+        if control is not None:
+            card["slo_burn"]["control_alerts"] = len(control.burn_alerts)
     if control is not None:
         card["parity"] = {
             "control_rounds": control.rounds_completed,
@@ -1710,6 +1792,28 @@ def evaluate_gates(card: dict, *, gates: dict | None = None,
             out["base_dist"]["ok"] = (out["base_dist"]["ok"]
                                       and post_mirror_bytes == 0
                                       and pulls_after > 0)
+    sb = card.get("slo_burn")
+    if sb and sb["injected_round"]:
+        out["slo_burn"] = {
+            "ok": (sb["first_fire_round"] >= sb["injected_round"]
+                   and sb["detect_rounds"] is not None
+                   and sb["detect_rounds"]
+                   <= g["slo_burn_detect_rounds_max"]
+                   and sb.get("control_alerts", 0) == 0),
+            "injected_round": sb["injected_round"],
+            "first_fire_round": sb["first_fire_round"],
+            "detect_rounds": sb["detect_rounds"],
+            "detect_rounds_max": g["slo_burn_detect_rounds_max"],
+            "control_alerts": sb.get("control_alerts", 0),
+            "alert_names": sb["alert_names"],
+        }
+    elif sb and sb["alerts"]:
+        # no regression injected yet alerts fired: a false positive is
+        # a gate failure in its own right (an alert that cries wolf on
+        # a healthy fleet is worse than no alert)
+        out["slo_burn"] = {"ok": False, "injected_round": 0,
+                           "false_positives": sb["alerts"],
+                           "alert_names": sb["alert_names"]}
     if "serving" in card:
         pts = card["serving"]["load_points"]
         lowest = min(pts, key=lambda p: p["rate_rps"]) if pts else None
@@ -1782,6 +1886,17 @@ def _baseline_gate(card: dict, baseline: dict, g: dict) -> dict:
                p.get("ttft_ms", {}).get("p99", 0.0),
                g["baseline_ttft_p99_ratio_max"],
                f"ttft p99 @ {p['rate_rps']} rps")
+    cur_sb = card.get("slo_burn") or {}
+    base_sb = baseline.get("slo_burn") or {}
+    if cur_sb.get("injected_round") and base_sb.get("injected_round") \
+            and base_sb.get("detect_rounds") is not None:
+        cur_d = cur_sb.get("detect_rounds")
+        # one round of slack: detection may not regress past the prior
+        # scorecard's time-to-page by more than a single round
+        if cur_d is None or cur_d > base_sb["detect_rounds"] + 1:
+            problems.append(
+                f"slo_burn detect_rounds {cur_d} > baseline "
+                f"{base_sb['detect_rounds']} + 1")
     out = {"ok": not problems, "problems": problems}
     gain_min = g.get("router_knee_ttft_gain_min", 0.0)
     # the knee gain is ROUTED vs SINGLE-SERVER: once the baseline is

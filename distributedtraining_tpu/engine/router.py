@@ -35,7 +35,7 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..utils import obs
+from ..utils import obs, reqtrace
 from . import serve as _serve
 
 logger = logging.getLogger(__name__)
@@ -174,7 +174,8 @@ class RouterHTTPFrontend:
                  policy: RouterPolicy | None = None,
                  poll_interval_s: float = 1.0,
                  unhealthy_after: int = 3,
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0,
+                 retry_after_cap_s: float = 0.25):
         if not backend_urls:
             raise ValueError("router needs at least one backend url")
         self.backends = [BackendState(url=u.rstrip("/"))
@@ -185,8 +186,14 @@ class RouterHTTPFrontend:
         self.poll_interval_s = poll_interval_s
         self.unhealthy_after = unhealthy_after
         self.timeout_s = timeout_s
+        # how long the router is willing to honor a backend's
+        # Retry-After before the next-best retry (0 disables the wait);
+        # tests monkeypatch _sleep to observe without stalling
+        self.retry_after_cap_s = retry_after_cap_s
+        self._sleep = time.sleep
         self.routed = 0
         self.shed = 0
+        self.retry_after_honored = 0
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._poller: threading.Thread | None = None
@@ -217,9 +224,18 @@ class RouterHTTPFrontend:
                 logger.exception("router poll sweep failed")
 
     # -- routing ------------------------------------------------------------
-    def _route(self, body: bytes) -> tuple[int, dict, dict]:
-        """Forward one /generate body. Returns (code, obj, headers)."""
+    def _route(self, body: bytes,
+               request_id: str | None = None) -> tuple[int, dict, dict]:
+        """Forward one /generate body. Returns (code, obj, headers).
+
+        ``request_id`` is the caller's ``X-DT-Request-Id`` (minted here
+        from the body when absent — the router is the outermost
+        frontend, so the identity every downstream trace stage carries
+        is born at this line); it is forwarded to every backend tried
+        and echoed on every outcome, including the router's own shed."""
         obs.count("router.requests")
+        request_id = request_id or reqtrace.mint_request_id(body)
+        rid_hdr = {reqtrace.REQUEST_ID_HEADER: request_id}
         with self._lock:
             states = list(self.backends)
             chosen = self.policy.choose(states)
@@ -233,7 +249,8 @@ class RouterHTTPFrontend:
             try:
                 req = urllib.request.Request(
                     chosen.url + "/generate", data=body,
-                    headers={"Content-Type": "application/json"})
+                    headers={"Content-Type": "application/json",
+                             reqtrace.REQUEST_ID_HEADER: request_id})
                 try:
                     with urllib.request.urlopen(
                             req, timeout=self.timeout_s) as r:
@@ -245,7 +262,8 @@ class RouterHTTPFrontend:
                     self.routed += 1
                 obs.count("router.routed")
                 out["backend"] = chosen.url
-                return 200, out, {}
+                out.setdefault("request_id", request_id)
+                return 200, out, dict(rid_hdr)
             except urllib.error.HTTPError as e:
                 code = e.code
                 try:
@@ -255,13 +273,28 @@ class RouterHTTPFrontend:
                 if code not in (429, 503):
                     # backend answered with a real verdict (400/504/...):
                     # relay it, retrying elsewhere would double-generate
-                    return code, payload, {}
+                    return code, payload, dict(rid_hdr)
                 obs.count("router.backend_errors")
+                retry_after = (e.headers or {}).get("Retry-After")
                 with self._lock:
                     # the backend told us it is saturated; trust it
                     # until the next poll sweep says otherwise
                     chosen.queue_depth = max(chosen.queue_depth,
                                              self.policy.max_queue_depth)
+                if retry_after is not None and self.retry_after_cap_s > 0:
+                    # honor the backend's own back-pressure signal
+                    # before piling onto the next-best backend — capped,
+                    # so one saturated server never stalls the router
+                    try:
+                        wait = min(float(retry_after),
+                                   self.retry_after_cap_s)
+                    except ValueError:
+                        wait = 0.0
+                    if wait > 0:
+                        with self._lock:
+                            self.retry_after_honored += 1
+                        obs.count("router.retry_after_honored")
+                        self._sleep(wait)
             except (urllib.error.URLError, OSError, ValueError):
                 obs.count("router.backend_errors")
                 with self._lock:
@@ -277,8 +310,9 @@ class RouterHTTPFrontend:
             retry = self.policy.retry_after(list(self.backends))
         obs.count("router.shed")
         return 429, {"error": "all backends overloaded",
-                     "retry_after_s": retry}, \
-            {"Retry-After": str(max(1, int(retry)))}
+                     "retry_after_s": retry,
+                     "request_id": request_id}, \
+            {"Retry-After": str(max(1, int(retry))), **rid_hdr}
 
     # -- http ---------------------------------------------------------------
     def start(self) -> int:
@@ -307,6 +341,8 @@ class RouterHTTPFrontend:
                         out = {
                             "ok": True, "role": "router",
                             "routed": fe.routed, "shed": fe.shed,
+                            "retry_after_honored":
+                                fe.retry_after_honored,
                             "backends": [dataclasses.asdict(b)
                                          for b in fe.backends]}
                     self._send(200, out)
@@ -319,7 +355,8 @@ class RouterHTTPFrontend:
                     return
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n) or b"{}"
-                code, obj, headers = fe._route(body)
+                code, obj, headers = fe._route(
+                    body, self.headers.get(reqtrace.REQUEST_ID_HEADER))
                 self._send(code, obj, headers)
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)

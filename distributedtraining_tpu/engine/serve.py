@@ -113,7 +113,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import devprof, flight, obs
+from ..utils import devprof, flight, obs, reqtrace
 from .batched_eval import _timed_compile
 
 logger = logging.getLogger(__name__)
@@ -153,6 +153,10 @@ class ServeRequest:
     tokens: list = dataclasses.field(default_factory=list)
     status: str = "queued"      # queued | active | done | truncated
     revision: str | None = None
+    # content-addressable identity (utils/reqtrace.py): minted at the
+    # frontend (router or server) or by submit() itself; propagated via
+    # the X-DT-Request-Id header and stamped on every trace stage
+    request_id: str | None = None
     submitted_t: float = dataclasses.field(default_factory=time.time)
     done_evt: threading.Event = dataclasses.field(
         default_factory=threading.Event)
@@ -173,6 +177,15 @@ class _Slot:
     spec_window: int = 0  # drafts allowed THIS step (set by _grow: the
     #                       pages for seq_len..seq_len+spec_window are
     #                       owned exclusively; 0 = plain-decode lane)
+    # lazy trace accumulators (utils/reqtrace.py): the per-token hot
+    # path only bumps these slot-local scalars; _trace_flush folds them
+    # into the request's timeline as ONE coalesced span whenever the
+    # story moves on (another stage, preempt, finish)
+    tr_decode_n: int = 0
+    tr_decode_t0: float = 0.0
+    tr_decode_t1: float = 0.0
+    tr_tpot_sum: float = 0.0
+    tr_tpot_n: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -648,7 +661,11 @@ class GenerationEngine:
                  prefix_cache: bool = False,
                  debug_invariants: bool = False,
                  draft=None,
-                 draft_k: int = 4):
+                 draft_k: int = 4,
+                 trace: bool = True,
+                 trace_exemplars: int = 4,
+                 trace_window_s: float = 30.0,
+                 burn=None):
         if swap_policy not in ("drain", "restart"):
             raise ValueError(f"swap_policy must be drain|restart, "
                              f"got {swap_policy!r}")
@@ -763,6 +780,18 @@ class GenerationEngine:
         self._tok_rate_ema: float | None = None
         self.steps = 0
         self.tokens_emitted = 0
+        # request-scoped lifecycle traces (utils/reqtrace.py): host-side
+        # stage timelines + the tail-exemplar reservoir. Every
+        # instrumentation site below is a single-branch no-op when
+        # trace=False; ``burn`` (a health.BurnRateMonitor) receives each
+        # finished/shed outcome as the SLO trace stream.
+        self.trace = reqtrace.TraceBook(
+            exemplar_k=trace_exemplars, window_s=trace_window_s,
+            burn=burn) if trace else None
+        if draft is not None and self.trace is not None:
+            # the drafter records its cold catch-up prefills
+            # ("spec_draft") against the same per-request timelines
+            draft.trace = self.trace
         if params is not None:
             self.install_params(params, revision=revision)
 
@@ -795,7 +824,8 @@ class GenerationEngine:
     def submit(self, prompt: Sequence[int],
                max_new_tokens: int | None = None, *,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int = 0) -> ServeRequest:
+               seed: int = 0,
+               request_id: str | None = None) -> ServeRequest:
         """Queue one generation request (thread-safe). Prompts longer
         than the cache capacity are rejected up front.
         ``temperature=0`` (the default) is greedy argmax — the
@@ -818,8 +848,17 @@ class GenerationEngine:
         req = ServeRequest(prompt=prompt, max_new_tokens=n_new,
                            temperature=float(temperature),
                            top_p=float(top_p), seed=int(seed))
+        if self.trace is not None:
+            req.request_id = request_id or reqtrace.mint_request_id(
+                prompt, max_new_tokens=n_new, temperature=req.temperature,
+                top_p=req.top_p, seed=req.seed)
+        else:
+            req.request_id = request_id
         with self._qlock:
             self._queue.append(req)
+            depth = len(self._queue)
+        if self.trace is not None:
+            self.trace.start(req, depth=depth)
         obs.count("serve.requests")
         self._work_evt.set()
         return req
@@ -1234,11 +1273,35 @@ class GenerationEngine:
             # never survive to propose against a different future
             self._draft.drop(slot.req.rid)
 
+    def _trace_flush(self, slot: _Slot) -> None:
+        """Fold the slot's lazy decode/tpot accumulators into its trace.
+
+        Per-token work is an int bump on the slot; the timeline only
+        sees one coalesced span per contiguous decode run, flushed when
+        the request's story moves on (spec/cow/preempt/finish)."""
+        if slot.tr_decode_n:
+            self.trace.stage_span(slot.req.rid, "decode",
+                                  slot.tr_decode_t0, slot.tr_decode_t1,
+                                  slot.tr_decode_n,
+                                  tokens=slot.tr_decode_n)
+            slot.tr_decode_n = 0
+        if slot.tr_tpot_n:
+            self.trace.note_latency(slot.req.rid,
+                                    tpot_sum_ms=slot.tr_tpot_sum,
+                                    tpot_n=slot.tr_tpot_n)
+            slot.tr_tpot_sum = 0.0
+            slot.tr_tpot_n = 0
+
     def _finish(self, slot: _Slot, status: str) -> None:
         self._admit_hold = False
         self._release(slot)
         slot.req.status = status
         slot.req.revision = self.revision
+        if self.trace is not None:
+            # terminal "emit" stage + burn-monitor feed + reservoir
+            # entry — before done_evt so a waiter observes a closed trace
+            self._trace_flush(slot)
+            self.trace.finish(slot.req, status)
         slot.req.done_evt.set()
         self._active.remove(slot)
         if status == "truncated":
@@ -1256,6 +1319,10 @@ class GenerationEngine:
         self._active.remove(victim)
         self._requeue_front(victim.req)
         self._admit_hold = True
+        if self.trace is not None:
+            self._trace_flush(victim)
+            self.trace.stage(victim.req.rid, "preempt",
+                             seq_len=victim.seq_len)
         obs.count("serve.preempted")
         logger.info("preempted request %d (page pool exhausted)",
                     victim.req.rid)
@@ -1306,6 +1373,10 @@ class GenerationEngine:
                 self._release(slot)
                 self._active.remove(slot)
                 self._requeue_front(slot.req)
+                if self.trace is not None:
+                    self._trace_flush(slot)
+                    self.trace.stage(slot.req.rid, "swap_invalidate",
+                                     seq_len=slot.seq_len)
                 obs.count("serve.swap_restarts")
         if self._active:
             return   # drain: finish in-flight on their revision first
@@ -1338,6 +1409,9 @@ class GenerationEngine:
         self.pool.decref(src)
         slot.pages[idx] = got[0]
         self.cow_copies += 1
+        if self.trace is not None:
+            self._trace_flush(slot)
+            self.trace.stage(slot.req.rid, "cow", pages=1)
         obs.count("serve.cow_copies")
         return True
 
@@ -1359,6 +1433,10 @@ class GenerationEngine:
         goes back to the queue front with its increfs rolled back."""
         P = self.page_size
         plen = len(req.prompt)
+        # queue age (submit -> admission attempt): the "how long did
+        # this request wait" half of TTFT — exported for fleet_report's
+        # q_age95 column whether or not per-request tracing is on
+        queue_age_ms = max(0.0, (time.time() - req.submitted_t) * 1e3)
         shared: list[int] = []
         matched = 0
         if self._cache is not None:
@@ -1397,6 +1475,17 @@ class GenerationEngine:
                 self._requeue_front(req)
                 return False
             pages = slot_stub.pages
+        obs.observe("serve.queue_age_ms", queue_age_ms)
+        if self.trace is not None:
+            # a request the scheduler already admitted once (then
+            # preempted / swap-invalidated) re-enters as "readmit" —
+            # the waterfall distinguishes first-wait from churn-wait
+            if self.trace.seen(req.rid, "admit"):
+                self.trace.stage(req.rid, "readmit",
+                                 queue_age_ms=queue_age_ms)
+            else:
+                self.trace.stage(req.rid, "admit",
+                                 queue_age_ms=queue_age_ms)
         if matched:
             self._prefill_shared(req, pages, matched)
         else:
@@ -1427,8 +1516,12 @@ class GenerationEngine:
                 self._params, toks, np.int32(plen), k_pages, v_pages,
                 page_row)
         self._kv = (k_pages, v_pages)
-        obs.observe("serve.prefill_ms", (time.perf_counter() - t0) * 1e3)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        obs.observe("serve.prefill_ms", dur_ms)
         obs.count("serve.prefills")
+        if self.trace is not None:
+            self.trace.stage(req.rid, "prefill", pfx_hit=0, pfx_tokens=0,
+                             prompt_tokens=plen, dur_ms=round(dur_ms, 3))
         if self._cache is not None:
             self._cache.register(list(req.prompt), pages)
         self._activate(req, pages, self._first_token(req, nxt, logit_row))
@@ -1464,8 +1557,13 @@ class GenerationEngine:
                 self._params, toks, np.int32(ctx_len), np.int32(suffix),
                 k_pages, v_pages, table)
         self._kv = (k_pages, v_pages)
-        obs.observe("serve.prefill_ms", (time.perf_counter() - t0) * 1e3)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        obs.observe("serve.prefill_ms", dur_ms)
         obs.count("serve.prefills")
+        if self.trace is not None:
+            self.trace.stage(req.rid, "prefill", pfx_hit=1,
+                             pfx_tokens=ctx_len, prompt_tokens=plen,
+                             dur_ms=round(dur_ms, 3))
         self._activate(req, pages, self._first_token(req, nxt, logit_row))
 
     def _first_token(self, req: ServeRequest, nxt, logit_row) -> int:
@@ -1493,10 +1591,18 @@ class GenerationEngine:
         # server heartbeat into fleet_report's ttft95/tpot95 columns.
         now = time.perf_counter()
         if len(slot.req.tokens) == 1:
-            obs.observe("serve.ttft_ms",
-                        max(0.0, (time.time() - slot.req.submitted_t) * 1e3))
+            ttft_ms = max(0.0, (time.time() - slot.req.submitted_t) * 1e3)
+            obs.observe("serve.ttft_ms", ttft_ms)
+            if self.trace is not None:
+                self.trace.note_latency(slot.req.rid, ttft_ms=ttft_ms)
         elif slot.last_emit_t:
-            obs.observe("serve.tpot_ms", (now - slot.last_emit_t) * 1e3)
+            tpot_ms = (now - slot.last_emit_t) * 1e3
+            obs.observe("serve.tpot_ms", tpot_ms)
+            if self.trace is not None:
+                # lazy: fold into the slot; _trace_flush hands the
+                # weighted sum to note_latency in one call per run
+                slot.tr_tpot_sum += tpot_ms
+                slot.tr_tpot_n += 1
         slot.last_emit_t = now
         if (self.eos_id is not None and tok == self.eos_id) or \
                 len(slot.req.tokens) >= slot.req.max_new_tokens:
@@ -1660,6 +1766,14 @@ class GenerationEngine:
                 self._spec_accepted += j
                 obs.count("serve.spec_proposed_tokens", len(props))
                 obs.count("serve.spec_accepted_tokens", j)
+            if self.trace is not None:
+                # one coalesced "spec" batch per request: rounds (n),
+                # proposed/accepted accumulate; tokens counts the
+                # verified emits of this round (accepted run + 1)
+                self._trace_flush(slot)
+                self.trace.stage(slot.req.rid, "spec",
+                                 proposed=len(props), accepted=j,
+                                 tokens=j + 1)
             for tok in props[:j] + [int(picks[i, j])]:
                 slot.seq_len += 1
                 slot.last_tok = tok
@@ -1730,9 +1844,19 @@ class GenerationEngine:
         self._kv = (k_pages, v_pages)
         nxt = np.asarray(jax.device_get(nxt))
         emitted = 0
+        trace_t = self.trace.clock() if self.trace is not None else 0.0
         for i, slot in enumerate(list(active)):
             slot.seq_len += 1
             slot.last_tok = int(nxt[i])
+            if self.trace is not None:
+                # lazy per-slot accumulation: the hot path is three
+                # scalar bumps against one hoisted clock read — the
+                # timeline gets one coalesced span at _trace_flush
+                # (spec/cow/preempt/finish), zero device work
+                if slot.tr_decode_n == 0:
+                    slot.tr_decode_t0 = trace_t
+                slot.tr_decode_n += 1
+                slot.tr_decode_t1 = trace_t
             self._emit(slot, int(nxt[i]))
             emitted += 1
         return emitted
@@ -1814,6 +1938,10 @@ class GenerationEngine:
         for req in drained:
             req.status = "truncated"
             req.done_evt.set()
+        if self.trace is not None:
+            # a run shorter than one reservoir window still freezes its
+            # tail exemplars on the way out
+            self.trace.seal_window()
 
 
 # ---------------------------------------------------------------------------
@@ -1921,11 +2049,16 @@ class ServeHTTPFrontend:
                         out["spec_accept_rate"] = e.spec_accept_rate
                         out["spec_k"] = e.draft_k
                     for key, metric in (("ttft_ms_p95", "serve.ttft_ms"),
-                                        ("tpot_ms_p95", "serve.tpot_ms")):
+                                        ("tpot_ms_p95", "serve.tpot_ms"),
+                                        ("q_age_ms_p95",
+                                         "serve.queue_age_ms")):
                         if metric in names and \
                                 reg.histogram(metric).count:
                             out[key] = reg.histogram(metric).percentiles(
                                 (95.0,))["p95"]
+                    burn = e.trace.burn if e.trace is not None else None
+                    if burn is not None:
+                        out["slo_burn"] = burn.max_burn()
                     self._send(200, out)
                 else:
                     self._send(404, {"error": "not found"})
@@ -1937,19 +2070,33 @@ class ServeHTTPFrontend:
                 # admission control BEFORE parsing: a saturated server
                 # answers cheaply and immediately instead of queueing
                 # the caller into the latency knee
+                # the caller's identity (router-minted) or None — a
+                # refusal still gets a traced request_id so the 429/503
+                # shows up in the same per-request stream
+                req_id = self.headers.get(reqtrace.REQUEST_ID_HEADER)
                 state, retry = fe.engine.admission_state()
                 if state == "shed":
                     fe.engine.shed_count += 1
                     obs.count("serve.shed")
+                    if fe.engine.trace is not None:
+                        req_id = fe.engine.trace.reject(
+                            req_id, "shed", retry_after_s=round(retry, 3))
                     self._send(429, {"error": "overloaded",
-                                     "retry_after_s": retry},
-                               {"Retry-After": str(max(1, int(retry)))})
+                                     "retry_after_s": retry,
+                                     "request_id": req_id},
+                               {"Retry-After": str(max(1, int(retry))),
+                                reqtrace.REQUEST_ID_HEADER: req_id or ""})
                     return
                 if state == "drain":
                     obs.count("serve.drain_rejects")
+                    if fe.engine.trace is not None:
+                        req_id = fe.engine.trace.reject(
+                            req_id, "drain", retry_after_s=round(retry, 3))
                     self._send(503, {"error": "draining for base swap",
-                                     "retry_after_s": retry},
-                               {"Retry-After": str(max(1, int(retry)))})
+                                     "retry_after_s": retry,
+                                     "request_id": req_id},
+                               {"Retry-After": str(max(1, int(retry))),
+                                reqtrace.REQUEST_ID_HEADER: req_id or ""})
                     return
                 try:
                     n = int(self.headers.get("Content-Length", 0))
@@ -1968,22 +2115,28 @@ class ServeHTTPFrontend:
                         toks, payload.get("max_new_tokens"),
                         temperature=float(payload.get("temperature", 0.0)),
                         top_p=float(payload.get("top_p", 1.0)),
-                        seed=int(payload.get("seed", 0)))
+                        seed=int(payload.get("seed", 0)),
+                        request_id=req_id)
                 except (ValueError, TypeError, json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
                     return
+                # echo the (possibly engine-minted) identity on every
+                # outcome so callers and the router can correlate
+                hdr = {reqtrace.REQUEST_ID_HEADER: req.request_id or ""}
                 if not req.wait(fe.timeout_s):
                     self._send(504, {"error": "generation timed out",
-                                     "rid": req.rid})
+                                     "rid": req.rid,
+                                     "request_id": req.request_id}, hdr)
                     return
                 out = {"rid": req.rid, "tokens": req.tokens,
-                       "status": req.status, "revision": req.revision}
+                       "status": req.status, "revision": req.revision,
+                       "request_id": req.request_id}
                 if fe.tokenizer is not None:
                     try:
                         out["text"] = fe.tokenizer.decode(req.tokens)
                     except Exception:
                         pass
-                self._send(200, out)
+                self._send(200, out, hdr)
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self._server.daemon_threads = True

@@ -158,6 +158,10 @@ class DraftEngine:
         self.pool: PagePool | None = None
         self._states: dict[int, _DraftState] = {}
         self.flush_count = 0
+        # set by GenerationEngine when request tracing is on: the
+        # drafter's cold catch-up prefills ("spec_draft") land on the
+        # same per-request timelines (utils/reqtrace.py)
+        self.trace = None
         if params is not None:
             self.install_params(params, revision=revision)
 
@@ -437,7 +441,15 @@ class DraftEngine:
                 st.toks = []
                 st.stable = 0
             if not st.toks and tgt_len > 0:
+                t0 = time.perf_counter()
                 self._prefill_state(st, known[:tgt_len])
+                if self.trace is not None:
+                    # cold drafter rebuild: the hidden prefill a request
+                    # pays after a draft swap/flush — invisible in
+                    # aggregate spec_draft_ms, causal in the waterfall
+                    self.trace.stage(
+                        slot.req.rid, "spec_draft", tokens=tgt_len,
+                        dur_ms=round((time.perf_counter() - t0) * 1e3, 3))
             jobs.append({"slot": slot, "st": st, "known": known, "k": k,
                          "out": []})
         if not jobs:

@@ -96,6 +96,12 @@ EVENT_KINDS: dict[str, str] = {
                       "(engine/lineage.py)",
     "lineage.drift": "merged-model quality drift detected by the "
                      "EWMA/CUSUM detector (engine/lineage.py)",
+    "serve.trace.exemplar": "one tail-exemplar request frozen by the "
+                            "reqtrace reservoir: request_id, status, "
+                            "ttft/tpot, stage count (utils/reqtrace.py)",
+    "serve.trace.stage": "one stage of a frozen exemplar's timeline: "
+                         "request_id, stage, rel_ms/dur_ms, batched "
+                         "step count + stage fields (utils/reqtrace.py)",
     "note": "free-form operator/debug annotation",
 }
 

@@ -23,6 +23,8 @@ from typing import Any, Sequence
 import jax
 import numpy as np
 
+from . import reqtrace
+
 try:  # identity needs the optional `cryptography` package; the poison
     # generators and the open-loop serving harness below do not — keep
     # them importable on minimal containers (engine/fleetsim.py relies
@@ -280,8 +282,15 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
         nonlocal i
         while i < len(arrivals) and arrivals[i][0] <= now:
             t_arr, prompt = arrivals[i]
+            seq = i
             i += 1
-            req = engine.submit(prompt, spec.max_new_tokens)
+            # deterministic content-addressable identity (arrival index
+            # as the sequence salt): the same spec mints the same ids,
+            # so a frozen tail exemplar can be named in a test
+            req = engine.submit(
+                prompt, spec.max_new_tokens,
+                request_id=reqtrace.mint_request_id(
+                    prompt, max_new_tokens=spec.max_new_tokens, seq=seq))
             tracked.append({"req": req, "arrival_s": t_arr,
                             "seen": 0, "last_emit": None})
 
@@ -319,6 +328,11 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
 
     completed = sum(1 for r in tracked if r["req"].done_evt.is_set())
     unfinished = len(tracked) - completed
+    # a live run seals its trace reservoir on the way out so the tail
+    # exemplars of even a sub-window run are frozen into the flight
+    # recorder (scripts/request_report.py reads them from there)
+    book = getattr(engine, "trace", None)
+    pm_ref = book.seal_window() if book is not None else None
 
     def _pcts(vals: list[float]) -> dict:
         s = sorted(vals)
@@ -334,6 +348,9 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
         "unfinished": unfinished,
         "steps": steps,
         "virtual_s": round(now, 4),
+        "trace_exemplars": (book.exemplars_frozen
+                            if book is not None else 0),
+        "trace_pm_ref": pm_ref,
         "tokens": int(sum(r["seen"] for r in tracked)),
         "ttft_ms": _pcts(ttft_ms) if ttft_ms else
         {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
@@ -384,7 +401,11 @@ def run_open_loop_routed(engines, spec: OpenLoopSpec, *,
                 shed += 1
                 continue
             eng = engines[int(b.url.rsplit("/", 1)[-1])]
-            req = eng.submit(prompt, spec.max_new_tokens)
+            req = eng.submit(
+                prompt, spec.max_new_tokens,
+                request_id=reqtrace.mint_request_id(
+                    prompt, max_new_tokens=spec.max_new_tokens,
+                    seq=i - 1))
             tracked.append({"req": req, "arrival_s": t_arr,
                             "seen": 0, "last_emit": None})
 

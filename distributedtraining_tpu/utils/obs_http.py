@@ -142,6 +142,24 @@ def render(registry=None, fleet=None) -> str:
         lines.extend(devprof.prom_lines())
     except Exception:  # a broken observatory must not 500 the registry
         logger.exception("obs_http: devprof render failed")
+    try:
+        # SLO burn rates (engine/health.BurnRateMonitor, fed by the
+        # request-trace stream): dt_slo_burn{slo,window} — cardinality
+        # is rules x the fixed window-label set. Function-level import:
+        # utils must not import engine at module load.
+        from ..engine import health as _health
+        burn = _health.live_burn_monitor()
+        if burn is not None:
+            lines.append("# HELP dt_slo_burn error-budget burn rate "
+                         "(bad_fraction/budget) per SLO per window")
+            lines.append("# TYPE dt_slo_burn gauge")
+            for (slo, window), v in sorted(burn.gauges().items()):
+                lines.append(
+                    f'dt_slo_burn{{slo="{_label_escape(slo)}",'
+                    f'window="{_label_escape(window)}"}} '
+                    f"{_prom_value(v)}")
+    except Exception:  # a broken monitor must not 500 the registry
+        logger.exception("obs_http: burn render failed")
     if fleet is not None:
         try:
             ledger = fleet.ledger()

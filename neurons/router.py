@@ -57,6 +57,10 @@ def main(argv=None) -> int:
                     help="seconds between /healthz sweeps")
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="per-request backend timeout (seconds)")
+    ap.add_argument("--retry-after-cap", type=float, default=0.25,
+                    help="max seconds to honor a backend's Retry-After "
+                         "hint before retrying the next-best backend "
+                         "(0 disables the wait)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -68,7 +72,8 @@ def main(argv=None) -> int:
     fe = RouterHTTPFrontend(args.backends, args.port, host=args.host,
                             policy=policy,
                             poll_interval_s=args.poll_interval,
-                            timeout_s=args.timeout)
+                            timeout_s=args.timeout,
+                            retry_after_cap_s=args.retry_after_cap)
     port = fe.start()
     print(f"router: http://{args.host}:{port}/generate -> "
           f"{len(args.backends)} backends (max queue {args.max_queue})",

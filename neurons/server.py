@@ -143,6 +143,14 @@ def main(argv=None) -> int:
         # --init-from boot: seed the shard store from the weights we
         # serve, so the FIRST published base pulls only what differs
         base_fetcher.seed(params)
+    # SLO burn-rate alerting over the request-trace stream
+    # (engine/health.py): every finished/shed request the TraceBook
+    # records feeds the monitor; multi-window rules fire the standard
+    # breach escalation and export as dt_slo_burn{slo,window}
+    from distributedtraining_tpu.engine import health as _health
+    burn = (_health.BurnRateMonitor(metrics=c.metrics)
+            if cfg.serve_trace else None)
+    _health.attach_burn(burn)
     engine = GenerationEngine(
         c.model, params, revision=revision,
         max_slots=cfg.serve_slots, page_size=cfg.serve_page_size,
@@ -152,7 +160,11 @@ def main(argv=None) -> int:
         swap_policy=cfg.swap_policy, watcher=watcher,
         max_queue=cfg.serve_max_queue,
         prefix_cache=cfg.serve_prefix_cache,
-        draft=_build_drafter(cfg, c), draft_k=cfg.serve_draft_k)
+        draft=_build_drafter(cfg, c), draft_k=cfg.serve_draft_k,
+        trace=cfg.serve_trace,
+        trace_exemplars=cfg.serve_trace_exemplars,
+        trace_window_s=cfg.serve_trace_window or 30.0,
+        burn=burn)
     watcher.start()
 
     # health plane: the server heartbeats its SERVED revision (the
@@ -183,11 +195,16 @@ def main(argv=None) -> int:
         # the registry digest on idle servers.
         names = _obs.registry().names()
         for metric, field in (("serve.ttft_ms", "ttft_ms_p95"),
-                              ("serve.tpot_ms", "tpot_ms_p95")):
+                              ("serve.tpot_ms", "tpot_ms_p95"),
+                              ("serve.queue_age_ms", "q_age_ms_p95")):
             if metric in names:
                 h = _obs.registry().histogram(metric)
                 if h.count:
                     out[field] = h.percentiles((95.0,))["p95"]
+        # worst fast-window burn rate across the serving SLOs —
+        # fleet_report's slo_burn column (0.0 = comfortably on budget)
+        if burn is not None:
+            out["slo_burn"] = burn.max_burn()
         return out
 
     vitals = Vitals(
@@ -217,6 +234,10 @@ def main(argv=None) -> int:
                 # cadence, so fleet_report's registry[server] line and
                 # offline joins see the serving numbers
                 obs.flush(step=engine.steps)
+                if burn is not None:
+                    # burn-rate rules re-check on the same cadence; any
+                    # firing walks the standard breach escalation
+                    burn.evaluate()
                 last_flush = time.monotonic()
             if cfg.max_steps is None:
                 continue   # unbounded: serve until interrupted
@@ -243,6 +264,7 @@ def main(argv=None) -> int:
         loop.close()
         plane.close()
         engine.close()
+        _health.attach_burn(None)
         if c.metrics is not None:
             obs.flush(step=engine.steps)
         # crash bundle (exceptional exits), then global obs state reset

@@ -38,7 +38,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
-           "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "shed",
+           "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "q_age95",
+           "slo_burn", "shed",
            "pfx_hit", "acc_rate", "published", "accepted", "declined",
            "stale_rounds",
            "wire_b", "base_b", "mirror_hit", "score", "credit", "quar",
@@ -165,6 +166,20 @@ def _cell(node: dict, col: str) -> str:
         # which tok_s alone cannot show
         v = node.get("ttft_ms_p95" if col == "ttft95" else "tpot_ms_p95")
         return "-" if v is None else f"{v:.1f}"
+    if col == "q_age95":
+        # queue-age p95 (server heartbeats, engine/serve.py observes
+        # serve.queue_age_ms at ADMISSION from the request tracer's
+        # submit timestamp): how long requests sat queued before a slot
+        # — the leading indicator ttft95 lags by a prefill
+        v = node.get("q_age_ms_p95")
+        return "-" if v is None else f"{v:.1f}"
+    if col == "slo_burn":
+        # worst fast-window (5m/1h) SLO error-budget burn rate across
+        # ttft/tpot/shed (engine/health.py BurnRateMonitor heartbeat
+        # extra): >1 means the budget is burning faster than allotted;
+        # the server's own multi-window rules page at 14.4x
+        v = node.get("slo_burn")
+        return "-" if not isinstance(v, (int, float)) else f"{v:.2f}"
     if col == "shed":
         # admission-control rejections (429 + Retry-After) this server
         # or router answered instead of queueing into the latency knee

@@ -83,6 +83,17 @@ def build_spec(args) -> "FleetSpec":
         # with no round loss
         mirror_kill_round=(2 * args.rounds // 3
                            if subs and args.rounds >= 6 else 0),
+        # injected-latency-regression scenario (engine/health.py
+        # BurnRateMonitor): late in the run every server's synthetic
+        # request outcomes slow by the factor; the slo_burn gate then
+        # asserts the multi-window burn rules page within
+        # slo_burn_detect_rounds_max rounds, with zero alerts on the
+        # clean control twin
+        latency_regression_round=(
+            args.latency_regression_round
+            if args.latency_regression_round is not None
+            else (2 * args.rounds // 3 if args.rounds >= 8 else 0)),
+        latency_regression_factor=args.latency_regression_factor,
         chaos=not args.no_chaos)
     return spec
 
@@ -199,6 +210,15 @@ def main(argv=None) -> int:
                          "p95 vs a non-speculating --baseline)")
     ap.add_argument("--draft-k", type=int, default=4,
                     help="draft tokens proposed per speculative step")
+    ap.add_argument("--latency-regression-round", type=int, default=None,
+                    help="inject a serving-latency regression at this "
+                         "round (0 = never; default: 2*rounds/3 when "
+                         "rounds >= 8) — the slo_burn gate scores "
+                         "detection")
+    ap.add_argument("--latency-regression-factor", type=float,
+                    default=4.0,
+                    help="multiplier applied to server request "
+                         "latencies from the regression round on")
     ap.add_argument("--out", default="FLEETSIM.json",
                     help="scorecard output path")
     ap.add_argument("--baseline",

@@ -35,7 +35,8 @@ import obs_report  # noqa: E402 — same directory; shares record loading
 # REJECTED on ingest, the same bundle-schema lint consumers apply
 EVENT_KINDS = ("config", "span", "metrics", "anomaly", "slo", "lease",
                "swap", "publish", "heartbeat", "remediation", "crash",
-               "lineage.record", "lineage.drift", "note")
+               "lineage.record", "lineage.drift",
+               "serve.trace.exemplar", "serve.trace.stage", "note")
 
 # a torn or failed publish outcome — the needle a crash forensics pass
 # is usually looking for

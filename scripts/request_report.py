@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Render one request's causal waterfall from frozen trace exemplars.
+
+The serving engine's request tracer (utils/reqtrace.py) freezes the K
+slowest ttft/tpot requests per window into the flight recorder as
+``serve.trace.exemplar`` (summary) + ``serve.trace.stage`` (one event
+per timeline entry) events, content-addressed into a postmortem bundle.
+This script is the offline consumer: given JSONL metric streams (the
+``{"postmortem": ...}`` mirror records every freeze_and_publish writes)
+and/or raw bundle JSON files (``/debug/dump`` output, transport
+``__pm__`` payloads), it
+
+- lists every exemplar found (default), or
+- renders the full stage waterfall of one request
+  (``--request-id rq-...`` — prefix match accepted), or
+- exports Chrome-trace JSON (``--trace out.json``) with ONE TRACK PER
+  STAGE, reusing obs_report.chrome_trace — open in chrome://tracing or
+  Perfetto and every admit/prefill/decode/spec/... lane reads as its
+  own row.
+
+Usage:
+    python scripts/request_report.py server.jsonl
+    python scripts/request_report.py dump.json --request-id rq-1f2e
+    python scripts/request_report.py run/*.jsonl --request-id rq-1f2e \
+        --trace waterfall.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import obs_report  # noqa: E402 — same directory; shares record loading
+
+# the two reqtrace event kinds (mirror of the utils/flight.EVENT_KINDS
+# entries — scripts stay import-free of the package)
+EXEMPLAR_KIND = "serve.trace.exemplar"
+STAGE_KIND = "serve.trace.stage"
+
+
+def gather_bundles(paths: list[str]) -> list[dict]:
+    """Every postmortem bundle reachable from ``paths``: raw bundle
+    JSON files (one dict with an ``events`` list) and JSONL streams
+    whose records carry a ``postmortem`` mirror. Deduped on bundle_id —
+    the same frozen ring republished twice is one bundle."""
+    bundles: list[dict] = []
+    jsonl_paths: list[str] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                head = f.read(1)
+                if head == "{":
+                    obj = json.loads(head + f.read())
+                    if isinstance(obj, dict) and \
+                            isinstance(obj.get("events"), list):
+                        bundles.append(obj)
+                        continue
+                    if isinstance(obj, dict) and \
+                            isinstance(obj.get("postmortem"), dict):
+                        bundles.append(obj["postmortem"])
+                        continue
+        except (OSError, ValueError):
+            pass
+        jsonl_paths.append(path)
+    for rec in obs_report.load_records(jsonl_paths):
+        pm = rec.get("postmortem")
+        if isinstance(pm, dict) and isinstance(pm.get("events"), list):
+            bundles.append(pm)
+    seen: set = set()
+    out = []
+    for b in bundles:
+        bid = b.get("bundle_id") or id(b)
+        if bid in seen:
+            continue
+        seen.add(bid)
+        out.append(b)
+    return out
+
+
+def collect_exemplars(bundles: list[dict]) -> dict[str, dict]:
+    """request_id -> {"summary": exemplar event, "stages": [stage
+    events in freeze order], "bundle_id": ...}. A request frozen in
+    several windows keeps its LAST freeze (most complete timeline)."""
+    out: dict[str, dict] = {}
+    for b in bundles:
+        per_req: dict[str, dict] = {}
+        for ev in b.get("events", ()):
+            if not isinstance(ev, dict):
+                continue
+            rid = ev.get("request_id")
+            if not isinstance(rid, str):
+                continue
+            if ev.get("kind") == EXEMPLAR_KIND:
+                per_req.setdefault(rid, {"stages": []})["summary"] = ev
+            elif ev.get("kind") == STAGE_KIND:
+                per_req.setdefault(rid, {"stages": []})["stages"] \
+                    .append(ev)
+        for rid, rec in per_req.items():
+            if rec.get("summary") and rec["stages"]:
+                rec["bundle_id"] = b.get("bundle_id")
+                out[rid] = rec
+    return out
+
+
+_WATERFALL_SKIP = ("kind", "t", "seq", "request_id", "stage", "rel_ms",
+                   "dur_ms", "n")
+
+
+def format_waterfall(rid: str, rec: dict) -> str:
+    """The causal per-request story: one row per timeline entry, in
+    stage order, with relative start, batched duration/step count, and
+    the stage's own fields (pfx_hit/pfx_tokens, proposed/accepted,
+    queue_age_ms, ...) spelled out."""
+    s = rec["summary"]
+    lines = [f"request {rid}"]
+    meta = [f"status={s.get('status', '?')}",
+            f"tokens={s.get('tokens', '?')}"]
+    if isinstance(s.get("ttft_ms"), (int, float)):
+        meta.append(f"ttft_ms={s['ttft_ms']:.3f}")
+    if isinstance(s.get("tpot_ms"), (int, float)):
+        meta.append(f"tpot_ms={s['tpot_ms']:.3f}")
+    if rec.get("bundle_id"):
+        meta.append(f"bundle={rec['bundle_id']}")
+    lines.append("  " + "  ".join(meta))
+    lines.append("")
+    header = ["stage", "rel_ms", "dur_ms", "n", "detail"]
+    rows = []
+    stages = sorted(rec["stages"],
+                    key=lambda e: float(e.get("rel_ms", 0.0)))
+    for ev in stages:
+        detail = "  ".join(
+            f"{k}={ev[k]}" for k in sorted(ev)
+            if k not in _WATERFALL_SKIP and ev[k] is not None)
+        rows.append([str(ev.get("stage", "?")),
+                     f"{float(ev.get('rel_ms', 0.0)):.3f}",
+                     f"{float(ev.get('dur_ms', 0.0)):.3f}",
+                     str(ev.get("n", 1)), detail])
+    widths = [max(len(r[i]) for r in [header] + rows)
+              for i in range(len(header))]
+    lines.append("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    lines.append("  ".join("-" * w for w in widths))
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    lines.append("")
+    lines.append("rel_ms from request submit; n = batched decode/spec/"
+                 "cow steps coalesced into the row")
+    return "\n".join(lines)
+
+
+def format_listing(exemplars: dict[str, dict]) -> str:
+    header = ["request_id", "status", "tokens", "ttft_ms", "tpot_ms",
+              "stages", "bundle"]
+    rows = []
+    for rid, rec in sorted(exemplars.items()):
+        s = rec["summary"]
+
+        def _ms(v):
+            return f"{v:.2f}" if isinstance(v, (int, float)) else "-"
+
+        rows.append([rid, str(s.get("status", "?")),
+                     str(s.get("tokens", "?")), _ms(s.get("ttft_ms")),
+                     _ms(s.get("tpot_ms")), str(len(rec["stages"])),
+                     str(rec.get("bundle_id", "-"))])
+    widths = [max(len(r[i]) for r in [header] + rows)
+              for i in range(len(header))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(header, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in rows]
+    lines.append("")
+    lines.append("pass --request-id <id> for one request's stage "
+                 "waterfall (prefix match ok)")
+    return "\n".join(lines)
+
+
+def trace_entries(rid: str, rec: dict) -> list[dict]:
+    """obs_report.chrome_trace input: one entry per stage event with
+    ``source`` = the STAGE name, so the export opens with one track per
+    stage (queue / admit / prefill / decode / spec / ... each its own
+    pid row) and the request reads left-to-right across tracks."""
+    t0 = rec["summary"].get("t0")
+    t0 = float(t0) if isinstance(t0, (int, float)) else 0.0
+    entries = []
+    for ev in rec["stages"]:
+        rel_ms = float(ev.get("rel_ms", 0.0))
+        entry = {"t": t0 + rel_ms / 1e3,
+                 "source": str(ev.get("stage", "?")),
+                 "kind": "serve.trace",
+                 "name": str(ev.get("stage", "?")),
+                 "request_id": rid,
+                 "n": ev.get("n", 1)}
+        dur = ev.get("dur_ms")
+        if isinstance(dur, (int, float)) and dur > 0:
+            entry["dur_ms"] = float(dur)
+        for k in sorted(ev):
+            if k not in _WATERFALL_SKIP and ev[k] is not None:
+                entry[k] = ev[k]
+        entries.append(entry)
+    return entries
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("files", nargs="+",
+                   help="JSONL metric streams and/or bundle JSON files")
+    p.add_argument("--request-id", default=None,
+                   help="render this request's waterfall (prefix ok)")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="write Chrome-trace JSON (one track per stage); "
+                        "needs --request-id")
+    a = p.parse_args(argv)
+    exemplars = collect_exemplars(gather_bundles(a.files))
+    if not exemplars:
+        print(f"no serve.trace.* exemplars found in {len(a.files)} "
+              "file(s) — is the engine running with tracing on and a "
+              "flight recorder configured?")
+        return 1
+    if a.request_id is None:
+        if a.trace:
+            p.error("--trace needs --request-id")
+        print(format_listing(exemplars))
+        return 0
+    hits = [rid for rid in sorted(exemplars)
+            if rid == a.request_id or rid.startswith(a.request_id)]
+    if not hits:
+        print(f"request id {a.request_id!r} not among the "
+              f"{len(exemplars)} frozen exemplar(s); run without "
+              "--request-id to list them")
+        return 1
+    if len(hits) > 1:
+        print(f"prefix {a.request_id!r} is ambiguous: "
+              + ", ".join(hits))
+        return 1
+    rid = hits[0]
+    print(format_waterfall(rid, exemplars[rid]))
+    if a.trace:
+        trace = obs_report.chrome_trace(trace_entries(rid,
+                                                      exemplars[rid]))
+        with open(a.trace, "w") as f:
+            json.dump(trace, f, indent=1)
+        print(f"\nchrome trace written to {a.trace} "
+              f"({len(trace['traceEvents'])} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # | head et al. closing stdout is not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

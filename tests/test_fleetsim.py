@@ -414,3 +414,82 @@ def test_thousand_actor_acceptance_run(serve_engine):
                                   fs.simulate(spec.control()), pts)
     assert json.dumps(card, sort_keys=True) == \
         json.dumps(card2, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate alerting (engine/health.BurnRateMonitor on the sim clock)
+# ---------------------------------------------------------------------------
+
+def test_slo_burn_detects_injected_latency_regression():
+    """The injected-latency-regression scenario: servers' synthetic
+    request stream slows by the factor from the injected round on, the
+    multi-window burn alert must page within the gate's detect window,
+    and the control twin (no injection) must stay silent."""
+    spec = smoke_spec(rounds=9, latency_regression_round=6)
+    assert spec.control().latency_regression_round == 0
+    res = fs.simulate(spec)
+    ctrl = fs.simulate(spec.control())
+    card = fs.assemble_scorecard(res, ctrl)
+    sb = card["slo_burn"]
+    assert sb["injected_round"] == 6 and sb["alerts"] > 0
+    assert sb["first_fire_round"] >= 6
+    assert 1 <= sb["detect_rounds"] <= 3
+    assert sb["control_alerts"] == 0          # zero false positives
+    assert sb["peak_burn"] > 1.0
+    # the regression violates the ttft objective; names carry slo+pair
+    assert any(n.startswith("ttft.") for n in sb["alert_names"])
+    gate = card["gates"]["slo_burn"]
+    assert gate["ok"], gate
+    assert gate["detect_rounds"] <= gate["detect_rounds_max"]
+    # the regression is visible in the servers' heartbeat-side numbers
+    # the fleet_report slo_burn column reads
+    assert res.burn_peak > ctrl.burn_peak
+
+
+def test_slo_burn_clean_fleet_stays_silent():
+    """No injection: zero alerts, and the gate is vacuous (absent) —
+    a page on a healthy fleet would be a gate failure instead."""
+    spec = smoke_spec(rounds=6)
+    card = fs.assemble_scorecard(fs.simulate(spec))
+    sb = card["slo_burn"]
+    assert sb["injected_round"] == 0 and sb["alerts"] == 0
+    assert sb["detect_rounds"] is None
+    assert "slo_burn" not in card["gates"]
+    # a false positive IS a failing gate: forge one alert on the
+    # uninjected card
+    bad = json.loads(json.dumps(card))
+    bad["slo_burn"]["alerts"] = 2
+    bad["slo_burn"]["alert_names"] = ["ttft.fast"]
+    gates = fs.evaluate_gates(bad)
+    assert not gates["slo_burn"]["ok"]
+    assert gates["slo_burn"]["false_positives"] == 2
+
+
+def test_slo_burn_scenario_is_seed_deterministic():
+    """The burn section rides the same determinism contract as the rest
+    of the scorecard: same seed, byte-identical (modulo timestamp)."""
+    spec = smoke_spec(rounds=9, latency_regression_round=6, seed=5)
+    a = fs.finalize_scorecard(fs.assemble_scorecard(fs.simulate(spec)),
+                              now=1.0)
+    b = fs.finalize_scorecard(fs.assemble_scorecard(fs.simulate(spec)),
+                              now=2.0)
+    assert a["slo_burn"] == b["slo_burn"]
+    assert a["scorecard_id"] == b["scorecard_id"]
+
+
+def test_slo_burn_baseline_gate_catches_detection_regression():
+    """--baseline: time-to-page may not regress past the prior
+    scorecard's detect_rounds by more than one round."""
+    spec = smoke_spec(rounds=9, latency_regression_round=6)
+    card = fs.assemble_scorecard(fs.simulate(spec),
+                                 fs.simulate(spec.control()))
+    base = json.loads(json.dumps(card))
+    ok = fs.evaluate_gates(card, baseline=base)
+    assert ok["baseline"]["ok"], ok["baseline"]
+    # a baseline that paged much faster than we now do fails the gate
+    faster = json.loads(json.dumps(card))
+    faster["slo_burn"]["detect_rounds"] = \
+        card["slo_burn"]["detect_rounds"] - 2
+    gates = fs.evaluate_gates(card, baseline=faster)
+    assert not gates["baseline"]["ok"]
+    assert any("slo_burn" in p for p in gates["baseline"]["problems"])

@@ -31,11 +31,16 @@ import pytest
 from distributedtraining_tpu.engine import TrainEngine
 from distributedtraining_tpu.engine.average import (AveragerLoop,
                                                     WeightedAverage)
-from distributedtraining_tpu.engine.health import (FleetMonitor,
+from distributedtraining_tpu.engine.health import (BURN_WINDOWS,
+                                                   BurnRateMonitor,
+                                                   BurnRule, FleetMonitor,
                                                    HeartbeatPublisher,
                                                    NodeHealth, SLORule,
-                                                   Vitals, build_heartbeat,
+                                                   Vitals, attach_burn,
+                                                   build_heartbeat,
+                                                   default_burn_rules,
                                                    default_slo_rules,
+                                                   live_burn_monitor,
                                                    parse_heartbeat,
                                                    report_vitals)
 from distributedtraining_tpu.engine.ingest import StagedDelta
@@ -641,3 +646,140 @@ def test_fleet_round_localfs_ledger_matches_merge_and_stale_slo(tmp_path):
     # machine-readable: the ledger the driver asserts against
     out = json.dumps(rep, default=float)
     assert json.loads(out)["nodes"]["miner/hotkey_1"]["accepted"] == 4
+
+
+# ---------------------------------------------------------------------------
+# BurnRateMonitor: multi-window SLO burn over the request-trace stream
+# ---------------------------------------------------------------------------
+
+def test_burn_rule_vocabulary_validated():
+    with pytest.raises(ValueError, match="unknown burn SLO"):
+        BurnRule("latency", objective_ms=100.0)
+    with pytest.raises(ValueError, match="budget"):
+        BurnRule("shed", budget=0.0)
+    with pytest.raises(ValueError, match="objective_ms"):
+        BurnRule("ttft")          # a latency rule needs an objective
+    with pytest.raises(ValueError, match="one BurnRule per slo"):
+        BurnRateMonitor([BurnRule("shed"), BurnRule("shed")])
+    slos = {r.slo for r in default_burn_rules()}
+    assert slos == {"ttft", "tpot", "shed"}
+
+
+def test_burn_math_min_samples_and_window_cutoff():
+    """burn = (bad/n)/budget over the trailing window; sparse traffic
+    (< min_samples in window) reads 0.0 so a quiet server never pages;
+    events aging out of the window stop counting."""
+    now = [10_000.0]
+    mon = BurnRateMonitor([BurnRule("ttft", objective_ms=100.0,
+                                    budget=0.1)],
+                          clock=lambda: now[0], min_samples=10)
+    # 9 violations: still below min_samples => 0.0
+    for i in range(9):
+        mon.observe(now[0], ttft_ms=500.0)
+    assert mon.burn("ttft", 300.0) == 0.0
+    mon.observe(now[0], ttft_ms=10.0)
+    # 10 samples, 9 bad: (0.9)/0.1 = 9.0
+    assert mon.burn("ttft", 300.0) == pytest.approx(9.0)
+    assert mon.max_burn() == pytest.approx(9.0)
+    # 30 good samples later, the window dilutes
+    for _ in range(30):
+        mon.observe(now[0], ttft_ms=10.0)
+    assert mon.burn("ttft", 300.0) == pytest.approx((9 / 40) / 0.1)
+    # advance past the window: the old outcomes age out entirely
+    now[0] += 400.0
+    for _ in range(10):
+        mon.observe(now[0], ttft_ms=10.0)
+    assert mon.burn("ttft", 300.0) == 0.0
+    # shed outcomes never pollute the latency stream
+    mon.observe(now[0], shed=True)
+    assert mon.burn("ttft", 300.0) == 0.0
+
+
+def test_burn_alert_needs_both_windows_and_fires_once():
+    """The multi-window rule: a short-window spike alone (blip) does
+    not page; short AND long past the factor does — once per
+    (slo, pair) per monitor lifetime."""
+    now = [100_000.0]
+    mon = BurnRateMonitor([BurnRule("tpot", objective_ms=50.0,
+                                    budget=0.02)],
+                          clock=lambda: now[0], min_samples=5)
+    short_s, long_s, factor = BURN_WINDOWS["fast"]
+    # seed the LONG window with enough good traffic that only the
+    # short window burns: long-window rate stays under factor*budget
+    t_old = now[0] - long_s + 60.0
+    for _ in range(2000):
+        mon.observe(t_old, tpot_ms=1.0)
+    for _ in range(20):
+        mon.observe(now[0], tpot_ms=500.0)
+    assert mon.burn("tpot", short_s) > factor      # short window burns
+    assert mon.burn("tpot", long_s) < factor       # long one does not
+    assert mon.evaluate(now[0]) == []              # blip: no page
+    # sustained: violations now dominate the long window too
+    for _ in range(3000):
+        mon.observe(now[0], tpot_ms=500.0)
+    fired = mon.evaluate(now[0], round_num=7)
+    assert [f"slo_burn.{a['slo_burn']}.{a['window']}" for a in fired] \
+        == ["slo_burn.tpot.fast", "slo_burn.tpot.slow"]
+    assert all(a["burn_short"] > a["factor"] and
+               a["burn_long"] > a["factor"] and a["round"] == 7
+               for a in fired)
+    # once per lifetime
+    assert mon.evaluate(now[0]) == []
+    assert mon.alerts == fired
+
+
+def test_burn_shed_stream_escalation_and_gauges():
+    """The shed SLO sees EVERY outcome (completion = good, refusal =
+    bad); firing walks the standard escalation (metrics sink +
+    anomaly one-shot) and the gauges export the full slo x window
+    matrix for dt_slo_burn."""
+
+    class _Anom:
+        def __init__(self):
+            self.fired = []
+
+        def trigger_external(self, reason, **details):
+            self.fired.append(reason)
+
+    now = [50_000.0]
+    sink = InMemorySink()
+    anom = _Anom()
+    mon = BurnRateMonitor([BurnRule("shed", budget=0.02)],
+                          clock=lambda: now[0], metrics=sink,
+                          anomaly=anom, min_samples=5)
+    for _ in range(100):
+        mon.observe(now[0], ttft_ms=10.0)   # completions: good
+    assert mon.evaluate(now[0]) == []
+    for _ in range(400):
+        mon.observe(now[0], shed=True)      # refusals burn
+    fired = mon.evaluate(now[0])
+    assert {a["window"] for a in fired} == {"fast", "slow"}
+    assert anom.fired == ["slo_burn.shed.fast", "slo_burn.shed.slow"]
+    logged = [r for r in sink.records if r.get("slo_burn") == "shed"]
+    assert len(logged) == 2
+    gauges = mon.gauges(now[0])
+    assert set(gauges) == {("shed", w)
+                           for w in ("5m", "30m", "1h", "6h")}
+    assert gauges[("shed", "5m")] > 14.4
+
+
+def test_attach_burn_exports_dt_slo_burn():
+    """obs_http.render picks up whichever monitor the serving role
+    attached; detach (or monitor death) removes the series — weakref,
+    a closed engine must not pin its monitor."""
+    now = [1_000.0]
+    mon = BurnRateMonitor(clock=lambda: now[0], min_samples=1)
+    for _ in range(20):
+        mon.observe(now[0], ttft_ms=999.0, tpot_ms=1.0)
+    attach_burn(mon)
+    try:
+        assert live_burn_monitor() is mon
+        body = render(registry=obs.registry(), fleet=None)
+        assert '# TYPE dt_slo_burn gauge' in body
+        assert 'dt_slo_burn{slo="ttft",window="5m"}' in body
+        assert 'dt_slo_burn{slo="shed",window="6h"}' in body
+    finally:
+        attach_burn(None)
+    assert live_burn_monitor() is None
+    assert "dt_slo_burn" not in render(registry=obs.registry(),
+                                       fleet=None)

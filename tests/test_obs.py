@@ -593,3 +593,80 @@ def test_every_exporter_metric_name_is_documented():
         "metric names the exporter can emit are missing from "
         f"docs/observability.md: {missing} — add a table row (or a "
         "placeholder rule row) for each")
+
+
+def test_concurrent_scrapes_during_registry_flush():
+    """Satellite: /metrics and /debug/dump raced from two scraper
+    threads while the main thread churns the registry with flushes and
+    new series — every response parses, no 500s, no torn Prometheus
+    text (partial lines / missing trailing newline), and the exporter
+    survives to serve a clean final scrape."""
+    import urllib.request
+
+    from distributedtraining_tpu.utils import flight
+    from distributedtraining_tpu.utils.obs_http import ObsHTTPExporter
+
+    sink = InMemorySink()
+    obs.configure(sink, role="scraper")
+    flight.configure("scraper", "s0")
+    exp = ObsHTTPExporter(0, role="scraper")
+    port = exp.start()
+    stop = threading.Event()
+    errors: list = []
+    bodies: list = []
+
+    def _scrape(path, parse):
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}",
+                        timeout=10) as r:
+                    raw = r.read().decode()
+                    assert r.status == 200
+                parse(raw)
+                bodies.append(path)
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                errors.append((path, repr(e)))
+                return
+
+    def _parse_prom(raw):
+        assert raw.endswith("\n"), "torn text: no trailing newline"
+        for ln in raw.splitlines():
+            if ln and not ln.startswith("#"):
+                name = ln.split("{")[0].split(" ")[0]
+                assert name.startswith("dt_"), f"torn line: {ln!r}"
+                float(ln.rsplit(" ", 1)[1])
+
+    threads = [
+        threading.Thread(target=_scrape, args=("/metrics", _parse_prom)),
+        threading.Thread(target=_scrape,
+                         args=("/debug/dump", json.loads)),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        # churn: new counter names, histogram traffic, full flushes and
+        # flight events racing the scrapers' renders — keep churning
+        # until both endpoints have been scraped several times
+        import time as _time
+        deadline = _time.time() + 30.0
+        i = 0
+        while (bodies.count("/metrics") < 4
+               or bodies.count("/debug/dump") < 4) and not errors \
+                and _time.time() < deadline:
+            obs.count(f"scrape.race_{i % 7}")
+            obs.observe("scrape.lat_ms", float(i))
+            obs.gauge("scrape.g", float(i))
+            flight.record("note", text=f"race {i}")
+            obs.registry().flush_to(sink, step=i)
+            i += 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        exp.close()
+        flight.shutdown()
+    assert not errors, errors
+    # both endpoints actually got scraped repeatedly under churn
+    assert bodies.count("/metrics") > 3
+    assert bodies.count("/debug/dump") > 3

@@ -1,0 +1,395 @@
+"""Request-scoped serving traces (utils/reqtrace.py).
+
+Covers the unit layer (id minting, the closed stage vocabulary, the
+coalescing timeline, TraceBook lifecycle on a fake clock), the
+producer-site lint that keeps every wired module inside the stage
+vocabulary (satellite of the devprof/flight closed-vocabulary pattern),
+and two end-to-end stories over a real engine: tracing changes no
+emitted token (parity with trace=False), and a sealed window freezes
+tail exemplars into the flight recorder that
+scripts/request_report.py can replay as a waterfall + Chrome trace.
+"""
+
+import ast
+import json
+import os
+import sys
+import urllib.request
+
+import jax
+import pytest
+
+from distributedtraining_tpu.engine.serve import (GenerationEngine,
+                                                  ServeHTTPFrontend,
+                                                  ServeLoop,
+                                                  reference_generate)
+from distributedtraining_tpu.models import gpt2
+from distributedtraining_tpu.transport.memory import InMemoryTransport
+from distributedtraining_tpu.utils import flight, obs, reqtrace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.request_report import (collect_exemplars,  # noqa: E402
+                                    format_listing, format_waterfall,
+                                    trace_entries)
+
+TINY = gpt2.GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                       n_layer=2, n_head=2, dtype="float32",
+                       vocab_multiple=64)
+
+
+# ---------------------------------------------------------------------------
+# mint_request_id
+# ---------------------------------------------------------------------------
+
+def test_mint_is_content_addressable():
+    """Same (content, meta, seq) => bit-identical id; any ingredient
+    change => different id. That reproducibility is what lets the
+    router, the engine, and an offline report agree on one identity."""
+    a = reqtrace.mint_request_id([1, 2, 3], seq=0, temperature=0.5)
+    b = reqtrace.mint_request_id([1, 2, 3], seq=0, temperature=0.5)
+    assert a == b
+    assert a.startswith("rq-") and len(a) == 3 + 16
+    assert reqtrace.mint_request_id([1, 2, 3], seq=1,
+                                    temperature=0.5) != a
+    assert reqtrace.mint_request_id([1, 2, 4], seq=0,
+                                    temperature=0.5) != a
+    assert reqtrace.mint_request_id([1, 2, 3], seq=0,
+                                    temperature=0.7) != a
+
+
+def test_mint_accepts_bytes_str_and_tokens():
+    for content in (b"hello", "hello", [1, 2, 3]):
+        rid = reqtrace.mint_request_id(content, seq=7)
+        assert rid.startswith("rq-")
+    # retries without an explicit seq stay distinguishable
+    assert reqtrace.mint_request_id(b"x") != reqtrace.mint_request_id(b"x")
+
+
+# ---------------------------------------------------------------------------
+# the closed stage vocabulary
+# ---------------------------------------------------------------------------
+
+def test_unknown_stage_rejected_at_producer():
+    assert reqtrace.check_stage("decode") == "decode"
+    with pytest.raises(ValueError, match="unknown reqtrace stage"):
+        reqtrace.check_stage("frobnicate")
+    tr = reqtrace.RequestTrace("rq-x", 0, 0.0)
+    with pytest.raises(ValueError, match="unknown reqtrace stage"):
+        tr.record("decodez", 1.0)
+    book = reqtrace.TraceBook()
+    with pytest.raises(ValueError, match="unknown reqtrace stage"):
+        book.reject(None, "overloaded")
+
+
+_WIRED = ("engine/serve.py", "engine/router.py", "engine/speculative.py",
+          "utils/loadgen.py")
+
+
+def test_producer_sites_use_registered_stages():
+    """The devprof/flight pattern for reqtrace: AST-walk every wired
+    module for ``.stage(rid, "<literal>")`` / ``.reject(id, "<literal>")``
+    call sites and require each literal to be a registered stage. A new
+    instrumentation site with a typo'd stage fails HERE even if no test
+    happens to drive that code path."""
+    import distributedtraining_tpu
+    pkg = os.path.dirname(distributedtraining_tpu.__file__)
+    found: dict[str, set[str]] = {}
+    for rel in _WIRED:
+        with open(os.path.join(pkg, rel)) as f:
+            tree = ast.parse(f.read())
+        names = set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("stage", "stage_span",
+                                           "reject")
+                    and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)):
+                continue
+            names.add(node.args[1].value)
+        found[rel] = names
+    # the wiring actually exists (an empty lint proves nothing)
+    assert found["engine/serve.py"] >= {"admit", "prefill", "decode",
+                                        "spec", "cow", "preempt", "shed"}
+    assert "spec_draft" in found["engine/speculative.py"]
+    for rel, names in found.items():
+        unknown = names - set(reqtrace.STAGES)
+        assert not unknown, f"{rel} records unregistered stages {unknown}"
+
+
+# ---------------------------------------------------------------------------
+# RequestTrace: the coalescing timeline
+# ---------------------------------------------------------------------------
+
+def test_per_step_stages_coalesce():
+    """Consecutive decode/spec/cow entries merge into one batched row
+    (n steps, numeric fields accumulated) so a long generation keeps a
+    bounded timeline; non-coalescing stages always append."""
+    tr = reqtrace.RequestTrace("rq-x", 0, 100.0)
+    tr.record("queue", 100.0, depth=0)
+    tr.record("admit", 100.1)
+    tr.record("prefill", 100.2, pfx_hit=0, pfx_tokens=0)
+    for i in range(10):
+        tr.record("decode", 100.3 + i * 0.01, tokens=1)
+    tr.record("spec", 100.5, n_rounds=1, proposed=4, accepted=3)
+    tr.record("spec", 100.6, n_rounds=1, proposed=4, accepted=1)
+    tr.record("decode", 100.7, tokens=1)
+    names = [e["stage"] for e in tr.stages]
+    assert names == ["queue", "admit", "prefill", "decode", "spec",
+                     "decode"]
+    dec = tr.stages[3]
+    assert dec["n"] == 10 and dec["tokens"] == 10
+    assert dec["t"] == pytest.approx(100.3)
+    assert dec["t_last"] == pytest.approx(100.39)
+    spec = tr.stages[4]
+    assert spec["n"] == 2 and spec["proposed"] == 8 and spec["accepted"] == 4
+    # readmit (not in _COALESCE) appends even when consecutive
+    tr.record("preempt", 100.8)
+    tr.record("preempt", 100.9)
+    assert [e["stage"] for e in tr.stages[-2:]] == ["preempt", "preempt"]
+
+
+def test_timeline_overflow_is_flagged_not_unbounded():
+    tr = reqtrace.RequestTrace("rq-x", 0, 0.0)
+    for i in range(200):
+        # alternate so nothing coalesces
+        tr.record("preempt" if i % 2 else "readmit", float(i))
+    assert len(tr.stages) == reqtrace._MAX_STAGES
+    assert tr.overflow == 200 - reqtrace._MAX_STAGES
+    assert tr.as_record()["overflow"] == tr.overflow
+
+
+def test_note_latency_tpot_averages():
+    tr = reqtrace.RequestTrace("rq-x", 0, 0.0)
+    assert tr.tpot_ms is None
+    tr.note_latency(ttft_ms=12.5)
+    tr.note_latency(tpot_ms=4.0)
+    tr.note_latency(tpot_ms=8.0)
+    assert tr.ttft_ms == 12.5
+    assert tr.tpot_ms == pytest.approx(6.0)
+
+
+# ---------------------------------------------------------------------------
+# TraceBook lifecycle (fake clock, stub burn monitor)
+# ---------------------------------------------------------------------------
+
+class _Req:
+    """The slice of serve.ServeRequest the book reads."""
+
+    def __init__(self, rid, request_id=None, t=1000.0):
+        self.rid = rid
+        self.request_id = request_id
+        self.submitted_t = t
+        self.tokens = [1, 2, 3]
+
+
+class _Burn:
+    def __init__(self):
+        self.seen = []
+
+    def observe(self, t, **kw):
+        self.seen.append((t, kw))
+
+
+def test_tracebook_lifecycle_and_burn_feed():
+    now = [1000.0]
+    burn = _Burn()
+    book = reqtrace.TraceBook(clock=lambda: now[0], exemplar_k=2,
+                              window_s=30.0, burn=burn)
+    req = _Req(0, "rq-aaaa")
+    book.start(req, depth=3)
+    assert book.live_count == 1 and book.started == 1
+    now[0] = 1000.2
+    book.stage(0, "admit", queue_age_ms=200.0)
+    book.stage(0, "prefill", pfx_hit=0, pfx_tokens=0)
+    book.note_latency(0, ttft_ms=200.0)
+    book.stage(0, "decode", tokens=1)
+    book.note_latency(0, tpot_ms=5.0)
+    assert book.seen(0, "admit") and not book.seen(0, "spec")
+    # untracked rid: silent no-op, never raises
+    book.stage(99, "decode", tokens=1)
+    now[0] = 1000.5
+    tr = book.finish(req, "done")
+    assert tr is not None and tr.status == "done"
+    assert tr.stages[-1]["stage"] == "emit"
+    assert tr.stages[-1]["tokens"] == 3
+    assert book.live_count == 0 and book.finished == 1
+    # finish fed the burn monitor the latency outcome
+    assert burn.seen == [(1000.5, {"ttft_ms": 200.0, "tpot_ms": 5.0})]
+    # double finish: trace already popped, no double count
+    assert book.finish(req, "done") is None
+    assert book.finished == 1
+    # reject feeds the shed stream and mints when the caller had no id
+    rid = book.reject(None, "shed", retry_after_s=0.5)
+    assert rid.startswith("rq-") and book.rejected == 1
+    assert burn.seen[-1] == (1000.5, {"shed": True})
+    rid2 = book.reject("rq-keep", "drain")
+    assert rid2 == "rq-keep"
+    c = book.counters()
+    assert c["trace_finished"] == 1.0 and c["trace_rejected"] == 2.0
+
+
+def test_window_auto_seals_on_expiry():
+    now = [0.0]
+    book = reqtrace.TraceBook(clock=lambda: now[0], window_s=10.0)
+    r0 = _Req(0, "rq-a", t=0.0)
+    book.start(r0)
+    now[0] = 1.0
+    book.finish(r0, "done")
+    assert book.windows_sealed == 0          # window still open
+    r1 = _Req(1, "rq-b", t=2.0)
+    book.start(r1)
+    now[0] = 11.0                            # past window_s
+    book.finish(r1, "done")
+    assert book.windows_sealed == 1
+    # flight recorder unconfigured: sealed (counted) but nothing frozen
+    assert book.exemplars_frozen == 0 and book.last_pm_ref is None
+
+
+def test_exemplar_pick_is_ttft_union_tpot_tails():
+    book = reqtrace.TraceBook(exemplar_k=1)
+    slow_ttft = reqtrace.RequestTrace("rq-t", 0, 0.0)
+    slow_ttft.note_latency(ttft_ms=500.0, tpot_ms=1.0)
+    slow_tpot = reqtrace.RequestTrace("rq-p", 1, 0.0)
+    slow_tpot.note_latency(ttft_ms=1.0, tpot_ms=80.0)
+    fast = reqtrace.RequestTrace("rq-f", 2, 0.0)
+    fast.note_latency(ttft_ms=2.0, tpot_ms=2.0)
+    picked = book._pick_exemplars([fast, slow_ttft, slow_tpot])
+    assert {t.request_id for t in picked} == {"rq-t", "rq-p"}
+
+
+# ---------------------------------------------------------------------------
+# end to end over a real engine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def setup():
+    model, cfg = gpt2.make_model(TINY)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=8)
+    prompts = [[7, 3, 11, 2, 9], [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
+               [42, 0, 99]]
+    return model, params, prompts
+
+
+def test_engine_trace_parity_and_stage_story(setup):
+    """Tracing on vs off: bit-identical tokens (host-side only — no new
+    jit programs, no device work), and every finished request tells the
+    queue -> admit -> prefill -> decode -> emit story with latency
+    attribution filled in."""
+    model, params, prompts = setup
+    eng_on = GenerationEngine(model, params, max_slots=4, page_size=8,
+                              trace=True, trace_window_s=1e9)
+    eng_off = GenerationEngine(model, params, max_slots=4, page_size=8,
+                               trace=False)
+    try:
+        got_on = eng_on.generate(prompts, max_new_tokens=8)
+        got_off = eng_off.generate(prompts, max_new_tokens=8)
+        assert got_on == got_off
+        assert eng_off.trace is None
+        book = eng_on.trace
+        assert book.started == len(prompts) == book.finished
+        assert book.live_count == 0
+        # finished traces wait in the open reservoir window
+        assert len(book._window) == len(prompts)
+        for tr in book._window:
+            names = [e["stage"] for e in tr.stages]
+            assert names[0] == "queue" and names[-1] == "emit"
+            assert "admit" in names and "prefill" in names
+            assert "decode" in names or "spec" in names
+            assert tr.status == "done"
+            assert tr.tokens == 8
+            assert tr.ttft_ms is not None and tr.ttft_ms >= 0.0
+            assert tr.tpot_ms is not None
+            assert tr.request_id.startswith("rq-")
+        # content-addressable: ids distinct across distinct requests
+        assert len({t.request_id for t in book._window}) == len(prompts)
+    finally:
+        eng_on.close()
+        eng_off.close()
+
+
+def test_seal_window_freezes_exemplars_and_report_replays(setup, tmp_path):
+    """The full forensic loop: live engine -> seal_window freezes the
+    tail exemplars into the flight recorder -> request_report.py
+    rebuilds the waterfall and the Chrome trace (one track per stage)
+    from the published bundle alone."""
+    model, params, prompts = setup
+    transport = InMemoryTransport()
+    flight.configure("server", "s0", transport=transport)
+    eng = GenerationEngine(model, params, max_slots=4, page_size=8,
+                           trace=True, trace_exemplars=8,
+                           trace_window_s=1e9)
+    try:
+        eng.generate(prompts, max_new_tokens=8)
+        ref = eng.trace.seal_window()
+        assert ref, "seal_window must publish a bundle ref"
+        assert eng.trace.last_pm_ref == ref
+        assert eng.trace.exemplars_frozen == len(prompts)
+        bundle = flight.fetch_bundle(transport, "server", "s0")
+    finally:
+        eng.close()
+        flight.shutdown()
+    assert bundle is not None and bundle["bundle_id"] == ref
+    kinds = {e["kind"] for e in bundle["events"]}
+    assert {"serve.trace.exemplar", "serve.trace.stage"} <= kinds
+
+    exemplars = collect_exemplars([bundle])
+    assert len(exemplars) == len(prompts)
+    listing = format_listing(exemplars)
+    rid, rec = sorted(exemplars.items())[0]
+    assert rid in listing
+    # the waterfall names every stage of the request's own timeline
+    text = format_waterfall(rid, rec)
+    for ev in rec["stages"]:
+        assert ev["stage"] in text
+    assert "ttft_ms" in text and "tokens=8" in text
+    # chrome trace: one track (source) per STAGE, entries carry the
+    # batched step counts
+    entries = trace_entries(rid, rec)
+    assert {e["source"] for e in entries} == \
+        {e["stage"] for e in rec["stages"]}
+    assert all(e["request_id"] == rid for e in entries)
+    emit = [e for e in entries if e["source"] == "emit"]
+    assert emit and emit[0]["tokens"] == 8
+
+
+def test_http_frontend_propagates_request_id(setup):
+    """The X-DT-Request-Id contract at the serving edge: a caller-sent
+    id is honored end to end (body + echo header); an id-less caller
+    gets an engine-minted one."""
+    model, params, _ = setup
+    eng = GenerationEngine(model, params, max_slots=2, page_size=8,
+                           trace=True, trace_window_s=1e9)
+    loop = ServeLoop(eng, idle_poll_s=0.02).start()
+    fe = ServeHTTPFrontend(eng, 0, timeout_s=60.0)
+    port = fe.start()
+    try:
+        prompt = [5, 4, 3, 2, 1]
+        body = json.dumps({"tokens": prompt,
+                           "max_new_tokens": 4}).encode()
+        rid = "rq-cafecafecafecafe"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json",
+                     reqtrace.REQUEST_ID_HEADER: rid})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+            echoed = resp.headers.get(reqtrace.REQUEST_ID_HEADER)
+        assert out["request_id"] == rid and echoed == rid
+        assert out["tokens"] == reference_generate(model, params, prompt, 4)
+        # the trace carries the caller's identity, not a re-mint
+        assert any(t.request_id == rid for t in eng.trace._window)
+        # no header: engine mints
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out2 = json.loads(resp.read())
+        assert out2["request_id"].startswith("rq-")
+        assert out2["request_id"] != rid
+    finally:
+        fe.close()
+        loop.close()
+        eng.close()

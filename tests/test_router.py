@@ -265,3 +265,87 @@ def test_router_retries_next_backend_on_failure(fleet):
         assert router.routed == 1
     finally:
         router.close()
+
+
+def test_router_honors_backend_retry_after(fleet):
+    """Satellite: a backend answering 429 with its own Retry-After gets
+    that back-pressure honored (capped) BEFORE the next-best retry —
+    the wait is observable via the patched sleep, the counter ticks,
+    and the caller still gets a 200 from the second backend."""
+    import http.server
+    import threading
+
+    from distributedtraining_tpu.utils import reqtrace
+
+    seen_ids = []
+
+    class _Shedding(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            seen_ids.append(self.headers.get(reqtrace.REQUEST_ID_HEADER))
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = json.dumps({"error": "overloaded"}).encode()
+            self.send_response(429)
+            self.send_header("Retry-After", "30")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):    # /healthz for the poll sweep
+            body = json.dumps({"role": "server", "queue_depth": 0,
+                               "active": 0}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    model, params, urls = fleet
+    shed_srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Shedding)
+    threading.Thread(target=shed_srv.serve_forever, daemon=True).start()
+    shed_url = f"http://127.0.0.1:{shed_srv.server_address[1]}"
+    router = RouterHTTPFrontend([shed_url] + urls, 0,
+                                poll_interval_s=30.0, timeout_s=60.0,
+                                retry_after_cap_s=0.05)
+    waits = []
+    router._sleep = waits.append
+    router.refresh()
+    try:
+        # make the shedding backend the policy's first choice
+        for b in router.backends:
+            b.queue_depth = 0 if b.url == shed_url else 1
+            b.healthy = True
+            b.revision = "r1"    # else majority-revision ranks it last
+        prompt = [9, 8, 7]
+        body = json.dumps({"tokens": prompt,
+                           "max_new_tokens": 4}).encode()
+        rid = "rq-0123456789abcdef"
+        code, out, hdrs = router._route(body, rid)
+        assert code == 200
+        assert out["tokens"] == reference_generate(model, params, prompt, 4)
+        assert out["backend"] in urls            # retried next-best
+        assert out["request_id"] == rid
+        assert hdrs[reqtrace.REQUEST_ID_HEADER] == rid
+        assert seen_ids == [rid]                 # id reached the backend
+        # the backend's Retry-After (30s) honored but capped at 0.05s
+        assert waits == [0.05]
+        assert router.retry_after_honored == 1
+        assert router.routed == 1 and router.shed == 0
+    finally:
+        router.close()
+        shed_srv.shutdown()
+        shed_srv.server_close()
+
+
+def test_retry_after_cap_zero_disables_wait(fleet):
+    """retry_after_cap_s=0: the back-pressure wait is off, the retry is
+    immediate, the counter stays 0 (ops can disable the stall)."""
+    _, _, urls = fleet
+    router = RouterHTTPFrontend(urls, 0, poll_interval_s=30.0,
+                                timeout_s=60.0, retry_after_cap_s=0.0)
+    waits = []
+    router._sleep = waits.append
+    assert router.retry_after_cap_s == 0.0
+    router.close()
+    assert waits == [] and router.retry_after_honored == 0
