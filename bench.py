@@ -1324,6 +1324,158 @@ def _time_serve_speculative(*, n_requests: int = 2, prompt_len: int = 16,
         obs.reset()
 
 
+def _time_kv_transfer(*, n_requests: int = 6, prompt_len: int = 24,
+                      gen_tokens: int = 16) -> dict:
+    """KV transfer plane A/B (round-24 tentpole): the disaggregated
+    export -> publish -> fetch -> adopt path between a prefill-phase
+    worker and a decode-phase worker over an in-memory transport,
+    against the unified engine as the oracle. Three pins ride along:
+    (1) parity — the disaggregated output (prefill worker's first
+    token re-emitted, decode worker's paged decode after page
+    adoption) must be token-identical for greedy lanes and
+    bit-identical for sampled lanes (the counter PRNG makes token
+    index, not worker, the stream coordinate); (2) dedupe — a second
+    wave over the same prompts must publish manifest-only bytes (the
+    content-addressed shards are already in the store on both sides);
+    (3) zero steady-state fresh compiles on BOTH worker classes (the
+    adopt program compiles once in wave 1, the bucket ladders are
+    phase-subset warm after it). The virtual-clock serve lane then
+    contrasts a unified worker under the prefill head-of-line cost
+    model against a 1-prefill + 1-decode pair at the same offered
+    load — the tpot p95 gain is the number the fleetsim
+    ``disagg_tpot_gain_min`` gate holds."""
+    from distributedtraining_tpu.engine import kv_transfer as kvt
+    from distributedtraining_tpu.engine.serve import GenerationEngine
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+    from distributedtraining_tpu.utils import loadgen, obs
+
+    cfg = gpt2.GPT2Config(vocab_size=256, n_positions=128, n_embd=64,
+                          n_layer=2, n_head=4, dtype="float32",
+                          vocab_multiple=128)
+    model, cfg = gpt2.make_model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), seq_len=8)
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(0, cfg.vocab_size, size=prompt_len))
+               for _ in range(n_requests)]
+    seq = ((prompt_len + gen_tokens + 15) // 16) * 16
+
+    def _eng(**kw):
+        return GenerationEngine(model, params, revision="r1",
+                                max_slots=n_requests, page_size=16,
+                                max_seq_len=seq, **kw)
+
+    def _drain(eng, reqs):
+        while not all(r.done_evt.is_set() for r in reqs):
+            eng.step()
+
+    def _submit_all(eng, wave, **extra):
+        # even lanes greedy, odd lanes sampled — both must survive the
+        # worker hop bit-identically
+        return [eng.submit(p, gen_tokens,
+                           request_id=f"bench-kv-w{wave}-{i}",
+                           **(extra if i % 2 == 0 else
+                              {**extra, "temperature": 0.8,
+                               "top_p": 0.95, "seed": 17 + i}))
+                for i, p in enumerate(prompts)]
+
+    class _Sink:
+        def log(self, *a, **k):
+            pass
+
+    obs.configure(_Sink(), role="bench")
+    try:
+        uni = _eng()
+        ref_reqs = _submit_all(uni, 0)
+        _drain(uni, ref_reqs)
+        ref = [list(r.tokens) for r in ref_reqs]
+        uni.close()
+
+        tr = InMemoryTransport()
+        exporter = kvt.KVExporter(tr)
+        adopter = kvt.KVAdopter(tr)
+        pe = _eng(phase="prefill", kv_exporter=exporter)
+        de = _eng(phase="decode", kv_adopter=adopter)
+        reg = obs.registry()
+
+        def disagg_wave(wave):
+            pre = _submit_all(pe, wave)
+            _drain(pe, pre)
+            dec = []
+            for i, (p, r) in enumerate(zip(prompts, pre)):
+                kw = {} if i % 2 == 0 else {"temperature": 0.8,
+                                            "top_p": 0.95, "seed": 17 + i}
+                dec.append(de.submit(p, gen_tokens, kv_ref=r.kv_ref,
+                                     first_token=r.first_token, **kw))
+            _drain(de, dec)
+            return [list(r.tokens) for r in dec]
+
+        t0 = time.perf_counter()
+        wave1 = disagg_wave(1)                 # cold: real wire bytes
+        wave1_s = time.perf_counter() - t0
+        wire_bytes = exporter.bytes_published
+        before = reg.histogram("compile.ms").count
+        wave2 = disagg_wave(2)                 # warm: dedupe + no compiles
+        steady_fresh = reg.histogram("compile.ms").count - before
+        rewire_bytes = exporter.bytes_published - wire_bytes
+        parity = (wave1 == ref) and (wave2 == ref)
+        exp_p = reg.histogram("serve.kv_export_ms").percentiles(
+            (50.0, 95.0))
+        fetch_p = reg.histogram("serve.kv_fetch_ms").percentiles(
+            (50.0, 95.0))
+        adopt_p = reg.histogram("serve.kv_adopt_ms").percentiles((95.0,))
+        out = {
+            "kv_transfer_parity": bool(parity),
+            "kv_transfer_wire_bytes": int(wire_bytes),
+            "kv_transfer_bytes_per_request": int(wire_bytes // n_requests),
+            "kv_transfer_rewire_bytes": int(rewire_bytes),
+            "kv_transfer_pages_per_request": int(
+                (prompt_len + 15) // 16),
+            "kv_transfer_export_ms_p50": round(exp_p["p50"], 3),
+            "kv_transfer_export_ms_p95": round(exp_p["p95"], 3),
+            "kv_transfer_fetch_ms_p50": round(fetch_p["p50"], 3),
+            "kv_transfer_fetch_ms_p95": round(fetch_p["p95"], 3),
+            "kv_transfer_adopt_ms_p95": round(adopt_p["p95"], 3),
+            "kv_transfer_wave_s": round(wave1_s, 3),
+            "kv_transfer_adoptions": int(de.kv_adopted),
+            "kv_transfer_reprefills": int(de.kv_reprefills),
+            "kv_transfer_steady_fresh_compiles": int(steady_fresh),
+        }
+        pe.close()
+        de.close()
+
+        # virtual-clock serve lane: unified worker paying the prefill
+        # head-of-line cost vs a phase-split pair at the same offered
+        # load — deterministic (seeded arrivals, virtual step clock),
+        # so the gain is rig-independent
+        spec = loadgen.OpenLoopSpec(rate_rps=24.0, duration_s=4.0,
+                                    seed=0, vocab=cfg.vocab_size,
+                                    max_new_tokens=8)
+        lane = _eng()
+        u = loadgen.run_open_loop(lane, spec, prefill_busy_steps=4)
+        lane.close()
+        tr2 = InMemoryTransport()
+        lp = _eng(phase="prefill", kv_exporter=kvt.KVExporter(tr2))
+        ld = _eng(phase="decode", kv_adopter=kvt.KVAdopter(tr2))
+        d = loadgen.run_open_loop_disagg([lp], [ld], spec,
+                                         prefill_busy_steps=4)
+        lp.close()
+        ld.close()
+        u95 = u["tpot_ms"]["p95"]
+        d95 = d["tpot_ms"]["p95"]
+        out.update({
+            "serve_disagg_unified_tpot_p95_ms": round(u95, 3),
+            "serve_disagg_tpot_p95_ms": round(d95, 3),
+            "serve_disagg_tpot_gain": round(u95 / max(d95, 1e-9), 3),
+            "serve_disagg_handoffs": int(d["handoffs"]),
+            "serve_disagg_kv_adopted": int(d["kv_adopted"]),
+            "serve_disagg_kv_reprefills": int(d["kv_reprefills"]),
+        })
+        return out
+    finally:
+        obs.reset()
+
+
 def _time_decode_attn_kernel(*, B: int = 4, Hq: int = 4, Hkv: int = 2,
                              D: int = 64, P: int = 16, MP: int = 8,
                              iters: int = 20) -> dict:
@@ -2412,6 +2564,18 @@ def main(argv=None) -> None:
         extras.update(_time_serve_speculative())
     except Exception as e:
         extras["serve_spec_error"] = repr(e)
+
+    try:
+        # disaggregated prefill/decode KV transfer (round-24 tentpole):
+        # export->publish->fetch->adopt A/B vs the unified engine —
+        # bytes on wire, transfer-stage latencies, adoption parity pin
+        # (greedy token-identical, sampled bit-identical), second-wave
+        # dedupe, zero steady-state fresh compiles on both worker
+        # classes, and the virtual-clock tpot p95 gain of a phase-split
+        # pair over a unified worker under prefill head-of-line cost
+        extras.update(_time_kv_transfer())
+    except Exception as e:
+        extras["kv_transfer_error"] = repr(e)
 
     try:
         # packed wire-v2 ingest: fused dequant->scatter-add kernel vs

@@ -135,6 +135,7 @@ class RunConfig:
     serve_trace: bool = True                 # request-scoped stage traces
     serve_trace_exemplars: int = 4           # K slowest frozen per window
     serve_trace_window: float = 30.0         # exemplar window (seconds)
+    serve_phase: str = "unified"             # unified | prefill | decode
     swap_policy: str = "drain"               # drain | restart
     swap_poll: float = 15.0                  # base-revision poll (seconds)
 
@@ -649,6 +650,18 @@ def build_parser(role: str) -> argparse.ArgumentParser:
         g.add_argument("--trace-window", dest="serve_trace_window",
                        type=_nonneg_float, default=d.serve_trace_window,
                        help="tail-exemplar reservoir window, seconds")
+        g.add_argument("--serve-phase", dest="serve_phase",
+                       choices=("unified", "prefill", "decode"),
+                       default=d.serve_phase,
+                       help="worker class for disaggregated serving "
+                            "(engine/kv_transfer.py): 'prefill' runs "
+                            "prompt prefill and exports KV pages as "
+                            "content-addressed shards, 'decode' adopts "
+                            "exported pages and decodes flat-out, "
+                            "'unified' (default) does both — the "
+                            "router learns the class from /healthz and "
+                            "falls back to unified workers whenever a "
+                            "class is missing or unhealthy")
         g.add_argument("--swap-policy", dest="swap_policy",
                        choices=("drain", "restart"),
                        default=d.swap_policy,

@@ -414,6 +414,14 @@ class FleetSpec:
     # clean control twin. 0 = never regress.
     latency_regression_round: int = 0
     latency_regression_factor: float = 4.0
+    # disaggregated serving topology (engine/kv_transfer.py): with >= 2
+    # servers, alternate them between prefill-phase and decode-phase
+    # workers — heartbeats carry the ``phase`` string plus cumulative
+    # ``kv_exported``/``kv_adopted`` extras, and each worker's
+    # BurnRateMonitor watches only ITS phase's objective (ttft on
+    # prefill, tpot on decode), the per-phase SLO split the scorecard's
+    # serve_phase section reads. False = every server unified (legacy).
+    disaggregated: bool = False
     # chaos transport (per-actor ChaosTransport over the hub)
     chaos: bool = True
     publish_error_rate: float = 0.02
@@ -740,8 +748,12 @@ class ServerActor(Actor):
     REQUESTS_PER_ROUND = 16
 
     def __init__(self, sim: "FleetSim", role: str, hotkey: str,
-                 index: int):
+                 index: int, phase: str = "unified"):
         super().__init__(sim, role, hotkey, index)
+        if phase not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown server phase {phase!r}")
+        self.phase = phase
+        self.kv_count = 0               # cumulative exports OR adoptions
         self.burn = BurnRateMonitor(clock=self.clock.now)
         self.first_burn_round = 0
         self.peak_burn = 0.0
@@ -756,22 +768,43 @@ class ServerActor(Actor):
         now = self.clock.now()
         for _ in range(self.REQUESTS_PER_ROUND):
             j = abs(float(self.rng.standard_normal()))
-            self.burn.observe(now, ttft_ms=(80.0 + 4.0 * j) * factor,
-                              tpot_ms=(9.0 + 0.5 * j) * factor)
+            # per-phase SLO: a prefill worker owns ttft (it emits the
+            # first token), a decode worker owns tpot — each burn
+            # monitor watches only its phase's objective, so a
+            # regression pages the worker class that caused it
+            if self.phase == "prefill":
+                self.burn.observe(now, ttft_ms=(80.0 + 4.0 * j) * factor)
+            elif self.phase == "decode":
+                self.burn.observe(now, tpot_ms=(9.0 + 0.5 * j) * factor)
+            else:
+                self.burn.observe(now, ttft_ms=(80.0 + 4.0 * j) * factor,
+                                  tpot_ms=(9.0 + 0.5 * j) * factor)
         new = self.burn.evaluate(now, round_num=round_no)
         if new and not self.first_burn_round:
             self.first_burn_round = round_no
         self.peak_burn = max(self.peak_burn, self.burn.max_burn(now))
         jitter = float(self.rng.standard_normal())
-        self.publish_heartbeat(
+        hb: dict[str, Any] = dict(
             steps=float(round_no),
             step_rate=1.0 / self.spec.round_s,
-            ttft_ms_p95=(80.0 + 4.0 * abs(jitter)) * factor,
-            tpot_ms_p95=(9.0 + 0.5 * abs(jitter)) * factor,
-            tokens_per_sec=900.0 - 20.0 * abs(jitter),
             queue_depth=float(self.index % 3),
             slo_burn=self.burn.max_burn(now),
             base_revision=self.sim.hub.base_revision())
+        if self.phase != "decode":
+            hb["ttft_ms_p95"] = (80.0 + 4.0 * abs(jitter)) * factor
+        if self.phase != "prefill":
+            hb["tpot_ms_p95"] = (9.0 + 0.5 * abs(jitter)) * factor
+            hb["tokens_per_sec"] = 900.0 - 20.0 * abs(jitter)
+        if self.phase != "unified":
+            # the disaggregated worker-class extras the real server role
+            # heartbeats (neurons/server.py _serve_counters)
+            self.kv_count += self.REQUESTS_PER_ROUND
+            hb["phase"] = self.phase
+            hb["kv_exported"] = float(
+                self.kv_count if self.phase == "prefill" else 0)
+            hb["kv_adopted"] = float(
+                self.kv_count if self.phase == "decode" else 0)
+        self.publish_heartbeat(**hb)
 
 
 class ValidatorActor(Actor):
@@ -1173,6 +1206,10 @@ class FleetResult:
     burn_alerts: list[dict] = dataclasses.field(default_factory=list)
     burn_first_fire_round: int = 0
     burn_peak: float = 0.0
+    # disaggregated serving topology (phase-specialized ServerActors)
+    serve_phases: dict = dataclasses.field(default_factory=dict)
+    kv_exported: int = 0
+    kv_adopted: int = 0
 
 
 class FleetSim:
@@ -1210,8 +1247,15 @@ class FleetSim:
             idx += 1
         self.servers = []
         for i in range(spec.servers):
+            # disaggregated topology: alternate prefill/decode worker
+            # classes (a lone server stays unified — no decode peer to
+            # hand off to)
+            phase = "unified"
+            if spec.disaggregated and spec.servers >= 2:
+                phase = "prefill" if i % 2 == 0 else "decode"
             self.servers.append(ServerActor(self, "server",
-                                            f"srv{i:03d}", idx))
+                                            f"srv{i:03d}", idx,
+                                            phase=phase))
             idx += 1
         self.validators = []
         for i in range(spec.validators):
@@ -1460,7 +1504,15 @@ class FleetSim:
                 (s.first_burn_round for s in self.servers
                  if s.first_burn_round), default=0),
             burn_peak=round(max((s.peak_burn for s in self.servers),
-                                default=0.0), 4))
+                                default=0.0), 4),
+            serve_phases={p: sum(1 for s in self.servers
+                                 if s.phase == p)
+                          for p in ("unified", "prefill", "decode")
+                          if any(s.phase == p for s in self.servers)},
+            kv_exported=sum(s.kv_count for s in self.servers
+                            if s.phase == "prefill"),
+            kv_adopted=sum(s.kv_count for s in self.servers
+                           if s.phase == "decode"))
 
     def close(self) -> None:
         if self.closed:
@@ -1515,6 +1567,12 @@ DEFAULT_GATES = {
     # over the non-speculating baseline scorecard — drafting must buy
     # real per-token latency, not just an acceptance-rate vanity number
     "spec_tpot_gain_min": 1.2,
+    # disaggregated load phase (--disaggregated): WITHIN one card, the
+    # disaggregated lane's tpot p95 at the highest rate both lanes
+    # offered must beat the unified lane (same prefill cost model) by
+    # at least this factor — splitting phases must actually take the
+    # prefill head-of-line stall off the decode stream
+    "disagg_tpot_gain_min": 1.2,
     # baseline-relative regression caps (only applied with --baseline)
     "baseline_parity_ratio_max": 1.5,
     "baseline_pr_drop_max": 0.05,
@@ -1675,6 +1733,12 @@ def assemble_scorecard(result: FleetResult,
         }
         if control is not None:
             card["slo_burn"]["control_alerts"] = len(control.burn_alerts)
+        if spec.disaggregated:
+            card["serve_phase"] = {
+                "phases": dict(result.serve_phases),
+                "kv_exported": result.kv_exported,
+                "kv_adopted": result.kv_adopted,
+            }
     if control is not None:
         card["parity"] = {
             "control_rounds": control.rounds_completed,
@@ -1832,12 +1896,58 @@ def evaluate_gates(card: dict, *, gates: dict | None = None,
             out["serving"]["router"] = True
             out["serving"]["shed_total"] = int(
                 sum(p.get("shed", 0) for p in pts))
+        dis = {p["rate_rps"]: p for p in pts if p.get("disaggregated")}
+        uni = {p["rate_rps"]: p for p in pts
+               if not p.get("disaggregated")}
+        if dis:
+            # within-card disaggregation knee: at the highest rate BOTH
+            # lanes offered, the disaggregated tpot p95 must beat the
+            # unified lane by disagg_tpot_gain_min — the same-card
+            # unified points ran the same prefill cost model, so the
+            # gain isolates what the phase split bought
+            out["serving"]["disaggregated"] = True
+            out["serving"]["handoffs_total"] = int(
+                sum(p.get("handoffs", 0) for p in dis.values()))
+            common = sorted(set(dis) & set(uni))
+            gain_min = g["disagg_tpot_gain_min"]
+            if common and gain_min > 0:
+                knee = max(common)
+                u95 = uni[knee].get("tpot_ms", {}).get("p95", 0.0)
+                d95 = dis[knee].get("tpot_ms", {}).get("p95",
+                                                       float("inf"))
+                gain = u95 / max(d95, 1e-9) if u95 else 0.0
+                out["serving"]["disagg_knee"] = {
+                    "rate_rps": knee,
+                    "unified_tpot_p95_ms": u95,
+                    "disagg_tpot_p95_ms": d95,
+                    "gain": round(gain, 3),
+                    "gain_min": gain_min,
+                    "handoffs": int(dis[knee].get("handoffs", 0)),
+                    "kv_reprefills": int(
+                        dis[knee].get("kv_reprefills", 0)),
+                }
+                if gain < gain_min:
+                    out["serving"]["ok"] = False
         if any(p.get("speculative") for p in pts):
             out["serving"]["speculative"] = True
             accs = [p["spec_accept_rate"] for p in pts
                     if p.get("spec_accept_rate") is not None]
             if accs:
                 out["serving"]["spec_accept_rate_min"] = round(min(accs), 4)
+    sp = card.get("serve_phase")
+    if sp is not None:
+        # disaggregated topology: both worker classes must exist AND
+        # move KV traffic — a fleet that claims the split but never
+        # exports/adopts is misconfigured, not disaggregated
+        out["serve_phase"] = {
+            "ok": (sp["phases"].get("prefill", 0) > 0
+                   and sp["phases"].get("decode", 0) > 0
+                   and sp["kv_exported"] > 0
+                   and sp["kv_adopted"] > 0),
+            "phases": sp["phases"],
+            "kv_exported": sp["kv_exported"],
+            "kv_adopted": sp["kv_adopted"],
+        }
     if baseline is not None:
         out["baseline"] = _baseline_gate(card, baseline, g)
     return out

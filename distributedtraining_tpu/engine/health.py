@@ -89,6 +89,11 @@ HEARTBEAT_FIELDS: dict[str, tuple[str, str]] = {
                                "(version-drift detection)"),
     "mem_in_use_bytes": ("num", "max per-device HBM bytes in use"),
     "mem_peak_bytes": ("num", "max per-device HBM high-water mark"),
+    "phase": ("str", "serving worker class: unified | prefill | decode"),
+    "kv_exported": ("num", "KV page-set manifests exported "
+                           "(prefill-phase serving worker)"),
+    "kv_adopted": ("num", "KV page-set manifests adopted "
+                          "(decode-phase serving worker)"),
 }
 
 _MAX_STR = 200
@@ -208,7 +213,13 @@ class Vitals:
                 body["loss_ema"] = self._loss_ema
         if self._counters is not None:
             for k, v in self._counters().items():
-                if v is not None and math.isfinite(float(v)):
+                if v is None:
+                    continue
+                if isinstance(v, str):
+                    # string extras (e.g. a serving worker's phase) ride
+                    # the same path build_heartbeat already allows
+                    body[k] = v[:_MAX_STR]
+                elif math.isfinite(float(v)):
                     body[k] = float(v)
         if self._base_revision is not None:
             rev = self._base_revision()

@@ -17,6 +17,16 @@ ahead of any engine queue:
   off is strictly better than one that waits: the p99 of ADMITTED
   requests stays near the service floor, and the shed count is an
   honest overload meter (``router.shed``).
+- **phase-aware disaggregation** — backends declare a worker class in
+  ``/healthz`` (``phase=prefill|decode|unified``). When both a
+  prefill-phase and a decode-phase backend are admissible, a request
+  routes as TWO legs: ``/prefill`` on the prefill worker (returns the
+  content-addressed KV manifest ref + the first-token decision), then
+  ``/generate`` on the decode worker carrying the ref
+  (engine/kv_transfer.py moves the pages). Either class unhealthy,
+  overloaded, or mid-flight failing ⇒ the classic unified route — a
+  mixed old/new fleet keeps serving with no flag day, the same
+  negotiation posture as wire v2.
 
 The router is deliberately thin: stdlib HTTP in, ``urllib`` out, state
 refreshed from each backend's ``/healthz`` (the same JSON the serving
@@ -57,6 +67,13 @@ class BackendState:
     shed: int = 0
     spec_accept_rate: float = 0.0
     spec_k: int = 0
+    # worker class (disaggregated serving): prefill | decode | unified.
+    # An old backend's healthz carries no "phase" field and defaults to
+    # unified — the no-flag-day negotiation: a mixed fleet keeps
+    # routing every request somewhere that can serve it end to end.
+    phase: str = "unified"
+    kv_exported: int = 0
+    kv_adopted: int = 0
     last_poll_t: float = 0.0
     consecutive_failures: int = 0
 
@@ -71,6 +88,11 @@ class BackendState:
         self.shed = int(health.get("shed", 0))
         self.spec_accept_rate = float(health.get("spec_accept_rate", 0.0))
         self.spec_k = int(health.get("spec_k", 0))
+        phase = health.get("phase", "unified")
+        self.phase = phase if phase in ("prefill", "decode", "unified") \
+            else "unified"
+        self.kv_exported = int(health.get("kv_exported", 0))
+        self.kv_adopted = int(health.get("kv_adopted", 0))
         self.consecutive_failures = 0
         self.last_poll_t = time.monotonic()
 
@@ -194,6 +216,8 @@ class RouterHTTPFrontend:
         self.routed = 0
         self.shed = 0
         self.retry_after_honored = 0
+        self.disagg_routed = 0      # completed prefill->decode routes
+        self.disagg_fallbacks = 0   # two-leg attempts that fell back
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._poller: threading.Thread | None = None
@@ -224,6 +248,72 @@ class RouterHTTPFrontend:
                 logger.exception("router poll sweep failed")
 
     # -- routing ------------------------------------------------------------
+    def _fetch_json(self, backend: BackendState, path: str,
+                    body: bytes, request_id: str) -> dict:
+        """POST one leg to one backend under optimistic in-flight
+        accounting (the same active+=1 discipline the unified loop
+        uses, so concurrent routes between health polls spread)."""
+        with self._lock:
+            backend.active += 1
+        try:
+            req = urllib.request.Request(
+                backend.url + path, data=body,
+                headers={"Content-Type": "application/json",
+                         reqtrace.REQUEST_ID_HEADER: request_id})
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read().decode())
+        finally:
+            with self._lock:
+                backend.active = max(0, backend.active - 1)
+
+    def _route_disagg(self, body: bytes, request_id: str,
+                      rid_hdr: dict) -> tuple[int, dict, dict] | None:
+        """The two-leg disaggregated route: prefill leg on a
+        prefill-phase worker (``/prefill`` → kv_ref + first_token),
+        decode leg on a decode-phase worker (``/generate`` with the
+        manifest ref merged into the body). Returns the completed
+        response, or None ⇒ fall back to the unified loop — taken
+        whenever EITHER class has no admissible backend or either leg
+        fails for any reason (the fallback matrix: mixed fleets, an
+        unhealthy class, a failed export, a mid-flight error all
+        degrade to the classic single-worker route, counted)."""
+        with self._lock:
+            pre = self.policy.choose(
+                [b for b in self.backends if b.phase == "prefill"])
+            dec = self.policy.choose(
+                [b for b in self.backends if b.phase == "decode"])
+        if pre is None or dec is None:
+            return None
+        try:
+            leg1 = self._fetch_json(pre, "/prefill", body, request_id)
+            if not leg1.get("kv_ref") or leg1.get("first_token") is None:
+                # export failed on the worker (already counted there):
+                # a unified worker can still serve the request whole
+                raise ValueError("prefill leg returned no kv_ref")
+            payload = json.loads(body or b"{}")
+            payload["kv_ref"] = leg1["kv_ref"]
+            payload["first_token"] = leg1["first_token"]
+            out = self._fetch_json(dec, "/generate",
+                                   json.dumps(payload).encode(),
+                                   request_id)
+        except Exception:
+            obs.count("router.disagg_fallbacks")
+            with self._lock:
+                self.disagg_fallbacks += 1
+            logger.info("disaggregated route failed (prefill=%s "
+                        "decode=%s); falling back to unified",
+                        pre.url, dec.url, exc_info=True)
+            return None
+        with self._lock:
+            self.routed += 1
+            self.disagg_routed += 1
+        obs.count("router.routed")
+        obs.count("router.disagg_routed")
+        out["backend"] = dec.url
+        out["prefill_backend"] = pre.url
+        out.setdefault("request_id", request_id)
+        return 200, out, dict(rid_hdr)
+
     def _route(self, body: bytes,
                request_id: str | None = None) -> tuple[int, dict, dict]:
         """Forward one /generate body. Returns (code, obj, headers).
@@ -236,8 +326,14 @@ class RouterHTTPFrontend:
         obs.count("router.requests")
         request_id = request_id or reqtrace.mint_request_id(body)
         rid_hdr = {reqtrace.REQUEST_ID_HEADER: request_id}
+        routed = self._route_disagg(body, request_id, rid_hdr)
+        if routed is not None:
+            return routed
         with self._lock:
-            states = list(self.backends)
+            # unified / fallback leg: prefill-phase workers cannot
+            # serve /generate end to end, everything else can (a
+            # decode worker degrades to local prefill)
+            states = [b for b in self.backends if b.phase != "prefill"]
             chosen = self.policy.choose(states)
         tried: set[str] = set()
         while chosen is not None:
@@ -303,7 +399,8 @@ class RouterHTTPFrontend:
                         chosen.healthy = False
             with self._lock:
                 remaining = [b for b in self.backends
-                             if b.url not in tried]
+                             if b.url not in tried
+                             and b.phase != "prefill"]
                 chosen = self.policy.choose(remaining)
         with self._lock:
             self.shed += 1
@@ -343,6 +440,8 @@ class RouterHTTPFrontend:
                             "routed": fe.routed, "shed": fe.shed,
                             "retry_after_honored":
                                 fe.retry_after_honored,
+                            "disagg_routed": fe.disagg_routed,
+                            "disagg_fallbacks": fe.disagg_fallbacks,
                             "backends": [dataclasses.asdict(b)
                                          for b in fe.backends]}
                     self._send(200, out)

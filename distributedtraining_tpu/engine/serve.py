@@ -152,11 +152,21 @@ class ServeRequest:
     rid: int = dataclasses.field(default_factory=lambda: next(_RID))
     tokens: list = dataclasses.field(default_factory=list)
     status: str = "queued"      # queued | active | done | truncated
+    #                             | prefilled (prefill-phase worker)
     revision: str | None = None
     # content-addressable identity (utils/reqtrace.py): minted at the
     # frontend (router or server) or by submit() itself; propagated via
     # the X-DT-Request-Id header and stamped on every trace stage
     request_id: str | None = None
+    # disaggregated serving (engine/kv_transfer.py): on a DECODE worker,
+    # the manifest ref of a prefill worker's exported KV to adopt; on a
+    # PREFILL worker, filled at finish with the published ref. None on
+    # the unified path. ``first_token`` rides alongside: the prefill
+    # worker's first-token decision (greedy argmax or the counter-PRNG
+    # sample at index 0), re-emitted verbatim by the decode worker —
+    # the bit-identity anchor of the cross-worker contract.
+    kv_ref: str | None = None
+    first_token: int | None = None
     submitted_t: float = dataclasses.field(default_factory=time.time)
     done_evt: threading.Event = dataclasses.field(
         default_factory=threading.Event)
@@ -665,10 +675,21 @@ class GenerationEngine:
                  trace: bool = True,
                  trace_exemplars: int = 4,
                  trace_window_s: float = 30.0,
-                 burn=None):
+                 burn=None,
+                 phase: str = "unified",
+                 kv_exporter=None,
+                 kv_adopter=None):
         if swap_policy not in ("drain", "restart"):
             raise ValueError(f"swap_policy must be drain|restart, "
                              f"got {swap_policy!r}")
+        if phase not in ("unified", "prefill", "decode"):
+            raise ValueError(f"phase must be unified|prefill|decode, "
+                             f"got {phase!r}")
+        if phase == "prefill" and kv_exporter is None:
+            raise ValueError("phase='prefill' needs a kv_exporter "
+                             "(engine/kv_transfer.KVExporter) — a "
+                             "prefill worker that cannot export KV "
+                             "serves nothing")
         if max_slots < 1 or page_size < 1:
             raise ValueError("max_slots and page_size must be >= 1")
         cfg = model.cfg
@@ -748,6 +769,21 @@ class GenerationEngine:
         self._sample_tok_warm = False
         self._page_copy_prog_: Callable | None = None
         self._page_copy_warm = False
+        # disaggregated serving (engine/kv_transfer.py): worker class +
+        # the transfer plane. "prefill" finishes every request after
+        # prefill + KV export; "decode" adopts exported pages at
+        # admission (degrading to local prefill on any transfer
+        # defect); "unified" is the classic engine — and the fallback
+        # class the router keeps routing to in mixed fleets.
+        self.phase = phase
+        self._kv_exporter = kv_exporter
+        self._kv_adopter = kv_adopter
+        self._kv_adopt_prog_: Callable | None = None
+        self._kv_adopt_warm = False
+        self.kv_exported = 0     # requests whose KV export published
+        self.kv_adopted = 0      # requests admitted on adopted pages
+        self.kv_reprefills = 0   # adoption degrades -> local prefill
+        self.kv_rev_mismatch = 0  # transfers refused on revision skew
         # donation lets XLA update the page pool in place (it is the
         # dominant buffer); CPU ignores donation with a warning, so skip
         self._donate = jax.default_backend() not in ("cpu",)
@@ -780,6 +816,10 @@ class GenerationEngine:
         self._tok_rate_ema: float | None = None
         self.steps = 0
         self.tokens_emitted = 0
+        # cumulative prefill dispatches (full + suffix): the load
+        # harness's prefill cost model reads the delta per step to
+        # charge compute-bound prefill work against a worker's clock
+        self.prefills_done = 0
         # request-scoped lifecycle traces (utils/reqtrace.py): host-side
         # stage timelines + the tail-exemplar reservoir. Every
         # instrumentation site below is a single-branch no-op when
@@ -825,7 +865,9 @@ class GenerationEngine:
                max_new_tokens: int | None = None, *,
                temperature: float = 0.0, top_p: float = 1.0,
                seed: int = 0,
-               request_id: str | None = None) -> ServeRequest:
+               request_id: str | None = None,
+               kv_ref: str | None = None,
+               first_token: int | None = None) -> ServeRequest:
         """Queue one generation request (thread-safe). Prompts longer
         than the cache capacity are rejected up front.
         ``temperature=0`` (the default) is greedy argmax — the
@@ -845,9 +887,16 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({n_new}) "
                 f"exceeds max_seq_len {self.max_seq_len}")
+        if kv_ref is not None and first_token is None:
+            raise ValueError("kv_ref without first_token: the prefill "
+                             "worker's first-token decision must ride "
+                             "along for output identity")
         req = ServeRequest(prompt=prompt, max_new_tokens=n_new,
                            temperature=float(temperature),
-                           top_p=float(top_p), seed=int(seed))
+                           top_p=float(top_p), seed=int(seed),
+                           kv_ref=kv_ref,
+                           first_token=(None if first_token is None
+                                        else int(first_token)))
         if self.trace is not None:
             req.request_id = request_id or reqtrace.mint_request_id(
                 prompt, max_new_tokens=n_new, temperature=req.temperature,
@@ -1437,6 +1486,16 @@ class GenerationEngine:
         # this request wait" half of TTFT — exported for fleet_report's
         # q_age95 column whether or not per-request tracing is on
         queue_age_ms = max(0.0, (time.time() - req.submitted_t) * 1e3)
+        if req.kv_ref is not None and self._kv_adopter is not None:
+            verdict = self._try_adopt(req, queue_age_ms)
+            if verdict == "ok":
+                return True
+            if verdict == "full":
+                return False
+            # "degrade": any transfer defect falls through to the
+            # classic local-prefill admission below — counted, loud,
+            # and output-identical (prefill is deterministic in the
+            # served revision)
         shared: list[int] = []
         matched = 0
         if self._cache is not None:
@@ -1492,6 +1551,141 @@ class GenerationEngine:
             self._prefill(req, pages)
         return True
 
+    def _try_adopt(self, req: ServeRequest, queue_age_ms: float) -> str:
+        """Admit one request on ADOPTED KV pages — the decode worker's
+        side of the disaggregated hop. Returns "ok" (slot active on the
+        transferred pages), "full" (pool exhausted; requeued, stop
+        admitting), or "degrade" (absent/torn manifest, hash miss,
+        geometry skew, or base-revision mismatch — fall through to
+        local prefill; every fallback is counted, never silent)."""
+        t0 = time.perf_counter()
+        got = self._kv_adopter.fetch(req.kv_ref)
+        if got is None:
+            obs.count("serve.kv_adopt_failures")
+            self.kv_reprefills += 1
+            obs.count("serve.kv_reprefills")
+            return "degrade"
+        if got["revision"] != (self.revision or ""):
+            # loud by contract: KV is a pure function of (params,
+            # tokens), so pages prefilled on another base revision are
+            # garbage here — not approximately right
+            self.kv_rev_mismatch += 1
+            obs.count("serve.kv_rev_mismatch")
+            self.kv_reprefills += 1
+            obs.count("serve.kv_reprefills")
+            logger.warning(
+                "kv adoption refused: pages prefilled on revision %r, "
+                "serving %r (request %s) — re-prefilling locally",
+                got["revision"], self.revision, req.request_id)
+            return "degrade"
+        P = self.page_size
+        plen = len(req.prompt)
+        k_pages, _ = self._kv
+        want = {"layers": k_pages.shape[0], "page_size": P,
+                "kv_heads": k_pages.shape[3],
+                "head_dim": k_pages.shape[4],
+                "dtype": str(k_pages.dtype)}
+        if got["geometry"] != want or got["prompt_len"] != plen \
+                or len(got["pages"]) != (plen + P - 1) // P:
+            obs.count("serve.kv_adopt_failures")
+            self.kv_reprefills += 1
+            obs.count("serve.kv_reprefills")
+            return "degrade"
+        pages = self._alloc_pages(plen // P + 1)
+        if pages is None:
+            self._requeue_front(req)
+            return "full"
+        for i, (k, v) in enumerate(got["pages"]):
+            self._adopt_page(pages[i], k, v)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        obs.observe("serve.queue_age_ms", queue_age_ms)
+        obs.observe("serve.kv_adopt_ms", dur_ms)
+        obs.count("serve.kv_adoptions")
+        obs.count("serve.kv_pages_adopted", len(got["pages"]))
+        self.kv_adopted += 1
+        if self.trace is not None:
+            stage = "readmit" if self.trace.seen(req.rid, "admit") \
+                else "admit"
+            self.trace.stage(req.rid, stage, queue_age_ms=queue_age_ms)
+            self.trace.stage(req.rid, "kv_adopt",
+                             pages=len(got["pages"]),
+                             dur_ms=round(dur_ms, 3))
+        if self._cache is not None:
+            # adoption = incref'd read-only pages: the cache takes its
+            # own reference per page, so a sibling request sharing the
+            # prompt prefix reuses them and this slot's first write
+            # into a shared page rides the CoW path — the exact
+            # invariants --debug-invariants audits on the unified
+            # engine
+            self._cache.register(list(req.prompt), pages)
+        self._activate(req, pages, int(got["first_token"]))
+        return "ok"
+
+    def _adopt_page(self, dst: int, k_new, v_new) -> None:
+        """Write one fetched KV page into pool slot ``dst`` — the
+        ``serve.kv_adopt`` program (engine/kv_transfer.make_adopt_prog):
+        bucket-free like ``serve.page_copy``, compiled once at the
+        first adoption and warm forever, so the decode worker's
+        steady-state fresh-compile pin stays 0."""
+        prog = self._kv_adopt_prog_
+        if prog is None:
+            from . import kv_transfer as _kvt
+            prog = _kvt.make_adopt_prog(self._donate)
+            self._kv_adopt_prog_ = prog
+        k_pages, v_pages = self._kv
+        args = (k_pages, v_pages, jnp.asarray(k_new),
+                jnp.asarray(v_new), np.int32(dst))
+        if not self._kv_adopt_warm:
+            self._kv_adopt_warm = True
+            self._kv = _timed_compile(prog, *args)
+        else:
+            self._kv = prog(*args)
+
+    def _finish_prefill(self, req: ServeRequest, pages: list,
+                        nxt: int) -> None:
+        """Prefill-phase terminal: export the prompt's KV pages as
+        content-addressed shards + a per-request manifest (manifest
+        LAST — engine/kv_transfer.KVExporter), release the slot-side
+        page references, and finish the request as ``prefilled``
+        carrying the manifest ref and the first-token decision. With a
+        prefix cache attached the pages stay resident, so the next
+        same-prefix request's export dedupes to zero fresh wire
+        bytes."""
+        P = self.page_size
+        plen = len(req.prompt)
+        ncontent = (plen + P - 1) // P
+        t0 = time.perf_counter()
+        k_pages, v_pages = self._kv
+        idx = np.asarray(pages[:ncontent], np.int32)
+        k_host = np.asarray(jax.device_get(k_pages[:, idx]))
+        v_host = np.asarray(jax.device_get(v_pages[:, idx]))
+        kv_ref = req.request_id or f"rq-rid{req.rid}"
+        ok = self._kv_exporter.export(
+            request_id=kv_ref, revision=self.revision or "",
+            pages=[(k_host[:, i], v_host[:, i]) for i in range(ncontent)],
+            prompt_len=plen, first_token=int(nxt), page_size=P)
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        if ok:
+            self.kv_exported += 1
+            req.kv_ref = kv_ref
+        req.first_token = int(nxt)
+        req.tokens.append(int(nxt))
+        self.tokens_emitted += 1
+        obs.count("serve.tokens")
+        ttft_ms = max(0.0, (time.time() - req.submitted_t) * 1e3)
+        obs.observe("serve.ttft_ms", ttft_ms)
+        for p in pages:
+            self.pool.decref(p)
+        req.status = "prefilled"
+        req.revision = self.revision
+        if self.trace is not None:
+            self.trace.stage(req.rid, "kv_export", pages=ncontent,
+                             ok=int(ok), dur_ms=round(dur_ms, 3))
+            self.trace.note_latency(req.rid, ttft_ms=ttft_ms)
+            self.trace.finish(req, "prefilled")
+        req.done_evt.set()
+        self._admit_hold = False
+
     def _prefill(self, req: ServeRequest, pages: list) -> None:
         P = self.page_size
         plen = len(req.prompt)
@@ -1519,6 +1713,7 @@ class GenerationEngine:
         dur_ms = (time.perf_counter() - t0) * 1e3
         obs.observe("serve.prefill_ms", dur_ms)
         obs.count("serve.prefills")
+        self.prefills_done += 1
         if self.trace is not None:
             self.trace.stage(req.rid, "prefill", pfx_hit=0, pfx_tokens=0,
                              prompt_tokens=plen, dur_ms=round(dur_ms, 3))
@@ -1560,6 +1755,7 @@ class GenerationEngine:
         dur_ms = (time.perf_counter() - t0) * 1e3
         obs.observe("serve.prefill_ms", dur_ms)
         obs.count("serve.prefills")
+        self.prefills_done += 1
         if self.trace is not None:
             self.trace.stage(req.rid, "prefill", pfx_hit=1,
                              pfx_tokens=ctx_len, prompt_tokens=plen,
@@ -1572,6 +1768,12 @@ class GenerationEngine:
         return int(nxt)
 
     def _activate(self, req: ServeRequest, pages: list, nxt: int) -> None:
+        if self.phase == "prefill":
+            # a prefill worker never decodes: the request's lifecycle
+            # ends here with its KV exported and the first-token
+            # decision attached for the decode worker to re-emit
+            self._finish_prefill(req, pages, nxt)
+            return
         req.status = "active"
         slot = _Slot(req=req, pages=pages, seq_len=len(req.prompt),
                      last_tok=nxt, order=next(self._order))
@@ -1994,8 +2196,12 @@ class ServeHTTPFrontend:
       optional ``max_new_tokens`` — blocks until the request finishes
       (or ``timeout_s``) and returns generated tokens (+ text when a
       tokenizer is attached), status, and the base revision served.
+    - ``POST /prefill`` (prefill-phase workers only) — same body as
+      ``/generate``; runs the prefill leg, exports the KV pages, and
+      returns ``kv_ref`` + ``first_token`` + ``prompt_len`` for the
+      router to hand to a decode worker.
     - ``GET /healthz`` — queue depth, active slots, revision,
-      tokens/sec.
+      tokens/sec, worker ``phase``.
     """
 
     def __init__(self, engine: GenerationEngine, port: int = 0, *,
@@ -2040,7 +2246,14 @@ class ServeHTTPFrontend:
                         "revision": e.revision,
                         "tokens_per_sec": e.tokens_per_sec,
                         "max_queue": e.max_queue,
-                        "shed": e.shed_count}
+                        "shed": e.shed_count,
+                        # worker class for phase-aware routing
+                        # (engine/router.py): prefill | decode |
+                        # unified — an old router ignores the field
+                        # and keeps treating this backend as unified
+                        "phase": e.phase,
+                        "kv_exported": e.kv_exported,
+                        "kv_adopted": e.kv_adopted}
                     if e.prefix_hits + e.prefix_misses > 0:
                         out["prefix_hit_rate"] = e.prefix_hit_rate
                     if e.speculative:
@@ -2064,8 +2277,21 @@ class ServeHTTPFrontend:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):  # noqa: N802
-                if self.path.split("?", 1)[0] != "/generate":
+                path = self.path.split("?", 1)[0]
+                if path not in ("/generate", "/prefill"):
                     self._send(404, {"error": "not found"})
+                    return
+                # phase discipline: a prefill worker only serves
+                # /prefill, everything else only /generate — a
+                # mis-routed call fails loudly instead of returning a
+                # one-token "generation"
+                if path == "/prefill" and fe.engine.phase != "prefill":
+                    self._send(409, {"error": "not a prefill-phase "
+                                              "worker"})
+                    return
+                if path == "/generate" and fe.engine.phase == "prefill":
+                    self._send(409, {"error": "prefill-phase worker; "
+                                              "POST /prefill"})
                     return
                 # admission control BEFORE parsing: a saturated server
                 # answers cheaply and immediately instead of queueing
@@ -2111,12 +2337,19 @@ class ServeHTTPFrontend:
                     if not isinstance(toks, list) or not toks:
                         raise ValueError("need a non-empty 'tokens' list "
                                          "or 'text'")
+                    # disaggregated hop (decode workers): the router
+                    # forwards the prefill leg's manifest ref + first
+                    # token with the original sampling params
+                    kv_ref = payload.get("kv_ref")
+                    ft = payload.get("first_token")
                     req = fe.engine.submit(
                         toks, payload.get("max_new_tokens"),
                         temperature=float(payload.get("temperature", 0.0)),
                         top_p=float(payload.get("top_p", 1.0)),
                         seed=int(payload.get("seed", 0)),
-                        request_id=req_id)
+                        request_id=req_id,
+                        kv_ref=(str(kv_ref) if kv_ref else None),
+                        first_token=(int(ft) if ft is not None else None))
                 except (ValueError, TypeError, json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
                     return
@@ -2131,6 +2364,13 @@ class ServeHTTPFrontend:
                 out = {"rid": req.rid, "tokens": req.tokens,
                        "status": req.status, "revision": req.revision,
                        "request_id": req.request_id}
+                if path == "/prefill":
+                    # the decode leg's inputs: manifest ref (None when
+                    # the export failed — the router then falls back
+                    # to unified) + the first-token decision
+                    out["kv_ref"] = req.kv_ref
+                    out["first_token"] = req.first_token
+                    out["prompt_len"] = len(req.prompt)
                 if fe.tokenizer is not None:
                     try:
                         out["text"] = fe.tokenizer.decode(req.tokens)

@@ -146,6 +146,28 @@ BASE_PREFIX = "__base__"
 # contract)
 BASE_MANIFEST_MAX_BYTES = 1 << 20
 
+# Disaggregated serving KV transfer (engine/kv_transfer.py): a prefill
+# worker exports one finished request's KV pages as content-addressed
+# shards and a per-request manifest, and a decode worker adopts them —
+# the serving twin of the ``__base__`` sharded plane, with the same
+# manifest-last publication order (shards first, manifest last, so a
+# torn set is never decodable and the reader degrades to local
+# prefill).
+#
+#   __kv__.s.<digest>        one KV page's bytes, keyed on its sha256
+#                            content address (idempotent re-publish;
+#                            shared system-prompt pages dedupe on the
+#                            wire for free)
+#   __kv__.<request-slug>    the per-request KV manifest (page digest
+#                            list + page geometry + base revision),
+#                            published LAST
+KV_PREFIX = "__kv__"
+
+# consumer-side size caps: one KV manifest is KBs of JSON; one KV page
+# is [L, P, Hkv, D] x {k, v} — bounded by geometry, capped generously
+KV_MANIFEST_MAX_BYTES = 1 << 20
+KV_PAGE_MAX_BYTES = 1 << 26
+
 # Regional shard mirrors (engine/basedist.MirrorDuty): an ``__agg__``
 # sub-averager re-publishes the base shards it already pulled under its
 # own reserved per-node namespace, and fetchers race/pick ANY replica
@@ -287,6 +309,28 @@ def is_mirror_id(artifact_id: str) -> bool:
         artifact_id.startswith(MIRROR_PREFIX + ".")
 
 
+def kv_page_id(digest: str) -> str:
+    """The reserved artifact id one exported KV page travels under,
+    keyed on its sha256 content address. The ``s.`` segment keeps page
+    ids disjoint from manifest ids by the same rule as
+    :func:`base_shard_id` (a request slug never contains a literal
+    ``.`` — :func:`lineage_slug` escapes them)."""
+    return f"{KV_PREFIX}.s.{digest}"
+
+
+def kv_manifest_id(request_id: str) -> str:
+    """The reserved artifact id the KV manifest for one request
+    publishes under — keyed on the request id (reqtrace mints them
+    unique per submission), slug-escaped by :func:`lineage_slug` so
+    exotic request ids stay id-safe."""
+    return f"{KV_PREFIX}.{lineage_slug(request_id)}"
+
+
+def is_kv_id(artifact_id: str) -> bool:
+    return isinstance(artifact_id, str) and \
+        artifact_id.startswith(KV_PREFIX + ".")
+
+
 def is_reserved_id(artifact_id: str) -> bool:
     """True for any id in the reserved control-plane/shard/aggregate/
     postmortem namespace (heartbeats, leases, wire-v2 shards, partial
@@ -303,6 +347,7 @@ def is_reserved_id(artifact_id: str) -> bool:
         or artifact_id.startswith(LINEAGE_PREFIX + ".")
         or artifact_id == BASE_PREFIX
         or artifact_id.startswith(BASE_PREFIX + ".")
+        or artifact_id.startswith(KV_PREFIX + ".")
         or artifact_id.startswith(MIRROR_PREFIX + "."))
 
 
@@ -429,6 +474,65 @@ def fetch_base_manifest_bytes(transport, revision: str) -> bytes | None:
     data = (fbm(revision) if fbm is not None
             else transport.fetch_delta_bytes(base_manifest_id(revision)))
     if data is not None and len(data) > BASE_MANIFEST_MAX_BYTES:
+        return None
+    return data
+
+
+def publish_kv_page(transport, digest: str, data: bytes) -> None:
+    """Publish one exported KV page through whatever surface
+    ``transport`` offers: its own ``publish_kv_page`` method when
+    present, else ``publish_raw`` under the reserved ``__kv__.s.*``
+    id. Like delta/base shards, KV pages travel UNSIGNED — their
+    integrity is the sha256 content address the manifest pins (and the
+    id itself spells)."""
+    pk = getattr(transport, "publish_kv_page", None)
+    if pk is not None:
+        pk(digest, data)
+        return
+    transport.publish_raw(kv_page_id(digest), data)
+
+
+def fetch_kv_page(transport, digest: str) -> bytes | None:
+    """One KV page's raw bytes (or None); callers verify against the
+    digest (engine/kv_transfer.py) — unsigned transport is safe
+    because the hash rides the manifest."""
+    fk = getattr(transport, "fetch_kv_page", None)
+    if fk is not None:
+        return fk(digest)
+    data = transport.fetch_delta_bytes(kv_page_id(digest))
+    if data is not None and len(data) > KV_PAGE_MAX_BYTES:
+        return None
+    return data
+
+
+def publish_kv_manifest(transport, request_id: str, data: bytes) -> None:
+    """Publish one request's KV manifest under the reserved
+    per-request id — LAST, after every page it lists (manifest-last
+    publication; a reader that sees the manifest sees a complete page
+    set or degrades on a hash miss). Prefers ``publish_delta_raw``
+    (SignedTransport envelopes it — the adopted page hashes are then
+    attributable to the prefill worker), the exact split
+    :func:`publish_base_manifest` uses."""
+    pkm = getattr(transport, "publish_kv_manifest", None)
+    if pkm is not None:
+        pkm(request_id, data)
+        return
+    pdr = getattr(transport, "publish_delta_raw", None)
+    if pdr is not None:
+        pdr(kv_manifest_id(request_id), data)
+        return
+    transport.publish_raw(kv_manifest_id(request_id), data)
+
+
+def fetch_kv_manifest_bytes(transport, request_id: str) -> bytes | None:
+    """Raw (possibly enveloped, size-capped) KV manifest bytes for one
+    request, or None — validation lives in engine/kv_transfer.py, the
+    same split as base-manifest reads. Absence means the prefill leg
+    never completed publication: the decode worker prefills locally."""
+    fkm = getattr(transport, "fetch_kv_manifest", None)
+    data = (fkm(request_id) if fkm is not None
+            else transport.fetch_delta_bytes(kv_manifest_id(request_id)))
+    if data is not None and len(data) > KV_MANIFEST_MAX_BYTES:
         return None
     return data
 

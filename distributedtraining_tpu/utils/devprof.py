@@ -110,6 +110,10 @@ PROGRAMS: dict[str, str] = {
     "serve.page_copy": "whole-page KV copy — the copy-on-write "
                        "primitive behind prefix sharing "
                        "(engine/serve.py)",
+    "serve.kv_adopt": "adopted-KV page write on a decode worker — "
+                      "scatter one fetched [L,P,Hkv,D] page pair into "
+                      "the pool (disaggregated serving; "
+                      "engine/kv_transfer.py)",
     "serve.draft": "draft-model propose step / context prefill over "
                    "the drafter's own paged KV pool "
                    "(engine/speculative.py)",

@@ -255,7 +255,8 @@ def sample_arrivals(spec: OpenLoopSpec) -> list[tuple[float, list[int]]]:
         out.append((t, [int(x) for x in prompt]))
 
 
-def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
+def run_open_loop(engine, spec: OpenLoopSpec, *,
+                  prefill_busy_steps: int = 0) -> dict:
     """Drive one load point through a live GenerationEngine; returns the
     load-point record the fleetsim scorecard embeds.
 
@@ -267,13 +268,24 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
     consecutive tokens, both in virtual ms; ``unfinished`` counts
     requests still incomplete when the ``max_steps`` collapse bound
     stops the run — a nonzero value IS the queueing-collapse signal,
-    alongside the exploding p99."""
+    alongside the exploding p99.
+
+    ``prefill_busy_steps`` is the virtual-clock prefill cost model: each
+    completed prefill charges that many extra BUSY ticks (clock advances,
+    the engine does not step), so a long-prompt admission visibly stalls
+    every in-flight decode on the same worker — the head-of-line effect
+    disaggregated serving exists to remove. The default 0 preserves the
+    legacy uniform-tick curve byte-for-byte."""
     from .obs import percentile
 
+    if prefill_busy_steps < 0:
+        raise ValueError("prefill_busy_steps must be >= 0")
     arrivals = sample_arrivals(spec)
     now = 0.0
     i = 0
     steps = 0
+    debt = 0                        # busy ticks owed for finished prefills
+    last_prefills = int(getattr(engine, "prefills_done", 0))
     tracked: list[dict] = []        # {req, arrival_s, seen, last_emit}
     ttft_ms: list[float] = []
     tpot_ms: list[float] = []
@@ -314,14 +326,20 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
                 rec["last_emit"] = t_emit
             rec["seen"] = n
 
-    while (i < len(arrivals) or not engine.idle) \
+    while (i < len(arrivals) or not engine.idle or debt > 0) \
             and steps < spec.max_steps:
-        if engine.idle and i < len(arrivals):
+        if engine.idle and debt == 0 and i < len(arrivals):
             now = max(now, arrivals[i][0])   # park until the next arrival
             _submit_due()
             continue
         _submit_due()
-        engine.step()
+        if debt > 0:
+            debt -= 1                       # engine busy with prefill math
+        else:
+            engine.step()
+            done = int(getattr(engine, "prefills_done", 0))
+            debt += (done - last_prefills) * prefill_busy_steps
+            last_prefills = done
         steps += 1
         now += spec.step_ms / 1e3
         _account()
@@ -360,7 +378,8 @@ def run_open_loop(engine, spec: OpenLoopSpec) -> dict:
 
 
 def run_open_loop_routed(engines, spec: OpenLoopSpec, *,
-                         max_backend_queue: int = 6) -> dict:
+                         max_backend_queue: int = 6,
+                         prefill_busy_steps: int = 0) -> dict:
     """One load point through N engines behind the router policy —
     the same virtual-clock discipline as :func:`run_open_loop` (every
     tick steps ALL engines; one tick is ``step_ms``), with the real
@@ -372,16 +391,23 @@ def run_open_loop_routed(engines, spec: OpenLoopSpec, *,
     requests stay off the collapse curve, and a 429'd open-loop caller
     never waited in any queue. The offered/shed split plus the
     admitted-only p99 is exactly the curve FLEETSIM_r04 gates against
-    the single-server r01 baseline."""
+    the single-server r01 baseline.
+
+    ``prefill_busy_steps`` charges the :func:`run_open_loop` prefill
+    cost model per engine (default 0 = legacy uniform ticks)."""
     from ..engine.router import BackendState, RouterPolicy
     from .obs import percentile
 
+    if prefill_busy_steps < 0:
+        raise ValueError("prefill_busy_steps must be >= 0")
     policy = RouterPolicy(max_queue_depth=max_backend_queue)
     arrivals = sample_arrivals(spec)
     now = 0.0
     i = 0
     steps = 0
     shed = 0
+    debt = [0] * len(engines)
+    last_prefills = [int(getattr(e, "prefills_done", 0)) for e in engines]
     tracked: list[dict] = []
     ttft_ms: list[float] = []
     tpot_ms: list[float] = []
@@ -429,16 +455,22 @@ def run_open_loop_routed(engines, spec: OpenLoopSpec, *,
                 rec["last_emit"] = t_emit
             rec["seen"] = n
 
-    while (i < len(arrivals)
+    while (i < len(arrivals) or any(debt)
            or not all(e.idle for e in engines)) and steps < spec.max_steps:
-        if all(e.idle for e in engines) and i < len(arrivals):
+        if all(e.idle for e in engines) and not any(debt) \
+                and i < len(arrivals):
             now = max(now, arrivals[i][0])
             _submit_due()
             continue
         _submit_due()
-        for e in engines:
-            if not e.idle:
+        for n, e in enumerate(engines):
+            if debt[n] > 0:
+                debt[n] -= 1            # busy with prefill math this tick
+            elif not e.idle:
                 e.step()
+                done = int(getattr(e, "prefills_done", 0))
+                debt[n] += (done - last_prefills[n]) * prefill_busy_steps
+                last_prefills[n] = done
         steps += 1
         now += spec.step_ms / 1e3
         _account()
@@ -460,6 +492,167 @@ def run_open_loop_routed(engines, spec: OpenLoopSpec, *,
         "offered": len(arrivals),
         "routed": len(tracked),
         "shed": shed,
+        "completed": completed,
+        "unfinished": unfinished,
+        "steps": steps,
+        "virtual_s": round(now, 4),
+        "tokens": int(sum(r["seen"] for r in tracked)),
+        "ttft_ms": _pcts(ttft_ms) if ttft_ms else
+        {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
+        "tpot_ms": _pcts(tpot_ms) if tpot_ms else
+        {"p50": float("nan"), "p95": float("nan"), "p99": float("nan")},
+    }
+
+
+def run_open_loop_disagg(prefill_engines, decode_engines,
+                         spec: OpenLoopSpec, *,
+                         prefill_busy_steps: int = 0,
+                         max_backend_queue: int = 6) -> dict:
+    """One load point through a DISAGGREGATED fleet: arrivals land on a
+    prefill-phase engine (chosen by the real router policy), which runs
+    the bucketed prefill, emits the first token, and exports the KV
+    pages as a content-addressed manifest; finished prefill legs are
+    handed off to the least-loaded decode-phase engine carrying the
+    ``kv_ref`` + first token, where the pages are adopted and decode
+    streams under the paged-attention kernel. Same virtual-clock
+    discipline as :func:`run_open_loop_routed`; ``prefill_busy_steps``
+    charges the prefill cost model on EVERY engine (decode engines pay
+    it only when a degraded transfer forces a local re-prefill), so the
+    disaggregated and unified curves are comparable within one card.
+
+    TTFT is arrival -> the prefill leg's first token; the decode leg
+    re-emits that token verbatim, so accounting starts the decode leg
+    at ``seen=1`` — no token is counted twice. ``handoffs`` counts
+    prefill legs that carried a kv_ref; a failed export falls back to a
+    plain decode-side submit (local prefill), keeping the harness
+    lossless under the same no-flag-day contract as the router."""
+    from ..engine.router import BackendState, RouterPolicy
+    from .obs import percentile
+
+    if prefill_busy_steps < 0:
+        raise ValueError("prefill_busy_steps must be >= 0")
+    policy = RouterPolicy(max_queue_depth=max_backend_queue)
+    arrivals = sample_arrivals(spec)
+    engines = list(prefill_engines) + list(decode_engines)
+    now = 0.0
+    i = 0
+    steps = 0
+    shed = 0
+    handoffs = 0
+    debt = [0] * len(engines)
+    last_prefills = [int(getattr(e, "prefills_done", 0)) for e in engines]
+    pending: list[dict] = []        # prefill legs in flight
+    tracked: list[dict] = []        # decode legs (latency accounting)
+    ttft_ms: list[float] = []
+    tpot_ms: list[float] = []
+    pre_states = [BackendState(url=f"engine://{n}", healthy=True,
+                               phase="prefill")
+                  for n in range(len(prefill_engines))]
+    # engine counters are lifetime-cumulative; the load point reports
+    # THIS run's deltas so warm engines can serve several rate points
+    adopted0 = sum(int(getattr(e, "kv_adopted", 0))
+                   for e in decode_engines)
+    reprefill0 = sum(int(getattr(e, "kv_reprefills", 0))
+                     for e in decode_engines)
+
+    def _submit_due() -> None:
+        nonlocal i, shed
+        while i < len(arrivals) and arrivals[i][0] <= now:
+            t_arr, prompt = arrivals[i]
+            seq = i
+            i += 1
+            for n, e in enumerate(prefill_engines):
+                pre_states[n].queue_depth = e.queue_depth
+                pre_states[n].active = e.active_count
+            b = policy.choose(pre_states)
+            if b is None:
+                shed += 1
+                continue
+            pe = prefill_engines[int(b.url.rsplit("/", 1)[-1])]
+            rid = reqtrace.mint_request_id(
+                prompt, max_new_tokens=spec.max_new_tokens, seq=seq)
+            req = pe.submit(prompt, spec.max_new_tokens, request_id=rid)
+            pending.append({"req": req, "arrival_s": t_arr,
+                            "rid": rid, "prompt": prompt})
+
+    def _handoff() -> None:
+        nonlocal handoffs
+        for rec in list(pending):
+            req = rec["req"]
+            if not req.done_evt.is_set():
+                continue
+            pending.remove(rec)
+            ttft_ms.append((now - rec["arrival_s"]) * 1e3)
+            de = min(decode_engines,
+                     key=lambda e: e.queue_depth + e.active_count)
+            if req.kv_ref is not None and req.tokens:
+                handoffs += 1
+                r2 = de.submit(rec["prompt"], spec.max_new_tokens,
+                               request_id=rec["rid"], kv_ref=req.kv_ref,
+                               first_token=int(req.tokens[0]))
+            else:  # export failed: lossless fallback, local prefill
+                r2 = de.submit(rec["prompt"], spec.max_new_tokens,
+                               request_id=rec["rid"])
+            tracked.append({"req": r2, "arrival_s": rec["arrival_s"],
+                            "seen": 1, "last_emit": now})
+
+    def _account() -> None:
+        for rec in tracked:
+            n = len(rec["req"].tokens)
+            if n <= rec["seen"]:
+                continue
+            burst = n - rec["seen"]
+            pace = spec.step_ms / 1e3 / burst
+            for j in range(burst):
+                t_emit = now - (burst - 1 - j) * pace
+                tpot_ms.append((t_emit - rec["last_emit"]) * 1e3)
+                rec["last_emit"] = t_emit
+            rec["seen"] = n
+
+    while (i < len(arrivals) or pending or any(debt)
+           or not all(e.idle for e in engines)) and steps < spec.max_steps:
+        if all(e.idle for e in engines) and not pending \
+                and not any(debt) and i < len(arrivals):
+            now = max(now, arrivals[i][0])
+            _submit_due()
+            continue
+        _submit_due()
+        for n, e in enumerate(engines):
+            if debt[n] > 0:
+                debt[n] -= 1            # busy with prefill math this tick
+            elif not e.idle:
+                e.step()
+                done = int(getattr(e, "prefills_done", 0))
+                debt[n] += (done - last_prefills[n]) * prefill_busy_steps
+                last_prefills[n] = done
+        steps += 1
+        now += spec.step_ms / 1e3
+        _handoff()
+        _account()
+
+    completed = sum(1 for r in tracked if r["req"].done_evt.is_set())
+    unfinished = len(pending) + len(tracked) - completed
+
+    def _pcts(vals: list[float]) -> dict:
+        s = sorted(vals)
+        return {"p50": round(percentile(s, 50.0), 3),
+                "p95": round(percentile(s, 95.0), 3),
+                "p99": round(percentile(s, 99.0), 3)}
+
+    return {
+        "rate_rps": spec.rate_rps,
+        "duration_s": spec.duration_s,
+        "disaggregated": True,
+        "prefill_servers": len(prefill_engines),
+        "decode_servers": len(decode_engines),
+        "offered": len(arrivals),
+        "routed": len(tracked) + len(pending),
+        "shed": shed,
+        "handoffs": handoffs,
+        "kv_adopted": int(sum(getattr(e, "kv_adopted", 0)
+                              for e in decode_engines)) - adopted0,
+        "kv_reprefills": int(sum(getattr(e, "kv_reprefills", 0)
+                                 for e in decode_engines)) - reprefill0,
         "completed": completed,
         "unfinished": unfinished,
         "steps": steps,

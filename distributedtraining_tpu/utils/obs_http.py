@@ -97,6 +97,12 @@ _FLEET_SERIES = (
      "1 while the node is quarantined out of the ingest set"),
     ("probation", "fleet_probation",
      "1 while the node is re-admitted on probation"),
+    ("kv_exported", "serve_kv_exported",
+     "requests whose prefill KV pages were published for a decode "
+     "worker (disaggregated serving, prefill phase)"),
+    ("kv_adopted", "serve_kv_adopted",
+     "requests admitted on adopted prefill KV instead of a local "
+     "prefill (disaggregated serving, decode phase)"),
 )
 
 

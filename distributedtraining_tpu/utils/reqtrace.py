@@ -15,8 +15,10 @@ is the request-scoped layer (the TPU serving anatomy in PAPERS.md
   distinguishable while the id remains reproducible from its inputs),
   propagated via the ``X-DT-Request-Id`` header through
   engine/router.py -> engine/serve.py -> engine/speculative.py; the
-  layer ROADMAP items 3/4 (multi-tenant adapters, disaggregated
-  prefill/decode) will route their cross-host attribution through.
+  disaggregated prefill/decode split (engine/kv_transfer.py) routes its
+  cross-worker attribution through exactly this id — the ``kv_export``
+  stage on the prefill worker and the ``kv_adopt`` stage on the decode
+  worker share one request_id, so the waterfall shows the hop.
 - each live request accumulates a **closed-vocabulary stage timeline**
   (:data:`STAGES`; :func:`check_stage` rejects unknown stages at the
   PRODUCER, exactly like flight.check_event_kind and the devprof
@@ -78,6 +80,13 @@ STAGES: dict[str, str] = {
                   "(cold catch-up prefill before proposing)",
     "cow": "copy-on-write page copies before a shared-page write "
            "(coalesced batch)",
+    "kv_export": "prefill worker exported this request's KV pages as "
+                 "content-addressed shards (disaggregated serving); "
+                 "pages, ok, dur_ms",
+    "kv_adopt": "decode worker adopted exported KV pages into its pool "
+                "(the cross-worker hop); pages, dur_ms — a failed "
+                "transfer shows as a plain 'prefill' instead (the "
+                "degrade path)",
     "preempt": "preempted back to the queue on page exhaustion",
     "swap_invalidate": "requeued by a restart-policy base hot-swap",
     "emit": "terminal: finished; tokens, status, ttft_ms, tpot_ms",

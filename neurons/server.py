@@ -151,6 +151,15 @@ def main(argv=None) -> int:
     burn = (_health.BurnRateMonitor(metrics=c.metrics)
             if cfg.serve_trace else None)
     _health.attach_burn(burn)
+    # disaggregated worker classes (engine/kv_transfer.py): a prefill
+    # worker exports KV pages over the SERVING transport (the same
+    # store base revisions ride), a decode worker adopts them; unified
+    # touches neither
+    from distributedtraining_tpu.engine import kv_transfer as _kvt
+    kv_exporter = (_kvt.KVExporter(c.transport)
+                   if cfg.serve_phase == "prefill" else None)
+    kv_adopter = (_kvt.KVAdopter(c.transport)
+                  if cfg.serve_phase == "decode" else None)
     engine = GenerationEngine(
         c.model, params, revision=revision,
         max_slots=cfg.serve_slots, page_size=cfg.serve_page_size,
@@ -160,11 +169,14 @@ def main(argv=None) -> int:
         swap_policy=cfg.swap_policy, watcher=watcher,
         max_queue=cfg.serve_max_queue,
         prefix_cache=cfg.serve_prefix_cache,
-        draft=_build_drafter(cfg, c), draft_k=cfg.serve_draft_k,
+        draft=(None if cfg.serve_phase == "prefill"
+               else _build_drafter(cfg, c)),
+        draft_k=cfg.serve_draft_k,
         trace=cfg.serve_trace,
         trace_exemplars=cfg.serve_trace_exemplars,
         trace_window_s=cfg.serve_trace_window or 30.0,
-        burn=burn)
+        burn=burn, phase=cfg.serve_phase,
+        kv_exporter=kv_exporter, kv_adopter=kv_adopter)
     watcher.start()
 
     # health plane: the server heartbeats its SERVED revision (the
@@ -205,6 +217,13 @@ def main(argv=None) -> int:
         # fleet_report's slo_burn column (0.0 = comfortably on budget)
         if burn is not None:
             out["slo_burn"] = burn.max_burn()
+        # disaggregated transfer volume — fleet_report's phase column
+        # reads the string field; the kv counters ride only on workers
+        # that actually export/adopt so unified heartbeats stay lean
+        if engine.phase != "unified":
+            out["phase"] = engine.phase
+            out["kv_exported"] = float(engine.kv_exported)
+            out["kv_adopted"] = float(engine.kv_adopted)
         return out
 
     vitals = Vitals(

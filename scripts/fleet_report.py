@@ -38,8 +38,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import obs_report  # noqa: E402 — same directory; shares record loading
 
 COLUMNS = ("role", "tier", "hotkey", "beats", "age_s", "step_rate",
-           "loss_ema", "rev", "tok_s", "ttft95", "tpot95", "q_age95",
-           "slo_burn", "shed",
+           "loss_ema", "rev", "phase", "tok_s", "ttft95", "tpot95",
+           "q_age95", "slo_burn", "shed", "kv_exp", "kv_adp",
            "pfx_hit", "acc_rate", "published", "accepted", "declined",
            "stale_rounds",
            "wire_b", "base_b", "mirror_hit", "score", "credit", "quar",
@@ -155,6 +155,13 @@ def _cell(node: dict, col: str) -> str:
         # train -> merge -> serve lag across the fleet
         v = node.get("base_revision")
         return "-" if not isinstance(v, str) or not v else v[:10]
+    if col == "phase":
+        # disaggregated worker class (engine/serve.py healthz/heartbeat
+        # "phase" extra): prefill | decode; unified workers and
+        # non-serving roles read "-" so the column only lights up on a
+        # split fleet
+        v = node.get("phase")
+        return v if v in ("prefill", "decode") else "-"
     if col == "tok_s":
         # serving throughput (server-role heartbeats only)
         v = node.get("tokens_per_sec")
@@ -185,6 +192,14 @@ def _cell(node: dict, col: str) -> str:
         # or router answered instead of queueing into the latency knee
         # (engine/serve.py admission_state / engine/router.py)
         v = node.get("shed")
+        return "-" if v is None else str(int(v))
+    if col in ("kv_exp", "kv_adp"):
+        # disaggregated KV traffic (kv_exported / kv_adopted heartbeat
+        # extras): per-request manifests a prefill worker exported, and
+        # manifests a decode worker adopted — the two must both move on
+        # a healthy split fleet (the fleetsim serve_phase gate's check,
+        # readable per node here)
+        v = node.get("kv_exported" if col == "kv_exp" else "kv_adopted")
         return "-" if v is None else str(int(v))
     if col == "pfx_hit":
         # prefix-cache hit rate: the fraction of admissions that reused

@@ -55,13 +55,16 @@ def build_spec(args) -> "FleetSpec":
         spec = FleetSpec.from_json(args.spec)
         if args.seed is not None:
             spec = dataclasses.replace(spec, seed=args.seed)
+        if args.disaggregated:
+            spec = dataclasses.replace(spec, disaggregated=True)
         return spec
     # --actors N distributes roles the way a real fleet skews: almost
     # everything is a miner; a handful of validators/servers/sub-
     # averagers; one primary + one standby averager
     n = args.actors
     validators = max(1, n // 250)
-    servers = max(1, n // 125)
+    # disaggregation needs both worker classes on the fleet
+    servers = max(2 if args.disaggregated else 1, n // 125)
     subs = max(0, n // 60) if n >= 120 else 0
     miners = n - validators - servers - subs - 2
     if miners < 1:
@@ -94,6 +97,7 @@ def build_spec(args) -> "FleetSpec":
             if args.latency_regression_round is not None
             else (2 * args.rounds // 3 if args.rounds >= 8 else 0)),
         latency_regression_factor=args.latency_regression_factor,
+        disaggregated=args.disaggregated,
         chaos=not args.no_chaos)
     return spec
 
@@ -102,7 +106,9 @@ def run_load_phase(rates, *, seed: int, duration_s: float,
                    servers: int = 0,
                    max_backend_queue: int = 6,
                    speculative: bool = False,
-                   draft_k: int = 4) -> list[dict]:
+                   draft_k: int = 4,
+                   disaggregated: bool = False,
+                   prefill_busy_steps: int = 0) -> list[dict]:
     """The open-loop latency curve: one real GenerationEngine per rate
     (a fresh engine per point keeps the points independent — no warm
     queue bleeding between rates). With ``servers > 0`` each point runs
@@ -112,7 +118,13 @@ def run_load_phase(rates, *, seed: int, duration_s: float,
     shed count is reported per point. With ``speculative`` each engine
     self-drafts through a DraftEngine on the same tiny model+params
     (acceptance ~1.0 — this measures the multi-token commit plumbing,
-    gated by ``spec_tpot_gain_min`` against a plain baseline)."""
+    gated by ``spec_tpot_gain_min`` against a plain baseline). With
+    ``disaggregated`` each rate runs TWO lanes under the same
+    ``prefill_busy_steps`` cost model — a unified single engine, then a
+    prefill-phase + decode-phase pair handing off content-addressed KV
+    pages over an in-memory transport (engine/kv_transfer.py) — so the
+    within-card ``disagg_tpot_gain_min`` gate can isolate what the
+    phase split bought."""
     import jax
 
     from distributedtraining_tpu.engine.serve import GenerationEngine
@@ -138,6 +150,39 @@ def run_load_phase(rates, *, seed: int, duration_s: float,
     for rate in rates:
         spec = loadgen.OpenLoopSpec(rate_rps=float(rate),
                                     duration_s=duration_s, seed=seed)
+        if disaggregated:
+            from distributedtraining_tpu.engine import kv_transfer as kvt
+            from distributedtraining_tpu.transport.memory import (
+                InMemoryTransport)
+            # lane A: unified single engine under the prefill cost model
+            engine = _engine(revision="r0")
+            try:
+                uni = loadgen.run_open_loop(
+                    engine, spec, prefill_busy_steps=prefill_busy_steps)
+            finally:
+                engine.close()
+            points.append(uni)
+            # lane B: prefill + decode pair over one in-memory transport
+            tr = InMemoryTransport()
+            pe = _engine(revision="r0", phase="prefill",
+                         kv_exporter=kvt.KVExporter(tr))
+            de = _engine(revision="r0", phase="decode",
+                         kv_adopter=kvt.KVAdopter(tr))
+            try:
+                dis = loadgen.run_open_loop_disagg(
+                    [pe], [de], spec,
+                    prefill_busy_steps=prefill_busy_steps)
+            finally:
+                pe.close()
+                de.close()
+            points.append(dis)
+            print(f"  load {rate:g} rps: unified tpot p95 "
+                  f"{uni['tpot_ms']['p95']:.2f}ms vs disagg "
+                  f"{dis['tpot_ms']['p95']:.2f}ms (handoffs "
+                  f"{dis['handoffs']}, adopted {dis['kv_adopted']}, "
+                  f"reprefills {dis['kv_reprefills']}, unfinished "
+                  f"{dis['unfinished']})", file=sys.stderr)
+            continue
         if servers > 0:
             engines = [_engine(prefix_cache=True)
                        for _ in range(servers)]
@@ -210,6 +255,17 @@ def main(argv=None) -> int:
                          "p95 vs a non-speculating --baseline)")
     ap.add_argument("--draft-k", type=int, default=4,
                     help="draft tokens proposed per speculative step")
+    ap.add_argument("--disaggregated", action="store_true",
+                    help="disaggregated topology: alternate sim servers "
+                         "between prefill/decode phases, and run the "
+                         "load phase as unified-vs-disaggregated lanes "
+                         "under the prefill cost model (gated within "
+                         "the card by disagg_tpot_gain_min)")
+    ap.add_argument("--prefill-busy-steps", type=int, default=None,
+                    help="virtual busy ticks charged per completed "
+                         "prefill in the load phase (default: 4 with "
+                         "--disaggregated, else 0 = legacy uniform "
+                         "ticks)")
     ap.add_argument("--latency-regression-round", type=int, default=None,
                     help="inject a serving-latency regression at this "
                          "round (0 = never; default: 2*rounds/3 when "
@@ -274,11 +330,16 @@ def main(argv=None) -> int:
             rates = [float(r) for r in args.rates.split(",") if r]
             print(f"fleetsim: open-loop serving at {rates} rps",
                   file=sys.stderr)
+            busy = (args.prefill_busy_steps
+                    if args.prefill_busy_steps is not None
+                    else (4 if args.disaggregated else 0))
             load_points = run_load_phase(
                 rates, seed=spec.seed, duration_s=args.load_duration,
                 servers=args.router_servers,
                 max_backend_queue=args.router_max_queue,
-                speculative=args.speculative, draft_k=args.draft_k)
+                speculative=args.speculative, draft_k=args.draft_k,
+                disaggregated=args.disaggregated,
+                prefill_busy_steps=busy)
 
         card = fs.assemble_scorecard(result, control, load_points,
                                      gates=gates)

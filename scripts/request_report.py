@@ -80,10 +80,29 @@ def gather_bundles(paths: list[str]) -> list[dict]:
     return out
 
 
+def _merge_hop(pre: dict, dec: dict) -> dict:
+    """One request's two-worker story (disaggregated serving): the
+    prefill worker's leg (timeline ending in ``kv_export``, status
+    "prefilled") spliced ahead of the decode worker's leg (timeline
+    starting at ``kv_adopt``). Each stage is tagged with its leg so the
+    waterfall and the Chrome trace keep the workers apart; rel_ms stays
+    leg-relative (each worker clocks from its own submit)."""
+    return {"summary": dec["summary"],
+            "stages": ([dict(ev, leg="prefill") for ev in pre["stages"]]
+                       + [dict(ev, leg="decode")
+                          for ev in dec["stages"]]),
+            "bundle_id": dec.get("bundle_id"),
+            "prefill_bundle_id": pre.get("bundle_id"),
+            "hop": True}
+
+
 def collect_exemplars(bundles: list[dict]) -> dict[str, dict]:
     """request_id -> {"summary": exemplar event, "stages": [stage
     events in freeze order], "bundle_id": ...}. A request frozen in
-    several windows keeps its LAST freeze (most complete timeline)."""
+    several windows keeps its LAST freeze (most complete timeline) —
+    EXCEPT the disaggregated case, where one worker's record ends
+    "prefilled" and another's carries the decode: those are two legs of
+    one request and merge into a single cross-worker waterfall."""
     out: dict[str, dict] = {}
     for b in bundles:
         per_req: dict[str, dict] = {}
@@ -99,9 +118,18 @@ def collect_exemplars(bundles: list[dict]) -> dict[str, dict]:
                 per_req.setdefault(rid, {"stages": []})["stages"] \
                     .append(ev)
         for rid, rec in per_req.items():
-            if rec.get("summary") and rec["stages"]:
-                rec["bundle_id"] = b.get("bundle_id")
-                out[rid] = rec
+            if not (rec.get("summary") and rec["stages"]):
+                continue
+            rec["bundle_id"] = b.get("bundle_id")
+            prev = out.get(rid)
+            if prev is not None:
+                prev_pf = prev["summary"].get("status") == "prefilled"
+                rec_pf = rec["summary"].get("status") == "prefilled"
+                if prev_pf and not rec_pf:
+                    rec = _merge_hop(prev, rec)
+                elif rec_pf and not prev_pf:
+                    rec = _merge_hop(rec, prev)
+            out[rid] = rec
     return out
 
 
@@ -122,14 +150,22 @@ def format_waterfall(rid: str, rec: dict) -> str:
         meta.append(f"ttft_ms={s['ttft_ms']:.3f}")
     if isinstance(s.get("tpot_ms"), (int, float)):
         meta.append(f"tpot_ms={s['tpot_ms']:.3f}")
+    if rec.get("hop"):
+        # disaggregated request: two workers, two legs, one story
+        meta.append("hop=prefill->decode")
     if rec.get("bundle_id"):
         meta.append(f"bundle={rec['bundle_id']}")
+    if rec.get("prefill_bundle_id"):
+        meta.append(f"prefill_bundle={rec['prefill_bundle_id']}")
     lines.append("  " + "  ".join(meta))
     lines.append("")
     header = ["stage", "rel_ms", "dur_ms", "n", "detail"]
     rows = []
+    # rel_ms is per-worker (each leg clocks from its own submit), so a
+    # merged hop sorts prefill-leg rows ahead of decode-leg rows
     stages = sorted(rec["stages"],
-                    key=lambda e: float(e.get("rel_ms", 0.0)))
+                    key=lambda e: (e.get("leg") == "decode",
+                                   float(e.get("rel_ms", 0.0))))
     for ev in stages:
         detail = "  ".join(
             f"{k}={ev[k]}" for k in sorted(ev)
@@ -147,6 +183,9 @@ def format_waterfall(rid: str, rec: dict) -> str:
     lines.append("")
     lines.append("rel_ms from request submit; n = batched decode/spec/"
                  "cow steps coalesced into the row")
+    if rec.get("hop"):
+        lines.append("legs clock separately: rel_ms restarts at the "
+                     "decode worker's submit")
     return "\n".join(lines)
 
 

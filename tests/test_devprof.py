@@ -264,6 +264,7 @@ _HOT_MODULES = (
     "distributedtraining_tpu/delta.py",
     "distributedtraining_tpu/engine/serve.py",
     "distributedtraining_tpu/engine/speculative.py",
+    "distributedtraining_tpu/engine/kv_transfer.py",
     "distributedtraining_tpu/ops/paged_attention.py",
     "distributedtraining_tpu/ops/dequant_scatter.py",
 )

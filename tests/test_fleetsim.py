@@ -378,6 +378,88 @@ def test_serving_gate_reads_load_points(serve_engine):
     assert not card2["gates"]["serving"]["ok"]
 
 
+def test_disaggregated_topology_phases_and_gate():
+    """FleetSpec.disaggregated splits the server cohort into
+    prefill/decode worker classes: the scorecard's serve_phase section
+    proves both classes lived (phase heartbeats) AND that KV actually
+    moved (exports on prefill, adoptions on decode); a one-class fleet
+    fails the gate."""
+    spec = smoke_spec(rounds=3, disaggregated=True)
+    card = fs.assemble_scorecard(fs.simulate(spec))
+    sp = card["serve_phase"]
+    assert sp["phases"] == {"prefill": 1, "decode": 1}
+    assert sp["kv_exported"] > 0 and sp["kv_adopted"] > 0
+    assert card["gates"]["serve_phase"]["ok"]
+    bad = json.loads(json.dumps(card))
+    bad["serve_phase"]["phases"] = {"prefill": 2}
+    assert not fs.evaluate_gates(bad)["serve_phase"]["ok"]
+    bad2 = json.loads(json.dumps(card))
+    bad2["serve_phase"]["kv_adopted"] = 0
+    assert not fs.evaluate_gates(bad2)["serve_phase"]["ok"]
+    # the knob round-trips (spec JSON is the fleet's config artifact)
+    rt = fs.FleetSpec.from_json(json.dumps(dataclasses.asdict(spec)))
+    assert rt == spec
+    # a non-disaggregated card has no serve_phase section or gate
+    plain = fs.assemble_scorecard(fs.simulate(smoke_spec(rounds=3)))
+    assert "serve_phase" not in plain
+    assert "serve_phase" not in plain["gates"]
+
+
+def test_disagg_load_points_and_knee_gate(serve_engine):
+    """The two-lane load phase: a unified worker paying the prefill
+    head-of-line cost vs a 1-prefill + 1-decode pair at the same
+    offered rates. The disaggregated lane must win tpot p95 at the
+    knee (highest common rate) by >= disagg_tpot_gain_min, and the
+    serving gate records the comparison."""
+    from distributedtraining_tpu.engine import kv_transfer as kvt
+    from distributedtraining_tpu.engine.serve import GenerationEngine
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+    import jax
+
+    uni_pts = [loadgen.run_open_loop(serve_engine, loadgen.OpenLoopSpec(
+        rate_rps=r, duration_s=1.5, seed=9, max_new_tokens=8),
+        prefill_busy_steps=4) for r in (8.0, 24.0)]
+    model, _ = gpt2.make_model(gpt2.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_head=2, n_layer=2))
+    params = model.init_params(jax.random.PRNGKey(0))
+    tr = InMemoryTransport()
+    pe = GenerationEngine(model, params, revision="r0", max_slots=4,
+                          page_size=8, phase="prefill",
+                          kv_exporter=kvt.KVExporter(tr))
+    de = GenerationEngine(model, params, revision="r0", max_slots=4,
+                          page_size=8, phase="decode",
+                          kv_adopter=kvt.KVAdopter(tr))
+    try:
+        dis_pts = [loadgen.run_open_loop_disagg(
+            [pe], [de], loadgen.OpenLoopSpec(
+                rate_rps=r, duration_s=1.5, seed=9, max_new_tokens=8),
+            prefill_busy_steps=4) for r in (8.0, 24.0)]
+    finally:
+        pe.close()
+        de.close()
+    d = dis_pts[-1]
+    assert d["disaggregated"] and d["handoffs"] > 0
+    assert d["kv_adopted"] == d["handoffs"] and d["kv_reprefills"] == 0
+    # the head-of-line cost the split removes, visible in the curve
+    assert d["tpot_ms"]["p95"] < uni_pts[-1]["tpot_ms"]["p95"]
+    spec = smoke_spec(rounds=3, disaggregated=True)
+    card = fs.assemble_scorecard(fs.simulate(spec),
+                                 load_points=uni_pts + dis_pts)
+    g = card["gates"]["serving"]
+    assert g["ok"], g
+    assert g["disaggregated"] and g["handoffs_total"] > 0
+    knee = g["disagg_knee"]
+    assert knee["rate_rps"] == 24.0
+    assert knee["gain"] >= knee["gain_min"]
+    # a regressed disaggregated lane fails the knee gate
+    bad = json.loads(json.dumps(card))
+    for p in bad["serving"]["load_points"]:
+        if p.get("disaggregated"):
+            p["tpot_ms"]["p95"] = uni_pts[-1]["tpot_ms"]["p95"]
+    assert not fs.evaluate_gates(bad)["serving"]["ok"]
+
+
 # ---------------------------------------------------------------------------
 # The acceptance run (slow lane)
 # ---------------------------------------------------------------------------
@@ -410,6 +492,66 @@ def test_thousand_actor_acceptance_run(serve_engine):
     assert len(card["serving"]["load_points"]) == 3
     # byte-identical rerun (load points are deterministic too, pinned
     # above at tier-1 scale — reuse them rather than re-decoding)
+    card2 = fs.assemble_scorecard(fs.simulate(spec),
+                                  fs.simulate(spec.control()), pts)
+    assert json.dumps(card, sort_keys=True) == \
+        json.dumps(card2, sort_keys=True)
+
+
+@pytest.mark.slow
+def test_thousand_actor_disaggregated_acceptance_run(serve_engine):
+    """ISSUE 19 acceptance: the same ~1000-actor chaos fleet with the
+    server cohort split into prefill/decode worker classes. Per-phase
+    SLO gates stay green, both classes prove themselves through phase
+    heartbeats + KV counters, and the disaggregated serve lane beats
+    the unified baseline on tpot p95 at the load knee by >=
+    disagg_tpot_gain_min. Deterministic like every other scorecard."""
+    from distributedtraining_tpu.engine import kv_transfer as kvt
+    from distributedtraining_tpu.engine.serve import GenerationEngine
+    from distributedtraining_tpu.models import gpt2
+    from distributedtraining_tpu.transport import InMemoryTransport
+    import jax
+
+    spec = fs.FleetSpec(
+        miners=960, validators=4, servers=8, sub_averagers=16,
+        rounds=12, seed=0, stale_miners=24, divergent_miners=24,
+        pushfail_miners=24, poison_miners=24, kills=12,
+        kill_primary_round=5, partitions_per_round=4,
+        disaggregated=True)
+    rates = (8.0, 24.0, 72.0)
+    pts = [loadgen.run_open_loop(serve_engine, loadgen.OpenLoopSpec(
+        rate_rps=r, duration_s=4.0, seed=spec.seed, max_new_tokens=8),
+        prefill_busy_steps=4) for r in rates]
+    model, _ = gpt2.make_model(gpt2.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=32, n_head=2, n_layer=2))
+    params = model.init_params(jax.random.PRNGKey(0))
+    tr = InMemoryTransport()
+    pe = GenerationEngine(model, params, revision="r0", max_slots=4,
+                          page_size=8, phase="prefill",
+                          kv_exporter=kvt.KVExporter(tr))
+    de = GenerationEngine(model, params, revision="r0", max_slots=4,
+                          page_size=8, phase="decode",
+                          kv_adopter=kvt.KVAdopter(tr))
+    try:
+        pts += [loadgen.run_open_loop_disagg(
+            [pe], [de], loadgen.OpenLoopSpec(
+                rate_rps=r, duration_s=4.0, seed=spec.seed,
+                max_new_tokens=8),
+            prefill_busy_steps=4) for r in rates]
+    finally:
+        pe.close()
+        de.close()
+    card = fs.assemble_scorecard(fs.simulate(spec),
+                                 fs.simulate(spec.control()), pts)
+    assert card["ok"], {k: v for k, v in card["gates"].items()
+                        if not v["ok"]}
+    assert card["serve_phase"]["phases"] == {"prefill": 4, "decode": 4}
+    assert card["serve_phase"]["kv_exported"] > 0
+    assert card["serve_phase"]["kv_adopted"] > 0
+    knee = card["gates"]["serving"]["disagg_knee"]
+    assert knee["rate_rps"] == 72.0
+    assert knee["gain"] >= knee["gain_min"]
+    assert knee["kv_reprefills"] == 0
     card2 = fs.assemble_scorecard(fs.simulate(spec),
                                   fs.simulate(spec.control()), pts)
     assert json.dumps(card, sort_keys=True) == \

@@ -112,7 +112,8 @@ def test_producer_sites_use_registered_stages():
         found[rel] = names
     # the wiring actually exists (an empty lint proves nothing)
     assert found["engine/serve.py"] >= {"admit", "prefill", "decode",
-                                        "spec", "cow", "preempt", "shed"}
+                                        "spec", "cow", "preempt", "shed",
+                                        "kv_export", "kv_adopt"}
     assert "spec_draft" in found["engine/speculative.py"]
     for rel, names in found.items():
         unknown = names - set(reqtrace.STAGES)
@@ -353,6 +354,74 @@ def test_seal_window_freezes_exemplars_and_report_replays(setup, tmp_path):
     assert all(e["request_id"] == rid for e in entries)
     emit = [e for e in entries if e["source"] == "emit"]
     assert emit and emit[0]["tokens"] == 8
+
+
+def test_cross_worker_hop_merges_into_one_waterfall(setup):
+    """Disaggregated forensics: the prefill worker freezes a
+    "prefilled" exemplar (timeline ending in kv_export), the decode
+    worker freezes the decode leg (kv_adopt onward) under the SAME
+    request id; request_report splices them into one cross-worker
+    waterfall — prefill leg first, each row tagged with its leg."""
+    from distributedtraining_tpu.engine import kv_transfer as kvt
+
+    model, params, prompts = setup
+    tr = InMemoryTransport()
+    rids = [f"rq-hop-{i}" for i in range(len(prompts))]
+
+    flight.configure("server", "pre0", transport=tr)
+    pe = GenerationEngine(model, params, revision="r1", max_slots=4,
+                          page_size=8, phase="prefill",
+                          kv_exporter=kvt.KVExporter(tr),
+                          trace=True, trace_exemplars=8,
+                          trace_window_s=1e9)
+    try:
+        legs = [pe.submit(p, 8, request_id=rid)
+                for p, rid in zip(prompts, rids)]
+        while not all(r.done_evt.is_set() for r in legs):
+            pe.step()
+        assert pe.trace.seal_window()
+        pre_bundle = flight.fetch_bundle(tr, "server", "pre0")
+    finally:
+        pe.close()
+        flight.shutdown()
+
+    flight.configure("server", "dec0", transport=tr)
+    de = GenerationEngine(model, params, revision="r1", max_slots=4,
+                          page_size=8, phase="decode",
+                          kv_adopter=kvt.KVAdopter(tr),
+                          trace=True, trace_exemplars=8,
+                          trace_window_s=1e9)
+    try:
+        reqs = [de.submit(p, 8, request_id=rid, kv_ref=leg.kv_ref,
+                          first_token=leg.first_token)
+                for p, rid, leg in zip(prompts, rids, legs)]
+        while not all(r.done_evt.is_set() for r in reqs):
+            de.step()
+        assert de.kv_adopted == len(prompts)
+        assert de.trace.seal_window()
+        dec_bundle = flight.fetch_bundle(tr, "server", "dec0")
+    finally:
+        de.close()
+        flight.shutdown()
+
+    exemplars = collect_exemplars([pre_bundle, dec_bundle])
+    assert set(rids) <= set(exemplars)
+    rec = exemplars[rids[0]]
+    assert rec["hop"] and rec["summary"]["status"] == "done"
+    assert rec["prefill_bundle_id"] == pre_bundle["bundle_id"]
+    stage_legs = [(e["stage"], e.get("leg")) for e in rec["stages"]]
+    assert ("kv_export", "prefill") in stage_legs
+    assert ("kv_adopt", "decode") in stage_legs
+    text = format_waterfall(rids[0], rec)
+    assert "hop=prefill->decode" in text
+    # splice order: every prefill-leg row above every decode-leg row
+    assert text.index("kv_export") < text.index("kv_adopt")
+    # bundle order must not matter
+    flipped = collect_exemplars([dec_bundle, pre_bundle])
+    assert flipped[rids[0]]["hop"]
+    # the chrome trace keeps the leg tag per entry
+    entries = trace_entries(rids[0], rec)
+    assert {e.get("leg") for e in entries} == {"prefill", "decode"}
 
 
 def test_http_frontend_propagates_request_id(setup):
